@@ -1,0 +1,27 @@
+"""hpx_tpu — a TPU-native asynchronous many-task framework.
+
+Capability target: biddisco/hpx (see SURVEY.md). Architecture: TPU-first —
+futures/dataflow orchestrate XLA program dispatches; parallel algorithms
+lower to jit/Pallas kernels; partitioned data is sharded jax.Arrays;
+collectives ride XLA collectives (psum/ppermute/all_gather/all_to_all) over
+ICI inside shard_map; localities map onto processes/devices with an
+AGAS-style name registry.
+
+Public API façade mirroring HPX's umbrella headers (hpx/hpx.hpp):
+
+    import hpx_tpu as hpx
+    f = hpx.async_(fn, *args)            # hpx::async
+    hpx.dataflow(fn, f1, f2)             # hpx::dataflow
+    hpx.when_all(fs); hpx.wait_all(fs)   # combinators
+    hpx.transform_reduce(hpx.par.on(hpx.tpu_executor()), ...)
+"""
+
+from .core.version import HPX_TPU_VERSION, full_version_as_string  # noqa: F401
+from .core.errors import Error, ErrorCode, HpxError  # noqa: F401
+from .core.config import Configuration  # noqa: F401
+
+__version__ = full_version_as_string()
+
+# Populated as milestones land (SURVEY.md §7): futures/async/dataflow (M1),
+# executors/policies (M2), algorithms (M3), runtime/localities (M5),
+# containers + segmented algorithms (M6), collectives (M7), services (M9).

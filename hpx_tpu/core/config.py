@@ -1,0 +1,225 @@
+"""Layered runtime configuration.
+
+Reference analog: libs/core/ini (section.key ini model),
+libs/core/runtime_configuration (the merged config object every subsystem
+reads), libs/full/command_line_handling (--hpx:* CLI overlay).
+
+Merge order (later wins), mirroring HPX:
+  1. compiled-in defaults (DEFAULTS below)
+  2. ini files:  ./hpx_tpu.ini, $HPX_TPU_INI
+  3. environment variables:  HPX_TPU_<SECTION>__<KEY>=value
+     (double underscore separates section path from key; single underscores
+      inside section names map to dots: HPX_TPU_PARCEL__PORT -> hpx.parcel.port)
+  4. command line:  --hpx:ini=section.key=value plus sugar flags
+     (--hpx:threads=N, --hpx:localities=N, --hpx:queuing=..., ...)
+  5. programmatic overrides via Configuration.set()
+
+Every subsystem reads one resolved `Configuration` object — same discipline
+as HPX's runtime_configuration.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+from .errors import BadParameter
+
+# Compiled-in defaults (HPX: generated defaults in runtime_configuration.cpp)
+DEFAULTS: Dict[str, str] = {
+    "hpx.os_threads": "auto",            # host worker threads
+    "hpx.localities": "1",
+    "hpx.locality": "0",
+    "hpx.queuing": "local-priority-fifo",  # scheduler choice
+    "hpx.scheduler.native": "1",          # use C++ scheduler when available
+    "hpx.stacks.small_size": "0",         # no stackful coroutines on host
+    "hpx.parcel.enable": "1",
+    "hpx.parcel.port": "7910",
+    "hpx.parcel.address": "127.0.0.1",
+    "hpx.parcel.bootstrap": "tcp",
+    "hpx.parcel.max_message_size": str(1 << 30),
+    "hpx.agas.service_mode": "bootstrap",  # locality 0 hosts the registry
+    "hpx.agas.max_pending_refcnt_requests": "4096",
+    "hpx.logging.level": "warning",
+    "hpx.logging.destination": "stderr",
+    "hpx.diagnostics.dump_config": "0",
+    "hpx.tpu.platform": "auto",           # auto | tpu | cpu
+    "hpx.tpu.default_dtype": "float32",
+    "hpx.tpu.donate_buffers": "1",
+    "hpx.tpu.watcher_threads": "2",       # future-completion watcher pool
+    "hpx.tpu.eager_futures": "1",         # device futures ready at dispatch
+    "hpx.counters.enable": "1",
+    "hpx.checkpoint.dir": "./checkpoints",
+    "hpx.resiliency.replay_default_n": "3",
+    "hpx.exec.default_chunk": "auto",
+    "hpx.exec.min_chunk_size": "1",
+}
+
+
+def _parse_ini_text(text: str) -> Dict[str, str]:
+    """Parse `[section]\nkey = value` ini text into flat dotted keys."""
+    out: Dict[str, str] = {}
+    section = ""
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith((";", "#", "//")):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = line[1:-1].strip()
+            continue
+        if "=" not in line:
+            raise BadParameter(f"malformed ini line: {raw!r}", "config")
+        key, _, value = line.partition("=")
+        full = f"{section}.{key.strip()}" if section else key.strip()
+        out[full] = value.strip()
+    return out
+
+
+def _env_overlay(environ: Mapping[str, str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    prefix = "HPX_TPU_"
+    for name, value in environ.items():
+        if not name.startswith(prefix) or name == "HPX_TPU_INI":
+            continue
+        rest = name[len(prefix):]
+        if "__" in rest:
+            section, _, key = rest.partition("__")
+            dotted = "hpx." + section.lower().replace("_", ".") + "." + key.lower()
+        else:
+            dotted = "hpx." + rest.lower()
+        out[dotted] = value
+    return out
+
+
+def _cli_overlay(argv: Iterable[str]) -> Tuple[Dict[str, str], List[str]]:
+    """Extract --hpx:* flags; return (overrides, remaining argv).
+
+    Sugar flags mirror HPX's CLI (libs/full/command_line_handling):
+      --hpx:threads=N       -> hpx.os_threads
+      --hpx:localities=N    -> hpx.localities
+      --hpx:queuing=NAME    -> hpx.queuing
+      --hpx:ini=sec.key=v   -> raw override
+      --hpx:print-counter=X -> hpx.counters.print (comma list)
+      --hpx:dump-config     -> hpx.diagnostics.dump_config=1
+    """
+    sugar = {
+        "threads": "hpx.os_threads",
+        "localities": "hpx.localities",
+        "locality": "hpx.locality",
+        "queuing": "hpx.queuing",
+        "hpx": "hpx.parcel.endpoint",
+        "agas": "hpx.agas.endpoint",
+    }
+    overrides: Dict[str, str] = {}
+    remaining: List[str] = []
+    for arg in argv:
+        if not arg.startswith("--hpx:"):
+            remaining.append(arg)
+            continue
+        body = arg[len("--hpx:"):]
+        key, sep, value = body.partition("=")
+        if key == "ini":
+            k, _, v = value.partition("=")
+            overrides[k.strip()] = v.strip()
+        elif key == "dump-config":
+            overrides["hpx.diagnostics.dump_config"] = "1"
+        elif key == "print-counter":
+            prev = overrides.get("hpx.counters.print", "")
+            overrides["hpx.counters.print"] = (prev + "," + value) if prev else value
+        elif key == "print-counter-interval":
+            overrides["hpx.counters.print_interval"] = value
+        elif key in sugar:
+            if not sep:
+                raise BadParameter(
+                    f"--hpx:{key} requires a value: --hpx:{key}=VALUE", "config")
+            overrides[sugar[key]] = value
+        else:
+            raise BadParameter(f"unknown --hpx: option: {arg}", "config")
+    return overrides, remaining
+
+
+class Configuration:
+    """The resolved, layered configuration object (thread-safe)."""
+
+    def __init__(self,
+                 argv: Optional[Iterable[str]] = None,
+                 overrides: Optional[Mapping[str, Any]] = None,
+                 environ: Optional[Mapping[str, str]] = None,
+                 ini_files: Optional[Iterable[str]] = None):
+        env = os.environ if environ is None else environ
+        self._lock = threading.Lock()
+        self._data: Dict[str, str] = dict(DEFAULTS)
+
+        files = list(ini_files) if ini_files is not None else []
+        if ini_files is None:
+            if os.path.exists("hpx_tpu.ini"):
+                files.append("hpx_tpu.ini")
+            extra = env.get("HPX_TPU_INI")
+            if extra:
+                if not os.path.exists(extra):
+                    raise BadParameter(
+                        f"HPX_TPU_INI points at nonexistent file: {extra}",
+                        "config")
+                files.append(extra)
+        for path in files:
+            with open(path, "r", encoding="utf-8") as fh:
+                self._data.update(_parse_ini_text(fh.read()))
+
+        self._data.update(_env_overlay(env))
+
+        self.remaining_argv: List[str] = []
+        if argv is not None:
+            cli, self.remaining_argv = _cli_overlay(argv)
+            self._data.update(cli)
+
+        if overrides:
+            for k, v in overrides.items():
+                self._data[str(k)] = str(v)
+
+    # -- queries ------------------------------------------------------------
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            return self._data.get(key, default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.get(key)
+        if v is None or v == "auto":
+            return default
+        return int(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key)
+        if v is None:
+            return default
+        return v.strip().lower() in ("1", "true", "yes", "on")
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.get(key)
+        if v is None or v == "auto":
+            return default
+        try:
+            return float(v)
+        except ValueError as e:
+            raise BadParameter(f"{key}={v!r} is not a float", "config") from e
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._data[str(key)] = str(value)
+
+    def section(self, prefix: str) -> Dict[str, str]:
+        """All keys under `prefix.` with the prefix stripped."""
+        p = prefix.rstrip(".") + "."
+        with self._lock:
+            return {k[len(p):]: v for k, v in self._data.items() if k.startswith(p)}
+
+    def dump(self) -> str:
+        """--hpx:dump-config analog."""
+        with self._lock:
+            return "\n".join(f"{k} = {v}" for k, v in sorted(self._data.items()))
+
+    def os_threads(self) -> int:
+        v = self.get("hpx.os_threads", "auto")
+        if v == "auto":
+            return max(1, os.cpu_count() or 1)
+        return max(1, int(v))

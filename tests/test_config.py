@@ -1,0 +1,71 @@
+"""Config layering tests (reference analog: libs/core/ini tests)."""
+
+import pytest
+
+from hpx_tpu.core.config import Configuration, _parse_ini_text
+from hpx_tpu.core.errors import BadParameter
+
+
+def test_defaults_present():
+    cfg = Configuration(environ={})
+    assert cfg.get("hpx.localities") == "1"
+    assert cfg.get_int("hpx.parcel.port") == 7910
+    assert cfg.get_bool("hpx.parcel.enable")
+
+
+def test_ini_parse_sections():
+    data = _parse_ini_text(
+        """
+        ; comment
+        [hpx.parcel]
+        port = 1234
+        address=10.0.0.1
+        [hpx]
+        localities = 4
+        """
+    )
+    assert data["hpx.parcel.port"] == "1234"
+    assert data["hpx.parcel.address"] == "10.0.0.1"
+    assert data["hpx.localities"] == "4"
+
+
+def test_env_overlay():
+    cfg = Configuration(environ={"HPX_TPU_PARCEL__PORT": "9999"})
+    assert cfg.get_int("hpx.parcel.port") == 9999
+
+
+def test_cli_overlay_and_remaining():
+    cfg = Configuration(
+        argv=["prog", "--hpx:threads=4", "--hpx:ini=hpx.queuing=static",
+              "--user-arg", "--hpx:dump-config"],
+        environ={},
+    )
+    assert cfg.os_threads() == 4
+    assert cfg.get("hpx.queuing") == "static"
+    assert cfg.get_bool("hpx.diagnostics.dump_config")
+    assert cfg.remaining_argv == ["prog", "--user-arg"]
+
+
+def test_cli_layer_beats_env():
+    cfg = Configuration(
+        argv=["--hpx:ini=hpx.parcel.port=42"],
+        environ={"HPX_TPU_PARCEL__PORT": "9999"},
+    )
+    assert cfg.get_int("hpx.parcel.port") == 42
+
+
+def test_unknown_hpx_flag_raises():
+    with pytest.raises(BadParameter):
+        Configuration(argv=["--hpx:bogus=1"], environ={})
+
+
+def test_programmatic_override_wins():
+    cfg = Configuration(environ={}, overrides={"hpx.localities": 8})
+    assert cfg.get_int("hpx.localities") == 8
+
+
+def test_section_query_and_dump():
+    cfg = Configuration(environ={})
+    sec = cfg.section("hpx.parcel")
+    assert "port" in sec and "enable" in sec
+    assert "hpx.parcel.port = 7910" in cfg.dump()

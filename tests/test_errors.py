@@ -1,0 +1,26 @@
+import pytest
+
+from hpx_tpu.core.errors import (
+    Error, ErrorCode, HpxError, throw_exception, throws_or_sets,
+)
+
+
+def test_throw_exception_carries_code():
+    with pytest.raises(HpxError) as ei:
+        throw_exception(Error.bad_parameter, "bad arg", "test_fn")
+    assert ei.value.get_error() == Error.bad_parameter
+    assert "bad_parameter" in str(ei.value)
+
+
+def test_error_code_out_param():
+    ec = ErrorCode()
+    assert not ec
+    throws_or_sets(ec, Error.network_error, "down")
+    assert ec and ec.value == Error.network_error
+    ec.clear()
+    assert not ec
+
+
+def test_throws_when_no_ec():
+    with pytest.raises(HpxError):
+        throws_or_sets(None, Error.deadlock, "stuck")
