@@ -22,6 +22,21 @@ from .core.config import Configuration  # noqa: F401
 
 __version__ = full_version_as_string()
 
-# Populated as milestones land (SURVEY.md §7): futures/async/dataflow (M1),
-# executors/policies (M2), algorithms (M3), runtime/localities (M5),
-# containers + segmented algorithms (M6), collectives (M7), services (M9).
+# -- futures / async / dataflow (M1) ----------------------------------------
+from .futures import (  # noqa: F401
+    Future, Promise, PackagedTask, Launch,
+    async_, post, sync, dataflow, unwrapping,
+    make_ready_future, make_exceptional_future, is_future,
+    when_all, when_any, when_each, when_some,
+    wait_all, wait_any, wait_each, wait_some, split_future,
+)
+from . import lcos  # noqa: F401
+from .synchronization import (  # noqa: F401
+    Barrier, ConditionVariable, CountingSemaphore, Event, Latch, Mutex,
+    SlidingSemaphore, Spinlock, StopSource, StopToken,
+    enable_lock_verification,
+)
+
+# Populated as milestones land (SURVEY.md §7): executors/policies (M2),
+# algorithms (M3), runtime/localities (M5), containers + segmented
+# algorithms (M6), collectives (M7), services (M9).

@@ -1,0 +1,1 @@
+from .threadpool import WorkStealingPool, default_pool, reset_default_pool  # noqa: F401

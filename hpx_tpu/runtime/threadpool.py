@@ -1,0 +1,179 @@
+"""Host-side work-stealing thread pool.
+
+Reference analog: libs/core/thread_pools + libs/core/schedulers
+(scheduled_thread_pool running scheduling_loop over per-core queues with
+stealing; default local-priority-queue scheduler).
+
+TPU-first rationale: host tasks here are *orchestration* (building dataflow
+graphs, dispatching XLA programs, IO) — the FLOPs live on device. The pool
+therefore optimizes for low submit overhead and FIFO fairness rather than
+cache locality. A native C++ scheduler (hpx_tpu/native) can be swapped in
+via the same interface (see exec/ executors); this pure-Python version is
+the always-available fallback and the reference for its semantics.
+
+Scheduling: per-worker deques; a worker pops LIFO from its own deque (hot
+cache) and steals FIFO from victims — the classic Arora-Blumofe-Plaxton
+discipline HPX's `abp` scheduler uses. External submits round-robin across
+queues. Idle workers park on a condition, mirroring HPX's scheduling_loop
+idle backoff.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+from typing import Any, Callable, Deque, List, Optional, Tuple
+
+_Task = Tuple[Callable[..., Any], tuple, dict]
+
+# Which pool the current OS thread is a worker of (if any). Futures consult
+# this to "work-help" instead of blocking — the analog of an HPX thread
+# suspending so its worker can steal other work (libs/core/thread_pools
+# scheduling_loop). Without this, a recursive async+get pattern deadlocks
+# the moment tasks outnumber workers.
+_worker_of = threading.local()
+
+
+def current_worker_pool() -> Optional["WorkStealingPool"]:
+    return getattr(_worker_of, "pool", None)
+
+
+class WorkStealingPool:
+    def __init__(self, num_threads: Optional[int] = None,
+                 name: str = "default") -> None:
+        self.name = name
+        n = num_threads or max(1, (os.cpu_count() or 2))
+        self._queues: List[Deque[_Task]] = [collections.deque() for _ in range(n)]
+        self._locks = [threading.Lock() for _ in range(n)]
+        self._cv = threading.Condition()
+        self._pending = 0          # tasks submitted, not yet popped
+        self._shutdown = False
+        self._rr = itertools.count()
+        self._tls = threading.local()
+        self._workers = [
+            threading.Thread(target=self._worker, args=(i,),
+                             name=f"hpx-tpu-{name}-{i}", daemon=True)
+            for i in range(n)
+        ]
+        self._executed = 0         # counter surface (perf counters, M9)
+        self._stolen = 0
+        for w in self._workers:
+            w.start()
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
+        """Fire-and-forget schedule (hpx::post semantics at pool level).
+
+        A worker submits to its own queue (children run hot, LIFO — HPX
+        thread_queue does the same); external threads round-robin across
+        queues."""
+        wid = getattr(self._tls, "wid", None)
+        if wid is None:
+            wid = next(self._rr) % len(self._queues)
+        with self._locks[wid]:
+            self._queues[wid].append((fn, args, kwargs))
+        with self._cv:
+            self._pending += 1
+            self._cv.notify()
+
+    def in_worker(self) -> bool:
+        return getattr(self._tls, "wid", None) is not None
+
+    @property
+    def num_threads(self) -> int:
+        return len(self._queues)
+
+    # -- worker loop --------------------------------------------------------
+    def _try_pop(self, wid: int) -> Optional[_Task]:
+        q, lk = self._queues[wid], self._locks[wid]
+        with lk:
+            if q:
+                return q.pop()          # own queue: LIFO
+        n = len(self._queues)
+        for off in range(1, n):
+            vid = (wid + off) % n
+            with self._locks[vid]:
+                if self._queues[vid]:
+                    self._stolen += 1
+                    return self._queues[vid].popleft()  # steal: FIFO
+        return None
+
+    def _run_task(self, task: _Task) -> None:
+        with self._cv:
+            self._pending -= 1
+        fn, args, kwargs = task
+        try:
+            fn(*args, **kwargs)
+        except BaseException:  # noqa: BLE001 — see _worker note
+            import traceback
+            traceback.print_exc()
+        self._executed += 1
+
+    def help_one(self) -> bool:
+        """Pop and run one queued task from any queue; True if one ran.
+
+        Called by futures while a worker waits — keeps the pool making
+        progress instead of deadlocking on nested get() (HPX suspension
+        analog)."""
+        wid = getattr(self._tls, "wid", 0)
+        task = self._try_pop(wid % len(self._queues))
+        if task is None:
+            return False
+        self._run_task(task)
+        return True
+
+    def _worker(self, wid: int) -> None:
+        self._tls.wid = wid
+        _worker_of.pool = self
+        while True:
+            task = self._try_pop(wid)
+            if task is None:
+                with self._cv:
+                    while self._pending == 0 and not self._shutdown:
+                        self._cv.wait()
+                    if self._shutdown and self._pending == 0:
+                        return
+                continue
+            # task exceptions are captured into futures by callers; a bare
+            # submit that raises is a programming error surfaced loudly.
+            self._run_task(task)
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        if wait:
+            for w in self._workers:
+                if w is not threading.current_thread():
+                    w.join(timeout=5.0)
+
+    # -- introspection (performance-counter feed) ---------------------------
+    def stats(self) -> dict:
+        return {"executed": self._executed, "stolen": self._stolen,
+                "pending": self._pending, "threads": len(self._queues)}
+
+
+_default_pool: Optional[WorkStealingPool] = None
+_default_lock = threading.Lock()
+
+
+def default_pool() -> WorkStealingPool:
+    global _default_pool
+    if _default_pool is None:
+        with _default_lock:
+            if _default_pool is None:
+                from ..core.config import Configuration
+                _default_pool = WorkStealingPool(
+                    Configuration(environ=os.environ).os_threads(), "default")
+    return _default_pool
+
+
+def reset_default_pool() -> None:
+    global _default_pool
+    with _default_lock:
+        if _default_pool is not None:
+            _default_pool.shutdown(wait=False)
+        _default_pool = None

@@ -1,0 +1,290 @@
+"""Synchronization primitives.
+
+Reference analog: libs/core/synchronization (hpx::mutex, spinlock,
+condition_variable, counting_semaphore, sliding_semaphore, latch, barrier,
+event, stop_token). HPX's versions *suspend the HPX thread* instead of
+blocking the OS thread; in this runtime host tasks run on OS threads, so
+Python's native primitives are the right substrate — the value added here
+is (a) HPX's exact API shapes, (b) futures-returning variants that let the
+dataflow layer wait without occupying a thread, and (c) the
+suspend-while-holding-lock debug check (see core `held_locks`, analog of
+HPX_WITH_VERIFY_LOCKS — SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from .core.errors import DeadlockError, Error, HpxError
+from .futures.future import Future, SharedState, make_ready_future
+
+# ---------------------------------------------------------------------------
+# VERIFY_LOCKS analog: registered locks held by the current thread. Waiting
+# on a future while holding a registered lock aborts (the classic AMT
+# deadlock HPX guards against with HPX_WITH_VERIFY_LOCKS).
+_tls = threading.local()
+_verify_locks = False
+
+
+def enable_lock_verification(enable: bool = True) -> None:
+    global _verify_locks
+    _verify_locks = enable
+
+
+def _held() -> List[Any]:
+    lst = getattr(_tls, "held", None)
+    if lst is None:
+        lst = _tls.held = []
+    return lst
+
+
+def verify_no_locks_held(what: str = "wait") -> None:
+    if _verify_locks and _held():
+        raise DeadlockError(
+            f"{what} while holding {len(_held())} registered lock(s) — "
+            "suspension while holding a lock deadlocks the scheduler")
+
+
+class Mutex:
+    """hpx::mutex with lock-verification registration."""
+
+    def __init__(self) -> None:
+        self._lk = threading.Lock()
+
+    def lock(self) -> None:
+        self._lk.acquire()
+        _held().append(self)
+
+    def try_lock(self) -> bool:
+        ok = self._lk.acquire(blocking=False)
+        if ok:
+            _held().append(self)
+        return ok
+
+    def unlock(self) -> None:
+        _held().remove(self)
+        self._lk.release()
+
+    def __enter__(self) -> "Mutex":
+        self.lock()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.unlock()
+
+
+Spinlock = Mutex  # host-side: same substrate; kept for API parity
+
+
+class ConditionVariable:
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+
+    def wait(self, pred: Optional[Callable[[], bool]] = None,
+             timeout: Optional[float] = None) -> bool:
+        verify_no_locks_held("condition_variable::wait")
+        with self._cv:
+            if pred is None:
+                return self._cv.wait(timeout)
+            return self._cv.wait_for(pred, timeout)
+
+    def notify_one(self) -> None:
+        with self._cv:
+            self._cv.notify()
+
+    def notify_all(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+
+class Latch:
+    """hpx::latch: single-use countdown; wait via block or future."""
+
+    def __init__(self, count: int) -> None:
+        if count < 0:
+            raise HpxError(Error.bad_parameter, "latch count must be >= 0")
+        self._lock = threading.Lock()
+        self._count = count
+        self._state = SharedState()
+        if count == 0:
+            self._state.set_value(None)
+
+    def count_down(self, n: int = 1) -> None:
+        with self._lock:
+            if self._count < n:
+                raise HpxError(Error.invalid_status, "latch over-decremented")
+            self._count -= n
+            fire = self._count == 0
+        if fire:
+            self._state.set_value(None)
+
+    def try_wait(self) -> bool:
+        return self._state.is_ready()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        verify_no_locks_held("latch::wait")
+        return self._state.wait(timeout)
+
+    def arrive_and_wait(self, n: int = 1,
+                        timeout: Optional[float] = None) -> bool:
+        self.count_down(n)
+        return self.wait(timeout)
+
+    def get_future(self) -> Future[None]:
+        return Future(self._state)
+
+
+class Barrier:
+    """hpx::barrier<>: cyclic; arrive_and_wait, with completion callback."""
+
+    def __init__(self, count: int,
+                 on_completion: Optional[Callable[[], None]] = None) -> None:
+        if count <= 0:
+            raise HpxError(Error.bad_parameter, "barrier count must be > 0")
+        self._count = count
+        self._on_completion = on_completion
+        self._lock = threading.Lock()
+        self._arrived = 0
+        self._state = SharedState()
+
+    def arrive(self, n: int = 1) -> Future[None]:
+        """Arrive without waiting; returned future fires on phase done."""
+        with self._lock:
+            st = self._state
+            self._arrived += n
+            fire = self._arrived >= self._count
+            if fire:
+                # open next phase before releasing waiters
+                self._arrived = 0
+                self._state = SharedState()
+        if fire:
+            if self._on_completion is not None:
+                self._on_completion()
+            st.set_value(None)
+        return Future(st)
+
+    def arrive_and_wait(self, timeout: Optional[float] = None) -> bool:
+        verify_no_locks_held("barrier::arrive_and_wait")
+        return self.arrive().wait(timeout)
+
+    def arrive_and_drop(self) -> None:
+        with self._lock:
+            self._count -= 1
+            st = self._state
+            fire = self._arrived >= self._count and self._count > 0
+            if fire:
+                self._arrived = 0
+                self._state = SharedState()
+        if fire:
+            if self._on_completion is not None:
+                self._on_completion()
+            st.set_value(None)
+
+
+class CountingSemaphore:
+    """hpx::counting_semaphore."""
+
+    def __init__(self, value: int = 0) -> None:
+        self._sem = threading.Semaphore(value)
+
+    def acquire(self, timeout: Optional[float] = None) -> bool:
+        verify_no_locks_held("semaphore::acquire")
+        return self._sem.acquire(timeout=timeout)
+
+    def try_acquire(self) -> bool:
+        return self._sem.acquire(blocking=False)
+
+    def release(self, n: int = 1) -> None:
+        self._sem.release(n)
+
+
+class SlidingSemaphore:
+    """hpx::sliding_semaphore: bounds the distance between a monotonically
+    growing lower and upper value (used to throttle in-flight pipeline
+    stages — e.g. how far ahead the host may run dispatching device steps).
+
+    wait(t): block until t - max_difference <= lower. signal(l): advance.
+    """
+
+    def __init__(self, max_difference: int, lower: int = 0) -> None:
+        self._max_diff = max_difference
+        self._lower = lower
+        self._cv = threading.Condition()
+
+    def wait(self, upper: int, timeout: Optional[float] = None) -> bool:
+        verify_no_locks_held("sliding_semaphore::wait")
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: upper - self._max_diff <= self._lower, timeout)
+
+    def try_wait(self, upper: int) -> bool:
+        with self._cv:
+            return upper - self._max_diff <= self._lower
+
+    def signal(self, lower: int) -> None:
+        with self._cv:
+            self._lower = max(self._lower, lower)
+            self._cv.notify_all()
+
+
+class Event:
+    """hpx::lcos::local::event: manual-reset gate."""
+
+    def __init__(self) -> None:
+        self._ev = threading.Event()
+
+    def occurred(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        verify_no_locks_held("event::wait")
+        return self._ev.wait(timeout)
+
+    def set(self) -> None:
+        self._ev.set()
+
+    def reset(self) -> None:
+        self._ev.clear()
+
+
+class StopSource:
+    """std::stop_source/std::stop_token analog (hpx::stop_token)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._callbacks: List[Callable[[], None]] = []
+
+    def request_stop(self) -> bool:
+        with self._lock:
+            if self._stopped:
+                return False
+            self._stopped = True
+            cbs = list(self._callbacks)
+            self._callbacks.clear()
+        for cb in cbs:
+            cb()
+        return True
+
+    def stop_requested(self) -> bool:
+        return self._stopped
+
+    def get_token(self) -> "StopToken":
+        return StopToken(self)
+
+
+class StopToken:
+    def __init__(self, source: StopSource) -> None:
+        self._source = source
+
+    def stop_requested(self) -> bool:
+        return self._source.stop_requested()
+
+    def on_stop(self, cb: Callable[[], None]) -> None:
+        src = self._source
+        with src._lock:
+            if not src._stopped:
+                src._callbacks.append(cb)
+                return
+        cb()

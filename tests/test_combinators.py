@@ -1,0 +1,138 @@
+"""when_all/when_any/when_some/when_each + dataflow tests.
+
+Reference analog: libs/core/async_combinators/tests/unit and
+libs/core/pack_traversal dataflow tests.
+"""
+
+import threading
+
+import pytest
+
+import hpx_tpu as hpx
+
+
+def test_when_all_varargs_and_iterable():
+    a, b = hpx.make_ready_future(1), hpx.make_ready_future(2)
+    done = hpx.when_all(a, b).get()
+    assert [f.get() for f in done] == [1, 2]
+    done2 = hpx.when_all([a, b]).get()
+    assert [f.get() for f in done2] == [1, 2]
+
+
+def test_when_all_empty():
+    assert hpx.when_all().get() == []
+
+
+def test_when_all_pending_then_fires():
+    p1, p2 = hpx.Promise(), hpx.Promise()
+    f = hpx.when_all(p1.get_future(), p2.get_future())
+    assert not f.is_ready()
+    p1.set_value(1)
+    assert not f.is_ready()
+    p2.set_value(2)
+    assert f.is_ready()
+
+
+def test_when_all_exceptional_inputs_do_not_throw_outer():
+    bad = hpx.make_exceptional_future(ValueError("x"))
+    ok = hpx.make_ready_future(1)
+    res = hpx.when_all(bad, ok).get()  # outer get does not raise
+    assert res[0].has_exception() and res[1].get() == 1
+
+
+def test_when_any_first_ready_index():
+    p1, p2 = hpx.Promise(), hpx.Promise()
+    f = hpx.when_any(p1.get_future(), p2.get_future())
+    p2.set_value("second")
+    r = f.get(timeout=5.0)
+    assert r.index == 1
+    assert r.futures[1].get() == "second"
+
+
+def test_when_some():
+    ps = [hpx.Promise() for _ in range(4)]
+    f = hpx.when_some(2, [p.get_future() for p in ps])
+    ps[3].set_value(1)
+    assert not f.is_ready()
+    ps[1].set_value(1)
+    assert sorted(f.get(timeout=5.0).indices) == [1, 3]
+
+
+def test_when_each_and_wait_each():
+    seen = []
+    ps = [hpx.Promise() for _ in range(3)]
+    f = hpx.when_each(lambda fut: seen.append(fut.get()),
+                      [p.get_future() for p in ps])
+    for i, p in enumerate(ps):
+        p.set_value(i)
+    f.get(timeout=5.0)
+    assert sorted(seen) == [0, 1, 2]
+
+
+def test_wait_all_values_coerced():
+    # plain values are accepted (make_ready_future coercion)
+    hpx.wait_all(hpx.make_ready_future(1), 2)
+
+
+def test_split_future():
+    p = hpx.Promise()
+    a, b, c = hpx.split_future(p.get_future(), 3)
+    p.set_value((10, 20, 30))
+    assert (a.get(), b.get(), c.get()) == (10, 20, 30)
+
+
+# -- dataflow ---------------------------------------------------------------
+
+def test_dataflow_receives_ready_futures():
+    a, b = hpx.make_ready_future(2), hpx.make_ready_future(3)
+    f = hpx.dataflow(lambda x, y: x.get() + y.get(), a, b)
+    assert f.get(timeout=5.0) == 5
+
+
+def test_dataflow_unwrapping():
+    a, b = hpx.make_ready_future(2), hpx.make_ready_future(3)
+    f = hpx.dataflow(hpx.unwrapping(lambda x, y: x + y), a, b)
+    assert f.get(timeout=5.0) == 5
+
+
+def test_dataflow_does_not_block_on_pending():
+    p = hpx.Promise()
+    fired = threading.Event()
+    f = hpx.dataflow(lambda fut: fired.set() or fut.get(), p.get_future())
+    assert not fired.wait(0.05)      # must not run before dependency ready
+    p.set_value(77)
+    assert f.get(timeout=5.0) == 77
+
+
+def test_dataflow_nested_containers():
+    ps = [hpx.Promise() for _ in range(3)]
+    futs = [p.get_future() for p in ps]
+    f = hpx.dataflow(lambda lst: sum(x.get() for x in lst), futs)
+    for i, p in enumerate(ps):
+        p.set_value(i + 1)
+    assert f.get(timeout=5.0) == 6
+
+
+def test_dataflow_mixed_values_and_futures():
+    f = hpx.dataflow(hpx.unwrapping(lambda x, y: x * y),
+                     hpx.make_ready_future(6), 7)
+    assert f.get(timeout=5.0) == 42
+
+
+def test_dataflow_exception_propagates():
+    bad = hpx.make_exceptional_future(KeyError("dep"))
+    f = hpx.dataflow(hpx.unwrapping(lambda x: x), bad)
+    with pytest.raises(KeyError):
+        f.get(timeout=5.0)
+
+
+def test_dataflow_chain_stencil_shape():
+    # 1d_stencil_4-shaped DAG: U[t+1][i] = f(U[t][i-1], U[t][i], U[t][i+1])
+    np_, nt = 5, 10
+    u = [hpx.make_ready_future(float(i)) for i in range(np_)]
+    heat = hpx.unwrapping(lambda l, m, r: 0.25 * l + 0.5 * m + 0.25 * r)
+    for _t in range(nt):
+        u = [hpx.dataflow(heat, u[(i - 1) % np_], u[i], u[(i + 1) % np_])
+             for i in range(np_)]
+    vals = [f.get(timeout=10.0) for f in u]
+    assert abs(sum(vals) - sum(range(np_))) < 1e-9  # conservation

@@ -1,0 +1,177 @@
+"""Futures/promise/continuation tests.
+
+Reference analog: libs/core/futures/tests/unit (future.cpp, shared_future.cpp,
+future_then.cpp) — semantics: continuations, unwrapping, exception
+propagation, promise protocol errors.
+"""
+
+import threading
+import time
+
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.core.errors import FutureError
+
+
+def test_make_ready_future():
+    f = hpx.make_ready_future(42)
+    assert f.is_ready() and f.has_value()
+    assert f.get() == 42
+    assert f.get() == 42  # shared_future semantics: repeatable get
+
+
+def test_promise_future_roundtrip():
+    p = hpx.Promise()
+    f = p.get_future()
+    assert not f.is_ready()
+    p.set_value("hi")
+    assert f.is_ready()
+    assert f.get() == "hi"
+
+
+def test_promise_future_retrieved_once():
+    p = hpx.Promise()
+    p.get_future()
+    with pytest.raises(FutureError):
+        p.get_future()
+
+
+def test_promise_already_satisfied():
+    p = hpx.Promise()
+    p.set_value(1)
+    with pytest.raises(FutureError):
+        p.set_value(2)
+
+
+def test_exception_propagation():
+    f = hpx.make_exceptional_future(ValueError("boom"))
+    assert f.has_exception()
+    with pytest.raises(ValueError, match="boom"):
+        f.get()
+
+
+def test_then_continuation_ready():
+    f = hpx.make_ready_future(3)
+    g = f.then(lambda fut: fut.get() * 2)
+    assert g.get() == 6
+
+
+def test_then_continuation_pending():
+    p = hpx.Promise()
+    g = p.get_future().then(lambda fut: fut.get() + 1)
+    assert not g.is_ready()
+    p.set_value(9)
+    assert g.get() == 10
+
+
+def test_then_chains_and_exceptions():
+    p = hpx.Promise()
+    g = (p.get_future()
+         .then(lambda f: f.get() * 2)
+         .then(lambda f: 1 / f.get()))
+    p.set_value(0)
+    with pytest.raises(ZeroDivisionError):
+        g.get()
+
+
+def test_future_unwrapping_in_set_value():
+    # future<future<int>> collapses (HPX unwrapping semantics)
+    p = hpx.Promise()
+    inner = hpx.make_ready_future(7)
+    p.set_value(inner)
+    assert p.get_future().get() == 7
+
+
+def test_then_returning_future_unwraps():
+    f = hpx.make_ready_future(1)
+    g = f.then(lambda fut: hpx.make_ready_future(fut.get() + 10))
+    assert g.get() == 11
+
+
+def test_packaged_task():
+    pt = hpx.PackagedTask(lambda a, b: a + b)
+    f = pt.get_future()
+    pt(2, 3)
+    assert f.get() == 5
+
+
+def test_wait_timeout():
+    p = hpx.Promise()
+    f = p.get_future()
+    assert f.wait(timeout=0.01) is False
+    with pytest.raises(FutureError):
+        f.get(timeout=0.01)
+
+
+def test_concurrent_set_and_wait():
+    # regression-style: waiter races the setter (HPX future races class)
+    for _ in range(50):
+        p = hpx.Promise()
+        f = p.get_future()
+        t = threading.Thread(target=lambda: p.set_value(123))
+        t.start()
+        assert f.get(timeout=5.0) == 123
+        t.join()
+
+
+def test_async_basic():
+    f = hpx.async_(lambda x: x * x, 12)
+    assert f.get(timeout=5.0) == 144
+
+
+def test_async_exception():
+    def bad():
+        raise RuntimeError("task failed")
+    with pytest.raises(RuntimeError, match="task failed"):
+        hpx.async_(bad).get(timeout=5.0)
+
+
+def test_async_unwraps_returned_future():
+    f = hpx.async_(lambda: hpx.async_(lambda: 5))
+    assert f.get(timeout=5.0) == 5
+
+
+def test_launch_sync():
+    order = []
+    f = hpx.async_(lambda: order.append("ran"), policy=hpx.Launch.sync)
+    assert order == ["ran"] and f.is_ready()
+
+
+def test_launch_deferred():
+    ran = []
+    f = hpx.async_(lambda: ran.append(1) or 99, policy=hpx.Launch.deferred)
+    assert ran == []           # not started
+    assert f.get() == 99
+    assert ran == [1]
+
+
+def test_post_fire_and_forget():
+    done = threading.Event()
+    hpx.post(done.set)
+    assert done.wait(5.0)
+
+
+def test_sync_helper():
+    assert hpx.sync(lambda: 3) == 3
+    assert hpx.sync(lambda: hpx.make_ready_future(4)) == 4
+
+
+def test_deferred_consumed_via_then_runs():
+    # regression: deferred future consumed through the callback interface
+    # (then/dataflow/when_all) must start its thunk
+    f = hpx.async_(lambda: 5, policy=hpx.Launch.deferred)
+    assert f.then(lambda fut: fut.get() + 1).get(timeout=5.0) == 6
+    g = hpx.async_(lambda: 7, policy=hpx.Launch.deferred)
+    assert hpx.when_all(g).get(timeout=5.0)[0].get() == 7
+
+
+def test_raising_user_callback_does_not_poison_producer():
+    # regression: a raising user callback must not escape into set_value
+    # nor starve later continuations
+    p = hpx.Promise()
+    f = p.get_future()
+    hpx.when_each(lambda fut: 1 / 0, f)      # user callback that raises
+    g = f.then(lambda fut: fut.get() * 2)
+    p.set_value(21)                           # must not raise
+    assert g.get(timeout=5.0) == 42

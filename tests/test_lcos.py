@@ -1,0 +1,274 @@
+"""LCO + synchronization primitive tests.
+
+Reference analogs: libs/core/lcos_local/tests/unit (channel.cpp,
+receive_buffer.cpp, and_gate, guards), libs/core/synchronization/tests/unit
+(latch.cpp, barrier.cpp, sliding_semaphore.cpp, stop_token).
+"""
+
+import threading
+import time
+
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.core.errors import DeadlockError, HpxError
+from hpx_tpu.lcos import (
+    AndGate, Channel, CompositeGuard, OneElementChannel, ReceiveBuffer,
+    Trigger, run_guarded,
+)
+
+
+def test_channel_set_then_get():
+    ch = Channel()
+    ch.set(1)
+    ch.set(2)
+    assert ch.get().get() == 1
+    assert ch.get().get() == 2
+
+
+def test_channel_get_before_set():
+    ch = Channel()
+    f = ch.get()
+    assert not f.is_ready()
+    ch.set("x")
+    assert f.get(timeout=5.0) == "x"
+
+
+def test_channel_close_fails_pending_gets():
+    ch = Channel()
+    f = ch.get()
+    n = ch.close()
+    assert n == 1
+    with pytest.raises(HpxError):
+        f.get()
+    with pytest.raises(HpxError):
+        ch.set(1)
+
+
+def test_channel_iteration():
+    ch = Channel()
+    for i in range(3):
+        ch.set(i)
+    ch.close()
+    assert list(ch) == [0, 1, 2]
+
+
+def test_channel_producer_consumer_threads():
+    ch = Channel()
+    out = []
+
+    def producer():
+        for i in range(100):
+            ch.set(i)
+
+    def consumer():
+        for _ in range(100):
+            out.append(ch.get().get(timeout=5.0))
+
+    ts = [threading.Thread(target=producer), threading.Thread(target=consumer)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10.0)
+    assert out == list(range(100))
+
+
+def test_one_element_channel():
+    ch = OneElementChannel()
+    ch.set(5)
+    with pytest.raises(HpxError):
+        ch.set(6)
+    assert ch.get().get() == 5
+    f = ch.get()
+    ch.set(7)
+    assert f.get() == 7
+
+
+def test_receive_buffer_halo_pattern():
+    rb = ReceiveBuffer()
+    # consumer asks for step 3 before producer stores it
+    f3 = rb.receive(3)
+    rb.store_received(3, "halo3")
+    rb.store_received(4, "halo4")   # producer ahead of consumer
+    assert f3.get(timeout=5.0) == "halo3"
+    assert rb.receive(4).get() == "halo4"
+    assert rb._slots == {}          # slots reclaimed
+
+
+def test_trigger():
+    tr = Trigger()
+    f = tr.get_future()
+    assert not f.is_ready()
+    tr.set()
+    tr.set()  # idempotent
+    assert f.is_ready()
+
+
+def test_and_gate_generations():
+    g = AndGate(3)
+    f = g.get_future()
+    g.set(0); g.set(2)
+    assert not f.is_ready()
+    g.set(1)
+    assert f.get() == 0
+    with pytest.raises(HpxError):
+        g.set(1)  # duplicate within generation
+    assert g.next_generation() == 1
+    f2 = g.get_future()
+    for i in range(3):
+        g.set(i)
+    assert f2.get() == 1
+
+
+def test_composite_guard_serializes():
+    guard = CompositeGuard()
+    order = []
+
+    def work(i):
+        def body():
+            order.append(("in", i))
+            time.sleep(0.002)
+            order.append(("out", i))
+        return body
+
+    fs = [guard.run(work(i)) for i in range(5)]
+    hpx.wait_all(fs)
+    # strictly serialized: every "in" immediately followed by its "out"
+    for j in range(0, 10, 2):
+        assert order[j][0] == "in" and order[j + 1][0] == "out"
+        assert order[j][1] == order[j + 1][1]
+
+
+def test_run_guarded_multiple_guards():
+    g1, g2 = CompositeGuard(), CompositeGuard()
+    counter = {"v": 0, "max_in": 0, "in": 0}
+    lock = threading.Lock()
+
+    def body():
+        with lock:
+            counter["in"] += 1
+            counter["max_in"] = max(counter["max_in"], counter["in"])
+        time.sleep(0.001)
+        counter["v"] += 1
+        with lock:
+            counter["in"] -= 1
+
+    fs = [run_guarded([g1, g2], body) for _ in range(8)]
+    fs += [run_guarded([g1], body) for _ in range(4)]
+    hpx.wait_all(fs, timeout=10.0)
+    assert counter["v"] == 12
+
+
+# -- synchronization --------------------------------------------------------
+
+def test_latch():
+    lt = hpx.Latch(3)
+    assert not lt.try_wait()
+    lt.count_down(2)
+    assert not lt.try_wait()
+    lt.count_down()
+    assert lt.try_wait() and lt.wait(0.0)
+    assert lt.get_future().is_ready()
+
+
+def test_latch_threads():
+    lt = hpx.Latch(4)
+    for _ in range(4):
+        threading.Thread(target=lt.count_down).start()
+    assert lt.wait(timeout=5.0)
+
+
+def test_barrier_cyclic():
+    bar = hpx.Barrier(3)
+    results = []
+
+    def party(i):
+        for phase in range(3):
+            bar.arrive_and_wait(timeout=10.0)
+            results.append((phase, i))
+
+    ts = [threading.Thread(target=party, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10.0)
+    assert len(results) == 9
+    # all phase-p arrivals complete before any phase-p+1 entry is recorded
+    phases = [p for p, _ in results]
+    assert phases == sorted(phases)
+
+
+def test_barrier_completion_callback():
+    hits = []
+    bar = hpx.Barrier(2, on_completion=lambda: hits.append(1))
+    f1 = bar.arrive()
+    f2 = bar.arrive()
+    hpx.wait_all(f1, f2)
+    assert hits == [1]
+
+
+def test_counting_semaphore():
+    sem = hpx.CountingSemaphore(2)
+    assert sem.try_acquire() and sem.try_acquire()
+    assert not sem.try_acquire()
+    sem.release()
+    assert sem.try_acquire()
+
+
+def test_sliding_semaphore_throttles():
+    ss = hpx.SlidingSemaphore(max_difference=2, lower=0)
+    assert ss.try_wait(2)
+    assert not ss.try_wait(3)
+    ss.signal(1)
+    assert ss.try_wait(3)
+
+
+def test_event():
+    ev = hpx.Event()
+    assert not ev.occurred()
+    ev.set()
+    assert ev.wait(0.0)
+    ev.reset()
+    assert not ev.occurred()
+
+
+def test_stop_token():
+    src = hpx.StopSource()
+    tok = src.get_token()
+    hits = []
+    tok.on_stop(lambda: hits.append(1))
+    assert not tok.stop_requested()
+    assert src.request_stop()
+    assert not src.request_stop()   # second request is a no-op
+    assert tok.stop_requested() and hits == [1]
+    tok.on_stop(lambda: hits.append(2))  # late registration fires inline
+    assert hits == [1, 2]
+
+
+def test_verify_locks_guard():
+    hpx.enable_lock_verification(True)
+    try:
+        m = hpx.Mutex()
+        with m:
+            with pytest.raises(DeadlockError):
+                hpx.Latch(1).wait(0.01)
+        # outside the lock it's fine
+        lt = hpx.Latch(0)
+        assert lt.wait(0.01)
+    finally:
+        hpx.enable_lock_verification(False)
+
+
+def test_run_guarded_concurrent_multiguard_no_deadlock():
+    # regression: interleaved multi-guard tail swaps must not create a
+    # circular future dependency
+    g1, g2 = CompositeGuard(), CompositeGuard()
+    fs = []
+    def spam(order):
+        for _ in range(20):
+            fs.append(run_guarded(order, lambda: 1))
+    t1 = threading.Thread(target=spam, args=([g1, g2],))
+    t2 = threading.Thread(target=spam, args=([g1, g2],))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    hpx.wait_all(fs, timeout=10.0)
+    assert all(f.is_ready() for f in fs)
