@@ -37,6 +37,20 @@ from .synchronization import (  # noqa: F401
     enable_lock_verification,
 )
 
-# Populated as milestones land (SURVEY.md §7): executors/policies (M2),
-# algorithms (M3), runtime/localities (M5), containers + segmented
-# algorithms (M6), collectives (M7), services (M9).
+# -- executors & execution policies (M2) ------------------------------------
+from .exec import (  # noqa: F401
+    BaseExecutor, SequencedExecutor, ParallelExecutor, ThreadPoolExecutor,
+    ForkJoinExecutor, TpuExecutor, Target, get_targets, default_target,
+    get_future,
+    ExecutionPolicy, seq, par, par_unseq, unseq, simd, par_simd,
+    static_chunk_size, auto_chunk_size, dynamic_chunk_size,
+    guided_chunk_size, num_cores,
+)
+
+# tpu_executor: the north-star spelling (BASELINE.json:
+# `hpx::execution::par.on(tpu_executor{})`)
+tpu_executor = TpuExecutor
+
+# Populated as milestones land (SURVEY.md §7): algorithms (M3),
+# runtime/localities (M5), containers + segmented algorithms (M6),
+# collectives (M7), services (M9).

@@ -223,3 +223,29 @@ class Configuration:
         if v == "auto":
             return max(1, os.cpu_count() or 1)
         return max(1, int(v))
+
+
+# -- process-wide resolved configuration ------------------------------------
+# "Every subsystem reads one resolved config object" (HPX
+# runtime_configuration discipline): subsystems call runtime_config()
+# instead of constructing fresh Configurations (which would re-read ini
+# files/environ and could observe divergent state mid-run).
+_runtime_config: Optional[Configuration] = None
+_runtime_config_lock = threading.Lock()
+
+
+def runtime_config() -> Configuration:
+    global _runtime_config
+    if _runtime_config is None:
+        with _runtime_config_lock:
+            if _runtime_config is None:
+                _runtime_config = Configuration()
+    return _runtime_config
+
+
+def set_runtime_config(cfg: Optional[Configuration]) -> None:
+    """Install (or with None, reset) the process-wide configuration —
+    used by runtime init with CLI argv, and by tests."""
+    global _runtime_config
+    with _runtime_config_lock:
+        _runtime_config = cfg

@@ -140,9 +140,9 @@ def when_each(fn: Callable[[Future], Any], *args: Any) -> Future:
 
 # -- blocking variants ------------------------------------------------------
 
-def wait_all(*args: Any, timeout: Optional[float] = None) -> None:
-    for f in _normalize(args):
-        f.wait(timeout)
+def wait_all(*args: Any, timeout: Optional[float] = None) -> bool:
+    """Wait for all inputs; one shared timeout, returns readiness."""
+    return when_all(*args).wait(timeout)
 
 
 def wait_any(*args: Any, timeout: Optional[float] = None) -> int:

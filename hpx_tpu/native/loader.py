@@ -1,0 +1,160 @@
+"""ctypes binding for the native runtime core (libhpx_tpu_rt.so).
+
+Builds the shared library on first use if g++ is available (no pybind11 in
+this environment — plain C ABI + ctypes, per the project's binding policy).
+Falls back cleanly: callers must handle native_lib() returning None and use
+the pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libhpx_tpu_rt.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+_lib_lock = threading.Lock()
+
+_TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_size_t)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _HERE], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.hpxrt_pool_create.restype = ctypes.c_void_p
+        lib.hpxrt_pool_create.argtypes = [ctypes.c_int]
+        lib.hpxrt_pool_submit.argtypes = [ctypes.c_void_p, _TASK_FN,
+                                          ctypes.c_size_t]
+        lib.hpxrt_pool_help_one.restype = ctypes.c_int
+        lib.hpxrt_pool_help_one.argtypes = [ctypes.c_void_p]
+        lib.hpxrt_pool_in_worker.restype = ctypes.c_int
+        lib.hpxrt_pool_in_worker.argtypes = [ctypes.c_void_p]
+        lib.hpxrt_pool_shutdown.argtypes = [ctypes.c_void_p]
+        lib.hpxrt_pool_executed.restype = ctypes.c_uint64
+        lib.hpxrt_pool_executed.argtypes = [ctypes.c_void_p]
+        lib.hpxrt_pool_stolen.restype = ctypes.c_uint64
+        lib.hpxrt_pool_stolen.argtypes = [ctypes.c_void_p]
+        lib.hpxrt_pool_pending.restype = ctypes.c_long
+        lib.hpxrt_pool_pending.argtypes = [ctypes.c_void_p]
+        lib.hpxrt_now_ns.restype = ctypes.c_uint64
+        lib.hpxrt_counter_new.restype = ctypes.c_void_p
+        lib.hpxrt_counter_add.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.hpxrt_counter_get.restype = ctypes.c_int64
+        lib.hpxrt_counter_get.argtypes = [ctypes.c_void_p]
+        lib.hpxrt_counter_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def now_ns() -> int:
+    lib = native_lib()
+    if lib is not None:
+        return lib.hpxrt_now_ns()
+    import time
+    return time.monotonic_ns()
+
+
+class NativePool:
+    """Work-stealing pool backed by C++ threads.
+
+    Python tasks are kept in an id-keyed registry; a single CFUNCTYPE
+    trampoline (which re-acquires the GIL) dispatches by id. Conforms to
+    the same interface as runtime.threadpool.WorkStealingPool so futures'
+    work-helping treats both uniformly.
+    """
+
+    def __init__(self, num_threads: int, name: str = "native") -> None:
+        lib = native_lib()
+        if lib is None:
+            raise RuntimeError("native runtime library unavailable")
+        self._lib = lib
+        self.name = name
+        self._n = max(1, num_threads)
+        self._handle = lib.hpxrt_pool_create(self._n)
+        self._tasks: Dict[int, tuple] = {}
+        self._tasks_lock = threading.Lock()
+        self._next_id = 0
+        self._shut = False
+
+        # The trampoline must outlive every submitted task — bind it to the
+        # instance so ctypes keeps the closure alive.
+        def _tramp(arg: int) -> None:
+            from ..runtime.threadpool import _worker_of
+            if getattr(_worker_of, "pool", None) is None and \
+                    self._lib.hpxrt_pool_in_worker(self._handle):
+                _worker_of.pool = self  # register for future work-helping
+            with self._tasks_lock:
+                task = self._tasks.pop(arg, None)
+            if task is None:
+                return
+            fn, args, kwargs = task
+            try:
+                fn(*args, **kwargs)
+            except BaseException:  # noqa: BLE001 — mirror Python pool
+                import traceback
+                traceback.print_exc()
+
+        self._tramp = _TASK_FN(_tramp)
+
+    @property
+    def num_threads(self) -> int:
+        return self._n
+
+    def submit(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
+        with self._tasks_lock:
+            tid = self._next_id
+            self._next_id += 1
+            self._tasks[tid] = (fn, args, kwargs)
+        self._lib.hpxrt_pool_submit(self._handle, self._tramp, tid)
+
+    def help_one(self) -> bool:
+        return bool(self._lib.hpxrt_pool_help_one(self._handle))
+
+    def in_worker(self) -> bool:
+        return bool(self._lib.hpxrt_pool_in_worker(self._handle))
+
+    def stats(self) -> dict:
+        return {
+            "executed": int(self._lib.hpxrt_pool_executed(self._handle)),
+            "stolen": int(self._lib.hpxrt_pool_stolen(self._handle)),
+            "pending": int(self._lib.hpxrt_pool_pending(self._handle)),
+            "threads": self._n,
+        }
+
+    def shutdown(self, wait: bool = True) -> None:
+        if not self._shut:
+            self._shut = True
+            self._lib.hpxrt_pool_shutdown(self._handle)
+
+    def __del__(self) -> None:  # best-effort; explicit shutdown preferred
+        try:
+            self.shutdown()
+        except Exception:
+            pass

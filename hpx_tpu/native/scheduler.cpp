@@ -1,0 +1,223 @@
+// Native runtime core: work-stealing task scheduler, monotonic timer,
+// atomic counters.
+//
+// Reference analog: libs/core/schedulers (local_priority_queue_scheduler /
+// abp work stealing) + libs/core/thread_pools (scheduled_thread_pool,
+// scheduling_loop) — re-designed for the TPU-native runtime where host
+// tasks are orchestration (graph building, XLA dispatch, IO callbacks)
+// rather than compute. Tasks enter as C function pointers; the Python
+// binding (hpx_tpu/native/loader.py) provides a trampoline that re-enters
+// the interpreter under the GIL.
+//
+// Scheduling discipline (same as the Python fallback pool, so the two are
+// interchangeable behind one interface):
+//   * per-worker deques; owner pops LIFO (hot cache), thieves steal FIFO
+//   * external submits round-robin across queues
+//   * idle workers park on a condition variable
+//   * help_one() lets any thread (incl. a worker blocked on a future)
+//     execute one queued task — the suspension/starvation-safety analog.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+typedef void (*hpxrt_task_fn)(void*);
+}
+
+namespace {
+
+struct Task {
+  hpxrt_task_fn fn;
+  void* arg;
+};
+
+struct Queue {
+  std::mutex m;
+  std::deque<Task> q;
+};
+
+struct Pool;
+thread_local Pool* tls_pool = nullptr;
+thread_local int tls_wid = -1;
+
+struct Pool {
+  std::vector<std::unique_ptr<Queue>> queues;
+  std::vector<std::thread> workers;
+  std::mutex cv_m;
+  std::condition_variable cv;
+  long pending = 0;  // guarded by cv_m
+  bool shutdown = false;
+  std::atomic<uint64_t> executed{0};
+  std::atomic<uint64_t> stolen{0};
+  std::atomic<unsigned> rr{0};
+
+  explicit Pool(int nthreads) {
+    queues.reserve(nthreads);
+    for (int i = 0; i < nthreads; ++i)
+      queues.emplace_back(std::make_unique<Queue>());
+    workers.reserve(nthreads);
+    for (int i = 0; i < nthreads; ++i)
+      workers.emplace_back([this, i] { worker(i); });
+  }
+
+  bool try_pop(int wid, Task* out) {
+    {
+      Queue& mine = *queues[wid];
+      std::lock_guard<std::mutex> lk(mine.m);
+      if (!mine.q.empty()) {
+        *out = mine.q.back();  // own queue: LIFO
+        mine.q.pop_back();
+        return true;
+      }
+    }
+    const int n = static_cast<int>(queues.size());
+    for (int off = 1; off < n; ++off) {
+      Queue& victim = *queues[(wid + off) % n];
+      std::lock_guard<std::mutex> lk(victim.m);
+      if (!victim.q.empty()) {
+        *out = victim.q.front();  // steal: FIFO
+        victim.q.pop_front();
+        stolen.fetch_add(1, std::memory_order_relaxed);
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void run_task(const Task& t) {
+    {
+      std::lock_guard<std::mutex> lk(cv_m);
+      --pending;
+    }
+    t.fn(t.arg);  // exceptions cannot cross the C boundary; the Python
+                  // trampoline captures them into futures
+    executed.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void worker(int wid) {
+    tls_pool = this;
+    tls_wid = wid;
+    for (;;) {
+      Task t;
+      if (try_pop(wid, &t)) {
+        run_task(t);
+        continue;
+      }
+      std::unique_lock<std::mutex> lk(cv_m);
+      cv.wait(lk, [this] { return pending > 0 || shutdown; });
+      if (shutdown && pending == 0) return;
+    }
+  }
+
+  void submit(hpxrt_task_fn fn, void* arg) {
+    int wid = (tls_pool == this && tls_wid >= 0)
+                  ? tls_wid
+                  : static_cast<int>(rr.fetch_add(1, std::memory_order_relaxed) %
+                                     queues.size());
+    {
+      Queue& q = *queues[wid];
+      std::lock_guard<std::mutex> lk(q.m);
+      q.q.push_back(Task{fn, arg});
+    }
+    {
+      std::lock_guard<std::mutex> lk(cv_m);
+      ++pending;
+    }
+    cv.notify_one();
+  }
+
+  int help_one() {
+    int wid = (tls_pool == this && tls_wid >= 0) ? tls_wid : 0;
+    Task t;
+    if (!try_pop(wid, &t)) return 0;
+    run_task(t);
+    return 1;
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(cv_m);
+      shutdown = true;
+    }
+    cv.notify_all();
+    for (auto& w : workers)
+      if (w.joinable() && w.get_id() != std::this_thread::get_id()) w.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hpxrt_pool_create(int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  return new Pool(nthreads);
+}
+
+void hpxrt_pool_submit(void* pool, hpxrt_task_fn fn, void* arg) {
+  static_cast<Pool*>(pool)->submit(fn, arg);
+}
+
+int hpxrt_pool_help_one(void* pool) {
+  return static_cast<Pool*>(pool)->help_one();
+}
+
+int hpxrt_pool_in_worker(void* pool) {
+  return tls_pool == static_cast<Pool*>(pool) && tls_wid >= 0;
+}
+
+void hpxrt_pool_shutdown(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  p->stop();
+  delete p;
+}
+
+uint64_t hpxrt_pool_executed(void* pool) {
+  return static_cast<Pool*>(pool)->executed.load(std::memory_order_relaxed);
+}
+
+uint64_t hpxrt_pool_stolen(void* pool) {
+  return static_cast<Pool*>(pool)->stolen.load(std::memory_order_relaxed);
+}
+
+long hpxrt_pool_pending(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lk(p->cv_m);
+  return p->pending;
+}
+
+// -- high-resolution timer (hpx::chrono::high_resolution_timer analog) -----
+
+uint64_t hpxrt_now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+// -- atomic counters (performance_counters raw-counter substrate) ----------
+
+void* hpxrt_counter_new() { return new std::atomic<int64_t>(0); }
+
+void hpxrt_counter_add(void* c, int64_t v) {
+  static_cast<std::atomic<int64_t>*>(c)->fetch_add(v,
+                                                   std::memory_order_relaxed);
+}
+
+int64_t hpxrt_counter_get(void* c) {
+  return static_cast<std::atomic<int64_t>*>(c)->load(
+      std::memory_order_relaxed);
+}
+
+void hpxrt_counter_free(void* c) {
+  delete static_cast<std::atomic<int64_t>*>(c);
+}
+
+}  // extern "C"

@@ -165,9 +165,9 @@ def default_pool() -> WorkStealingPool:
     if _default_pool is None:
         with _default_lock:
             if _default_pool is None:
-                from ..core.config import Configuration
+                from ..core.config import runtime_config
                 _default_pool = WorkStealingPool(
-                    Configuration(environ=os.environ).os_threads(), "default")
+                    runtime_config().os_threads(), "default")
     return _default_pool
 
 

@@ -10,7 +10,8 @@ Env vars MUST be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force, don't setdefault: the sandbox pre-sets JAX_PLATFORMS=axon (TPU)
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
@@ -20,6 +21,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# The sandbox's sitecustomize forces jax_platforms to "axon,cpu" (TPU
+# first) regardless of the env var; override it before any device query.
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")

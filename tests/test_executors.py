@@ -1,0 +1,196 @@
+"""Executor & execution-policy tests.
+
+Reference analog: libs/core/executors/tests/unit (minimal_async_executor,
+fork_join_executor, executor_parameters) and libs/core/async_cuda tests
+(cuda_executor future completion) — here against TpuExecutor on the CPU
+mesh backend.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.exec.policies import par, seq
+from hpx_tpu.native.loader import NativePool, native_lib
+
+
+def test_sequenced_executor_inline():
+    ex = hpx.SequencedExecutor()
+    order = []
+    ex.post(order.append, 1)
+    order.append(2)
+    assert order == [1, 2]
+    assert ex.async_execute(lambda: 5).get() == 5
+
+
+def test_parallel_executor_async():
+    ex = hpx.ParallelExecutor()
+    assert ex.async_execute(lambda a, b: a + b, 2, 3).get(timeout=5.0) == 5
+
+
+def test_thread_pool_executor_private_pool():
+    ex = hpx.ThreadPoolExecutor(num_threads=2)
+    try:
+        fs = [ex.async_execute(lambda i=i: i * i) for i in range(20)]
+        assert sorted(f.get(timeout=5.0) for f in fs) == sorted(
+            i * i for i in range(20))
+    finally:
+        ex.shutdown()
+
+
+def test_bulk_async_execute():
+    ex = hpx.ParallelExecutor()
+    futs = ex.bulk_async_execute(lambda i: i + 100, range(8))
+    assert [f.get(timeout=5.0) for f in futs] == [100 + i for i in range(8)]
+
+
+def test_then_execute():
+    ex = hpx.ParallelExecutor()
+    f = hpx.make_ready_future(10)
+    g = ex.then_execute(lambda fut: fut.get() * 3, f)
+    assert g.get(timeout=5.0) == 30
+
+
+def test_fork_join_bulk_sync():
+    ex = hpx.ForkJoinExecutor(num_threads=2)
+    try:
+        out = ex.bulk_sync_execute(lambda i: i * 2, list(range(16)))
+        assert out == [i * 2 for i in range(16)]
+    finally:
+        ex.shutdown()
+
+
+def test_fork_join_propagates_exception():
+    ex = hpx.ForkJoinExecutor(num_threads=2)
+    try:
+        def bad(i):
+            if i == 3:
+                raise ValueError("bulk failure")
+            return i
+        with pytest.raises(ValueError, match="bulk failure"):
+            ex.bulk_sync_execute(bad, list(range(8)))
+    finally:
+        ex.shutdown()
+
+
+# -- policies ---------------------------------------------------------------
+
+def test_policy_rebinding():
+    ex = hpx.SequencedExecutor()
+    p = par.on(ex)
+    assert p.get_executor() is ex
+    assert par.get_executor() is not ex          # original unchanged
+    pt = par.task
+    assert pt.is_task and not par.is_task
+    pc = par.with_(hpx.static_chunk_size(4))
+    assert pc.chunking.size == 4
+
+
+def test_policy_with_unknown_param_raises():
+    from hpx_tpu.core.errors import BadParameter
+    with pytest.raises(BadParameter):
+        par.with_(object())
+
+
+def test_chunk_size_params():
+    assert hpx.static_chunk_size(4).chunks(10, 2) == [4, 4, 2]
+    assert sum(hpx.auto_chunk_size().chunks(1000, 4)) == 1000
+    assert hpx.dynamic_chunk_size(3).chunks(7, 2) == [3, 3, 1]
+    g = hpx.guided_chunk_size(1).chunks(100, 2)
+    assert sum(g) == 100 and g[0] >= g[-1]
+    assert hpx.static_chunk_size().chunks(0, 4) == []
+
+
+# -- native pool ------------------------------------------------------------
+
+def test_native_lib_builds_and_pools_work():
+    assert native_lib() is not None, "native runtime must build in CI"
+    p = NativePool(2)
+    try:
+        ev = threading.Event()
+        out = []
+        for i in range(50):
+            p.submit(out.append, i)
+        p.submit(ev.set)
+        assert ev.wait(5.0)
+        # drain: helpers may still be finishing appends
+        deadline = threading.Event()
+        for _ in range(100):
+            if len(out) == 50:
+                break
+            deadline.wait(0.01)
+        assert sorted(out) == list(range(50))
+        st = p.stats()
+        assert st["executed"] >= 51 and st["threads"] == 2
+    finally:
+        p.shutdown()
+
+
+def test_native_pool_help_one_from_external_thread():
+    p = NativePool(1)
+    try:
+        hits = []
+        block = threading.Event()
+        p.submit(block.wait, 5.0)       # occupy the single worker
+        p.submit(hits.append, 1)
+        assert p.help_one()              # external thread runs the task
+        assert hits == [1]
+        block.set()
+    finally:
+        p.shutdown()
+
+
+# -- tpu executor (CPU backend in tests; same code path on device) ----------
+
+def test_tpu_targets():
+    ts = hpx.get_targets()
+    assert len(ts) == 8                  # virtual CPU mesh
+    assert hpx.default_target() is ts[0]
+    ts[0].synchronize()
+
+
+def test_tpu_executor_async_execute():
+    ex = hpx.TpuExecutor()
+    x = jnp.arange(8, dtype=jnp.float32)
+    f = ex.async_execute(lambda a: a * 2.0, x)
+    assert f.is_ready()                  # eager mode
+    np.testing.assert_allclose(np.asarray(f.get()), np.arange(8) * 2.0)
+
+
+def test_tpu_executor_watched_mode():
+    ex = hpx.TpuExecutor(eager=False)
+    x = jnp.ones((16,), jnp.float32)
+    f = ex.async_execute(lambda a: a + 1.0, x)
+    v = f.get(timeout=30.0)
+    np.testing.assert_allclose(np.asarray(v), np.full(16, 2.0))
+
+
+def test_tpu_executor_compile_error_becomes_future_exception():
+    ex = hpx.TpuExecutor()
+    def bad(a):
+        raise TypeError("not traceable")
+    f = ex.async_execute(bad, jnp.zeros(4))
+    assert f.has_exception()
+    with pytest.raises(TypeError):
+        f.get()
+
+
+def test_tpu_executor_then_execute_chains_device_ops():
+    ex = hpx.TpuExecutor()
+    f = ex.async_execute(lambda a: a + 1.0, jnp.zeros(4, jnp.float32))
+    g = ex.then_execute(lambda v: v * 10.0, f)
+    np.testing.assert_allclose(np.asarray(g.get(timeout=30.0)),
+                               np.full(4, 10.0))
+
+
+def test_get_future_on_raw_value():
+    f = hpx.get_future(jnp.arange(4))
+    assert f.get(timeout=30.0).shape == (4,)
+
+
+def test_policy_on_tpu_executor_roundtrip():
+    p = par.on(hpx.TpuExecutor())
+    assert isinstance(p.get_executor(), hpx.TpuExecutor)
