@@ -51,6 +51,17 @@ from .exec import (  # noqa: F401
 # `hpx::execution::par.on(tpu_executor{})`)
 tpu_executor = TpuExecutor
 
-# Populated as milestones land (SURVEY.md §7): algorithms (M3),
-# runtime/localities (M5), containers + segmented algorithms (M6),
-# collectives (M7), services (M9).
+# -- parallel algorithms (M3) ------------------------------------------------
+from .algo import (  # noqa: F401
+    for_each, for_each_n, for_loop, transform, copy, copy_n, copy_if,
+    fill, fill_n, generate, generate_n,
+    reduce, transform_reduce, count, count_if,
+    all_of, any_of, none_of, min_element, max_element, minmax_element,
+    equal, mismatch, find, find_if,
+    inclusive_scan, exclusive_scan, transform_inclusive_scan,
+    transform_exclusive_scan, adjacent_difference, adjacent_find,
+    sort, stable_sort, is_sorted, merge, reverse, rotate, unique, partition,
+)
+
+# Populated as milestones land (SURVEY.md §7): runtime/localities (M5),
+# containers + segmented algorithms (M6), collectives (M7), services (M9).

@@ -1,0 +1,55 @@
+"""Parallel algorithms (local surface).
+
+Reference analog: libs/core/algorithms — the CPO set over execution
+policies. Segmented (distributed) overlays dispatch from the same entry
+points once containers are partitioned (M6, libs/full/segmented_algorithms
+analog).
+"""
+
+from .elementwise import (  # noqa: F401
+    copy,
+    copy_if,
+    copy_n,
+    fill,
+    fill_n,
+    for_each,
+    for_each_n,
+    for_loop,
+    generate,
+    generate_n,
+    transform,
+)
+from .reductions import (  # noqa: F401
+    all_of,
+    any_of,
+    count,
+    count_if,
+    equal,
+    find,
+    find_if,
+    max_element,
+    min_element,
+    minmax_element,
+    mismatch,
+    none_of,
+    reduce,
+    transform_reduce,
+)
+from .scans import (  # noqa: F401
+    adjacent_difference,
+    adjacent_find,
+    exclusive_scan,
+    inclusive_scan,
+    transform_exclusive_scan,
+    transform_inclusive_scan,
+)
+from .sorting import (  # noqa: F401
+    is_sorted,
+    merge,
+    partition,
+    reverse,
+    rotate,
+    sort,
+    stable_sort,
+    unique,
+)

@@ -1,0 +1,109 @@
+"""Algorithm dispatch machinery.
+
+Reference analog: libs/core/algorithms' tag_invoke CPO dispatch +
+partitioner/chunking utilities (hpx/parallel/util/detail/chunk_size.hpp,
+foreach_partitioner.hpp). Structure kept deliberately (SURVEY.md §3.3):
+
+    algorithm(policy, range, ...)            (CPO)
+      -> route by policy/range:
+           device  : one fused jit kernel (TpuExecutor / jax arrays)
+           host    : chunk -> bulk_async_execute -> combine
+           segmented (M6): per-segment dispatch via shard_map
+
+so `par.on(tpu_executor())` reroutes a whole algorithm with no user-facing
+change. Everything below the partitioner collapses into one XLA program on
+the device path — chunking is the compiler's job there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ..exec.params import AutoChunkSize
+from ..exec.policies import ExecutionPolicy, seq as seq_policy
+from ..exec.tpu import TpuExecutor
+from ..futures.future import Future, make_ready_future
+
+
+def is_jax_array(x: Any) -> bool:
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def is_device_policy(policy: ExecutionPolicy, *ranges: Any) -> bool:
+    """Device path when bound to a TpuExecutor, or when operating on jax
+    arrays under a parallel/vectorizing policy with no explicit host
+    executor (jax data wants jax execution)."""
+    if isinstance(policy.executor, TpuExecutor):
+        return True
+    if policy.executor is not None:
+        return False
+    if (policy.parallel or policy.vectorize) and ranges and \
+            all(is_jax_array(r) for r in ranges if r is not None):
+        return True
+    return False
+
+
+def device_executor(policy: ExecutionPolicy) -> TpuExecutor:
+    if isinstance(policy.executor, TpuExecutor):
+        return policy.executor
+    return _shared_tpu_executor()
+
+
+_tpu_exec: Optional[TpuExecutor] = None
+
+
+def _shared_tpu_executor() -> TpuExecutor:
+    global _tpu_exec
+    if _tpu_exec is None:
+        _tpu_exec = TpuExecutor()
+    return _tpu_exec
+
+
+def finish(policy: ExecutionPolicy, value_fn: Callable[[], Any]) -> Any:
+    """Respect the task policy: value, or future of value.
+
+    value_fn is deferred so task-policy callers get true asynchrony on the
+    host path (the device path is async regardless — dispatch is async).
+    """
+    if policy.is_task:
+        from ..futures.async_ import async_
+        return async_(value_fn)
+    return value_fn()
+
+
+def chunk_bounds(count: int, policy: ExecutionPolicy,
+                 num_workers: int) -> List[Tuple[int, int]]:
+    """[(begin, end)) chunks per the policy's chunking parameter."""
+    chunking = policy.chunking or AutoChunkSize()
+    if policy.cores:
+        num_workers = min(num_workers, policy.cores)
+    sizes = chunking.chunks(count, max(1, num_workers))
+    out = []
+    pos = 0
+    for s in sizes:
+        out.append((pos, pos + s))
+        pos += s
+    return out
+
+
+def host_bulk(policy: ExecutionPolicy, count: int,
+              chunk_fn: Callable[[int, int], Any]) -> List[Any]:
+    """Run chunk_fn over chunk bounds on the policy's executor; ordered
+    results. Sequential policies run inline (no task overhead)."""
+    ex = policy.get_executor()
+    if not policy.parallel or count == 0:
+        return [chunk_fn(0, count)] if count else []
+    bounds = chunk_bounds(count, policy, ex.num_workers)
+    if len(bounds) <= 1:
+        return [chunk_fn(0, count)]
+    futs = [ex.async_execute(chunk_fn, b, e) for (b, e) in bounds]
+    return [f.get() for f in futs]
+
+
+def to_numpy_view(rng: Any):
+    """Host path works on numpy views (zero-copy for arrays/lists copy)."""
+    import numpy as np
+    if isinstance(rng, np.ndarray):
+        return rng
+    return np.asarray(rng)

@@ -1,0 +1,231 @@
+"""Elementwise parallel algorithms: for_each, transform, copy, fill,
+generate, for_loop.
+
+Reference analog: libs/core/algorithms include/hpx/parallel/algorithms/
+{for_each,transform,copy,fill,generate,for_loop}.hpp.
+
+Semantics note (TPU-first, documented divergence): HPX mutates ranges
+through iterators; jax arrays are immutable, so every algorithm RETURNS
+its result range. On the host path over numpy arrays the operation is
+also applied in place where HPX would (for_each, fill), and the range is
+returned as well so call sites are uniform across paths.
+
+Device lowering: the user's elementwise callable is vmapped over the
+flattened range and the whole algorithm becomes ONE jitted XLA program
+(the per-chunk loop_n of HPX collapses into the kernel — SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..exec.policies import ExecutionPolicy, seq
+from ._core import (
+    device_executor,
+    finish,
+    host_bulk,
+    is_device_policy,
+    to_numpy_view,
+)
+
+
+def _vmapped(f: Callable) -> Callable:
+    import jax
+
+    def kernel(*arrs):
+        flat = [a.reshape(-1) for a in arrs]
+        out = jax.vmap(f)(*flat)
+        return out.reshape(arrs[0].shape)
+
+    return kernel
+
+
+def for_each(policy: ExecutionPolicy, rng: Any,
+             f: Callable[[Any], Any]) -> Any:
+    """Apply f to each element. Returns the (new) range.
+
+    Device path: f must be jax-traceable elementwise; result is f applied
+    elementwise (HPX's mutate-in-place becomes pure transform — for_each
+    and transform coincide on immutable arrays).
+    """
+    if is_device_policy(policy, rng):
+        ex = device_executor(policy)
+        fut = ex.async_execute(_vmapped(f), rng)
+        return fut if policy.is_task else fut.get()
+
+    arr = to_numpy_view(rng)
+
+    def chunk(b: int, e: int) -> None:
+        for i in range(b, e):
+            r = f(arr[i])
+            if r is not None:       # allow mutating or transforming style
+                arr[i] = r
+
+    def run():
+        host_bulk(policy, len(arr), chunk)
+        return arr
+
+    return finish(policy, run)
+
+
+def for_each_n(policy: ExecutionPolicy, rng: Any, n: int,
+               f: Callable[[Any], Any]) -> Any:
+    return for_each(policy, rng[:n], f)
+
+
+def transform(policy: ExecutionPolicy, rng: Any, f: Callable,
+              rng2: Optional[Any] = None) -> Any:
+    """Unary transform(policy, a, f) or binary transform(policy, a, f, b)."""
+    if is_device_policy(policy, rng, rng2):
+        ex = device_executor(policy)
+        if rng2 is None:
+            fut = ex.async_execute(_vmapped(f), rng)
+        else:
+            fut = ex.async_execute(_vmapped(f), rng, rng2)
+        return fut if policy.is_task else fut.get()
+
+    import numpy as np
+    a = to_numpy_view(rng)
+    if rng2 is not None:
+        b = to_numpy_view(rng2)
+        out = np.empty(len(a), dtype=np.result_type(a, b))
+
+        def chunk(lo: int, hi: int) -> None:
+            for i in range(lo, hi):
+                out[i] = f(a[i], b[i])
+    else:
+        out = np.empty(len(a), dtype=a.dtype)
+
+        def chunk(lo: int, hi: int) -> None:
+            for i in range(lo, hi):
+                out[i] = f(a[i])
+
+    def run():
+        host_bulk(policy, len(a), chunk)
+        return out
+
+    return finish(policy, run)
+
+
+def copy(policy: ExecutionPolicy, rng: Any) -> Any:
+    """Returns a copy of the range (copy-to-destination flattened into a
+    functional return, matching the jax data model)."""
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(jnp.copy, rng)  # dtype-preserving copy
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+    return finish(policy, lambda: arr.copy())
+
+
+def copy_n(policy: ExecutionPolicy, rng: Any, n: int) -> Any:
+    return copy(policy, rng[:n])
+
+
+def copy_if(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    """Keep elements satisfying pred. Device note: output size is data-
+    dependent — the device path computes the mask on device and compacts
+    on host boundary (XLA needs static shapes)."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        mask_f = ex.async_execute(
+            lambda a: jax.vmap(pred)(a.reshape(-1)), rng)
+
+        def run():
+            import numpy as np
+            mask = np.asarray(mask_f.get())
+            flat = np.asarray(rng).reshape(-1)
+            return jnp.asarray(flat[mask])
+        return finish(policy, run)
+
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        mask_parts = host_bulk(
+            policy, len(arr),
+            lambda b, e: [bool(pred(arr[i])) for i in range(b, e)])
+        mask = np.array([m for part in mask_parts for m in part], dtype=bool)
+        return arr[mask]
+
+    return finish(policy, run)
+
+
+def fill(policy: ExecutionPolicy, rng: Any, value: Any) -> Any:
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(lambda a: jnp.full_like(a, value), rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def run():
+        host_bulk(policy, len(arr),
+                  lambda b, e: arr.__setitem__(slice(b, e), value))
+        return arr
+
+    return finish(policy, run)
+
+
+def fill_n(policy: ExecutionPolicy, rng: Any, n: int, value: Any) -> Any:
+    return fill(policy, rng[:n], value)
+
+
+def generate(policy: ExecutionPolicy, rng: Any, gen: Callable[[], Any]) -> Any:
+    """generate fills with gen() per element. Device path: gen must be a
+    traceable index-free thunk; generation order is unspecified (as in
+    par/par_unseq HPX)."""
+    if is_device_policy(policy, rng):
+        import jax
+        ex = device_executor(policy)
+        fut = ex.async_execute(
+            lambda a: jax.vmap(lambda _: gen())(a.reshape(-1)).reshape(a.shape),
+            rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def chunk(b: int, e: int) -> None:
+        for i in range(b, e):
+            arr[i] = gen()
+
+    def run():
+        host_bulk(policy, len(arr), chunk)
+        return arr
+
+    return finish(policy, run)
+
+
+def generate_n(policy: ExecutionPolicy, rng: Any, n: int, gen: Callable) -> Any:
+    return generate(policy, rng[:n], gen)
+
+
+def for_loop(policy: ExecutionPolicy, first: int, last: int,
+             body: Callable[[int], Any]) -> Any:
+    """hpx::experimental::for_loop(policy, first, last, body) — an indexed
+    loop. Contract on BOTH paths: returns the array/list of body(i)
+    results (the device path is pure, so results are its only output; the
+    host path collects for parity — returns None only if every body call
+    returned None, i.e. a pure side-effect loop)."""
+    count = max(0, last - first)
+    if is_device_policy(policy):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        idx = jnp.arange(first, last)
+        fut = ex.async_execute(lambda ix: jax.vmap(body)(ix), idx)
+        return fut if policy.is_task else fut.get()
+
+    def chunk(b: int, e: int) -> list:
+        return [body(first + i) for i in range(b, e)]
+
+    def run():
+        parts = host_bulk(policy, count, chunk)
+        results = [r for part in parts for r in part]
+        if all(r is None for r in results):
+            return None
+        return results
+
+    return finish(policy, run)

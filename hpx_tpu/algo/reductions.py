@@ -1,0 +1,310 @@
+"""Reductions and searches: reduce, transform_reduce, count, any/all/none,
+min/max/minmax element values, equal, mismatch, find.
+
+Reference analog: libs/core/algorithms include/hpx/parallel/algorithms/
+{reduce,transform_reduce,count,all_any_none,minmax,equal,mismatch,find}.hpp.
+
+Device lowering: reduction with an arbitrary traceable binary op uses
+jax.lax.reduce in ONE jitted program; transform_reduce fuses map+reduce —
+this is the config #1 (SAXPY+dot) path where XLA fuses the multiply into
+the reduction and the MXU/VPU stream the whole range from HBM once.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Optional
+
+from ..exec.policies import ExecutionPolicy
+from ._core import (
+    device_executor,
+    finish,
+    host_bulk,
+    is_device_policy,
+    to_numpy_view,
+)
+
+
+import operator as _op
+
+# Fast paths with known identities; lax.reduce would use `init` as the
+# per-tile identity, which silently corrupts results for non-identity
+# inits, so the general path folds via associative_scan (identity-free)
+# and applies init exactly once.
+_KNOWN_FOLDS = {}
+
+
+def _known_folds():
+    if not _KNOWN_FOLDS:
+        import jax.numpy as jnp
+        _KNOWN_FOLDS.update({
+            _op.add: jnp.sum, _op.mul: jnp.prod,
+            min: jnp.min, max: jnp.max,
+        })
+    return _KNOWN_FOLDS
+
+
+def _device_reduce_kernel(op: Callable, init: Any):
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(a):
+        flat = a.reshape(-1)
+        fold = _known_folds().get(op)
+        if fold is not None:
+            total = fold(flat)
+        else:
+            # associative fold without an identity requirement
+            total = jax.lax.associative_scan(jax.vmap(op), flat)[-1]
+        return op(jnp.asarray(init, flat.dtype), total)
+
+    return kernel
+
+
+def reduce(policy: ExecutionPolicy, rng: Any, init: Any = 0,
+           op: Callable = operator.add) -> Any:
+    if is_device_policy(policy, rng):
+        ex = device_executor(policy)
+        fut = ex.async_execute(_device_reduce_kernel(op, init), rng)
+        return fut if policy.is_task else fut.get()
+
+    arr = to_numpy_view(rng)
+
+    def chunk(b: int, e: int) -> Any:
+        acc = None
+        for i in range(b, e):
+            acc = arr[i] if acc is None else op(acc, arr[i])
+        return acc
+
+    def run():
+        partials = [p for p in host_bulk(policy, len(arr), chunk)
+                    if p is not None]
+        acc = init
+        for p in partials:
+            acc = op(acc, p)
+        return acc
+
+    return finish(policy, run)
+
+
+def transform_reduce(policy: ExecutionPolicy, rng: Any, init: Any,
+                     reduce_op: Callable, transform_op: Callable,
+                     rng2: Optional[Any] = None) -> Any:
+    """transform_reduce(policy, a, init, plus, f) or the binary
+    (inner-product) form transform_reduce(policy, a, b, init, plus, mul)
+    spelled transform_reduce(policy, a, init, plus, mul, rng2=b)."""
+    if is_device_policy(policy, rng, rng2):
+        import jax
+        ex = device_executor(policy)
+
+        if rng2 is None:
+            def kernel(a):
+                mapped = jax.vmap(transform_op)(a.reshape(-1))
+                return _device_reduce_kernel(reduce_op, init)(mapped)
+            fut = ex.async_execute(kernel, rng)
+        else:
+            def kernel2(a, b):
+                mapped = jax.vmap(transform_op)(a.reshape(-1), b.reshape(-1))
+                return _device_reduce_kernel(reduce_op, init)(mapped)
+            fut = ex.async_execute(kernel2, rng, rng2)
+        return fut if policy.is_task else fut.get()
+
+    a = to_numpy_view(rng)
+    b = to_numpy_view(rng2) if rng2 is not None else None
+
+    def chunk(lo: int, hi: int) -> Any:
+        acc = None
+        for i in range(lo, hi):
+            v = transform_op(a[i]) if b is None else transform_op(a[i], b[i])
+            acc = v if acc is None else reduce_op(acc, v)
+        return acc
+
+    def run():
+        partials = [p for p in host_bulk(policy, len(a), chunk)
+                    if p is not None]
+        acc = init
+        for p in partials:
+            acc = reduce_op(acc, p)
+        return acc
+
+    return finish(policy, run)
+
+
+def count(policy: ExecutionPolicy, rng: Any, value: Any) -> Any:
+    return count_if(policy, rng, lambda x: x == value)
+
+
+def count_if(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(
+            lambda a: jax.vmap(pred)(a.reshape(-1)).sum(dtype=jnp.int32), rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def chunk(b: int, e: int) -> int:
+        return sum(1 for i in range(b, e) if pred(arr[i]))
+
+    return finish(policy,
+                  lambda: sum(host_bulk(policy, len(arr), chunk)))
+
+
+def _bool_query(policy: ExecutionPolicy, rng: Any, pred: Callable,
+                combine: str) -> Any:
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            m = jax.vmap(pred)(a.reshape(-1))
+            return jnp.all(m) if combine == "all" else jnp.any(m)
+        fut = ex.async_execute(kernel, rng)
+        if policy.is_task:
+            return fut.then(lambda f: bool(f.get()))
+        return bool(fut.get())
+    arr = to_numpy_view(rng)
+
+    def chunk(b: int, e: int) -> bool:
+        it = (bool(pred(arr[i])) for i in range(b, e))
+        return all(it) if combine == "all" else any(it)
+
+    def run():
+        parts = host_bulk(policy, len(arr), chunk)
+        return all(parts) if combine == "all" else any(parts)
+
+    return finish(policy, run)
+
+
+def all_of(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    return _bool_query(policy, rng, pred, "all")
+
+
+def any_of(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    return _bool_query(policy, rng, pred, "any")
+
+
+def none_of(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    r = any_of(policy, rng, pred)
+    from ..futures.future import Future
+    if isinstance(r, Future):
+        return r.then(lambda f: not f.get())
+    return not r
+
+
+def min_element(policy: ExecutionPolicy, rng: Any) -> Any:
+    return _minmax(policy, rng, "min")
+
+
+def max_element(policy: ExecutionPolicy, rng: Any) -> Any:
+    return _minmax(policy, rng, "max")
+
+
+def minmax_element(policy: ExecutionPolicy, rng: Any) -> Any:
+    return _minmax(policy, rng, "minmax")
+
+
+def _minmax(policy: ExecutionPolicy, rng: Any, which: str) -> Any:
+    """Returns the min/max VALUE (HPX returns iterators; values are the
+    range-functional equivalent). minmax returns a (min, max) pair."""
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        if which == "min":
+            fut = ex.async_execute(lambda a: a.min(), rng)
+        elif which == "max":
+            fut = ex.async_execute(lambda a: a.max(), rng)
+        else:
+            fut = ex.async_execute(
+                lambda a: jnp.stack([a.min(), a.max()]), rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        if which == "min":
+            return arr.min()
+        if which == "max":
+            return arr.max()
+        return (arr.min(), arr.max())
+
+    return finish(policy, run)
+
+
+def equal(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(lambda a, b: jnp.array_equal(a, b), rng, rng2)
+        if policy.is_task:
+            return fut.then(lambda f: bool(f.get()))
+        return bool(fut.get())
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        return bool(np.array_equal(a, b))
+
+    return finish(policy, run)
+
+
+def mismatch(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Index of first mismatch, or -1 (iterator-pair analog)."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a, b):
+            neq = (a.reshape(-1) != b.reshape(-1))
+            any_neq = neq.any()
+            idx = jnp.argmax(neq)
+            return jnp.where(any_neq, idx, -1)
+        fut = ex.async_execute(kernel, rng, rng2)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        neq = np.flatnonzero(a != b)
+        return int(neq[0]) if neq.size else -1
+
+    return finish(policy, run)
+
+
+def find(policy: ExecutionPolicy, rng: Any, value: Any) -> Any:
+    return find_if(policy, rng, lambda x: x == value)
+
+
+def find_if(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    """Index of first match, or -1."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            m = jax.vmap(pred)(a.reshape(-1))
+            return jnp.where(m.any(), jnp.argmax(m), -1)
+        fut = ex.async_execute(kernel, rng)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    arr = to_numpy_view(rng)
+
+    def chunk(b: int, e: int) -> int:
+        for i in range(b, e):
+            if pred(arr[i]):
+                return i
+        return -1
+
+    def run():
+        for idx in host_bulk(policy, len(arr), chunk):
+            if idx != -1:
+                return idx
+        return -1
+
+    return finish(policy, run)

@@ -1,0 +1,166 @@
+"""Scans and adjacent ops: inclusive_scan, exclusive_scan, transform
+variants, adjacent_difference, adjacent_find.
+
+Reference analog: libs/core/algorithms include/hpx/parallel/algorithms/
+{inclusive_scan,exclusive_scan,transform_inclusive_scan,
+transform_exclusive_scan,adjacent_difference,adjacent_find}.hpp and the
+scan_partitioner (3-phase chunked scan) in parallel/util.
+
+Device lowering: jax.lax.associative_scan — the parallel scan is exactly
+what the scan_partitioner approximates on CPUs, but compiled; arbitrary
+associative traceable ops supported.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Optional
+
+from ..exec.policies import ExecutionPolicy
+from ._core import (
+    device_executor,
+    finish,
+    is_device_policy,
+    to_numpy_view,
+)
+
+
+def _host_scan(arr, init, op, inclusive: bool, transform=None):
+    import numpy as np
+    if transform is None:
+        out = np.empty_like(arr)
+        first = arr[0] if len(arr) else None
+    else:
+        # transform element 0 once: dtype probe AND iteration value
+        first = transform(arr[0]) if len(arr) else None
+        out = np.empty(len(arr),
+                       dtype=np.result_type(np.asarray(first))
+                       if len(arr) else float)
+    acc = init
+    for i in range(len(arr)):
+        v = first if i == 0 else (
+            arr[i] if transform is None else transform(arr[i]))
+        if inclusive:
+            acc = op(acc, v)
+            out[i] = acc
+        else:
+            out[i] = acc
+            acc = op(acc, v)
+    return out
+
+
+def inclusive_scan(policy: ExecutionPolicy, rng: Any, init: Any = 0,
+                   op: Callable = operator.add) -> Any:
+    return transform_inclusive_scan(policy, rng, init, op, None)
+
+
+def exclusive_scan(policy: ExecutionPolicy, rng: Any, init: Any = 0,
+                   op: Callable = operator.add) -> Any:
+    return transform_exclusive_scan(policy, rng, init, op, None)
+
+
+def transform_inclusive_scan(policy: ExecutionPolicy, rng: Any, init: Any,
+                             op: Callable,
+                             transform: Optional[Callable]) -> Any:
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            flat = a.reshape(-1)
+            if transform is not None:
+                flat = jax.vmap(transform)(flat)
+            scanned = jax.lax.associative_scan(jax.vmap(op), flat)
+            # init is combined exactly once per prefix (not assumed to be
+            # the op's identity): out[i] = op(init, fold(a[0..i]))
+            init_a = jnp.asarray(init, flat.dtype)
+            return jax.vmap(lambda x: op(init_a, x))(scanned)
+        fut = ex.async_execute(kernel, rng)
+        return fut if policy.is_task else fut.get()
+
+    arr = to_numpy_view(rng)
+    return finish(policy,
+                  lambda: _host_scan(arr, init, op, True, transform))
+
+
+def transform_exclusive_scan(policy: ExecutionPolicy, rng: Any, init: Any,
+                             op: Callable,
+                             transform: Optional[Callable]) -> Any:
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            flat = a.reshape(-1)
+            if transform is not None:
+                flat = jax.vmap(transform)(flat)
+            scanned = jax.lax.associative_scan(jax.vmap(op), flat)
+            init_a = jnp.asarray(init, flat.dtype)
+            # exclusive: out[0]=init, out[i]=op(init, fold(a[0..i-1])) —
+            # init is NOT assumed to be the op's identity
+            combined = jax.vmap(lambda x: op(init_a, x))(scanned[:-1])
+            return jnp.concatenate([init_a[None], combined])
+        fut = ex.async_execute(kernel, rng)
+        return fut if policy.is_task else fut.get()
+
+    arr = to_numpy_view(rng)
+    return finish(policy,
+                  lambda: _host_scan(arr, init, op, False, transform))
+
+
+def adjacent_difference(policy: ExecutionPolicy, rng: Any,
+                        op: Callable = operator.sub) -> Any:
+    """out[0]=a[0]; out[i]=op(a[i], a[i-1]) (std semantics)."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            flat = a.reshape(-1)
+            diffs = jax.vmap(op)(flat[1:], flat[:-1])
+            return jnp.concatenate([flat[:1], diffs])
+        fut = ex.async_execute(kernel, rng)
+        return fut if policy.is_task else fut.get()
+
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        out = np.empty_like(arr)
+        if len(arr):
+            out[0] = arr[0]
+            for i in range(1, len(arr)):
+                out[i] = op(arr[i], arr[i - 1])
+        return out
+
+    return finish(policy, run)
+
+
+def adjacent_find(policy: ExecutionPolicy, rng: Any,
+                  pred: Callable = operator.eq) -> Any:
+    """Index of first i with pred(a[i], a[i+1]), or -1."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            flat = a.reshape(-1)
+            m = jax.vmap(pred)(flat[:-1], flat[1:])
+            return jnp.where(m.any(), jnp.argmax(m), -1)
+        fut = ex.async_execute(kernel, rng)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    arr = to_numpy_view(rng)
+
+    def run():
+        for i in range(len(arr) - 1):
+            if pred(arr[i], arr[i + 1]):
+                return i
+        return -1
+
+    return finish(policy, run)

@@ -1,0 +1,175 @@
+"""Sorting and order ops: sort, stable_sort, is_sorted, merge, rotate,
+reverse, unique, partition.
+
+Reference analog: libs/core/algorithms include/hpx/parallel/algorithms/
+{sort,is_sorted,merge,rotate,reverse,unique,partition}.hpp (parallel
+quicksort/merge). Device lowering: XLA's sort (bitonic-style network) via
+jnp.sort/argsort — the compiler's sort IS the parallel sort.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Optional
+
+from ..exec.policies import ExecutionPolicy
+from ._core import (
+    device_executor,
+    finish,
+    is_device_policy,
+    to_numpy_view,
+)
+
+
+def sort(policy: ExecutionPolicy, rng: Any,
+         key: Optional[Callable] = None) -> Any:
+    """Returns the sorted range. `key` maps elements to sort keys
+    (HPX's comparator generalized to the key form jax supports)."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            flat = a.reshape(-1)
+            if key is None:
+                return jnp.sort(flat)
+            ks = jax.vmap(key)(flat)
+            return flat[jnp.argsort(ks, stable=True)]
+        fut = ex.async_execute(kernel, rng)
+        return fut if policy.is_task else fut.get()
+
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        if key is None:
+            return np.sort(arr, kind="stable")
+        ks = np.array([key(x) for x in arr])
+        return arr[np.argsort(ks, kind="stable")]
+
+    return finish(policy, run)
+
+
+stable_sort = sort  # device sort with stable argsort; numpy kind="stable"
+
+
+def is_sorted(policy: ExecutionPolicy, rng: Any) -> Any:
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(
+            lambda a: (a.reshape(-1)[1:] >= a.reshape(-1)[:-1]).all(), rng)
+        if policy.is_task:
+            return fut.then(lambda f: bool(f.get()))
+        return bool(fut.get())
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        return bool(np.all(arr[1:] >= arr[:-1]))
+
+    return finish(policy, run)
+
+
+def merge(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Merge two sorted ranges into one sorted range."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(
+            lambda a, b: jnp.sort(jnp.concatenate(
+                [a.reshape(-1), b.reshape(-1)])), rng, rng2)
+        return fut if policy.is_task else fut.get()
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        return np.sort(np.concatenate([a, b]), kind="stable")
+
+    return finish(policy, run)
+
+
+def reverse(policy: ExecutionPolicy, rng: Any) -> Any:
+    if is_device_policy(policy, rng):
+        ex = device_executor(policy)
+        fut = ex.async_execute(lambda a: a[::-1], rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+    return finish(policy, lambda: arr[::-1].copy())
+
+
+def rotate(policy: ExecutionPolicy, rng: Any, middle: int) -> Any:
+    """Left-rotate so that rng[middle] becomes the first element."""
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(lambda a: jnp.roll(a, -middle), rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        return np.roll(arr, -middle)
+
+    return finish(policy, run)
+
+
+def unique(policy: ExecutionPolicy, rng: Any) -> Any:
+    """Remove consecutive duplicates (std::unique semantics, shrunk).
+
+    Output size is data-dependent: device path computes the keep-mask on
+    device and compacts at the host boundary (static shapes under jit)."""
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        mask_fut = ex.async_execute(
+            lambda a: jnp.concatenate(
+                [jnp.ones(1, bool),
+                 a.reshape(-1)[1:] != a.reshape(-1)[:-1]]), rng)
+
+        def run():
+            import numpy as np
+            mask = np.asarray(mask_fut.get())
+            return jnp.asarray(np.asarray(rng).reshape(-1)[mask])
+        return finish(policy, run)
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        if len(arr) == 0:
+            return arr.copy()
+        mask = np.concatenate([[True], arr[1:] != arr[:-1]])
+        return arr[mask]
+
+    return finish(policy, run)
+
+
+def partition(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    """Stable partition: satisfying elements first; returns (range,
+    partition_point)."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            flat = a.reshape(-1)
+            m = jax.vmap(pred)(flat)
+            # stable partition via stable argsort of negated mask
+            order = jnp.argsort(~m, stable=True)
+            return flat[order], m.sum()
+        fut = ex.async_execute(kernel, rng)
+
+        def done(f):
+            arr2, point = f.get()
+            return arr2, int(point)
+        return fut.then(done) if policy.is_task else done(fut)
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        mask = np.array([bool(pred(x)) for x in arr])
+        return np.concatenate([arr[mask], arr[~mask]]), int(mask.sum())
+
+    return finish(policy, run)

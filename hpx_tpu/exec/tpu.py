@@ -147,6 +147,11 @@ class TpuExecutor(BaseExecutor):
     user-facing change — BASELINE.json north star).
     """
 
+    _jit_cache: dict = {}
+    import collections as _collections
+    _jit_lru: "_collections.OrderedDict" = _collections.OrderedDict()
+    _jit_lru_max = 256
+
     def __init__(self, target: Optional[Target] = None,
                  eager: Optional[bool] = None,
                  donate_argnums: tuple = ()) -> None:
@@ -155,16 +160,34 @@ class TpuExecutor(BaseExecutor):
             eager = runtime_config().get_bool("hpx.tpu.eager_futures", True)
         self.eager = eager
         self._donate = donate_argnums
-        self._jit_cache: dict = {}
 
     # -- compilation --------------------------------------------------------
     def _compiled(self, fn: Callable[..., Any]) -> Callable[..., Any]:
         import jax
-        key = fn
-        cached = self._jit_cache.get(key)
+        from ..utils.fnkey import fn_cache_key
+        # Structural key: algorithm call sites create fresh lambdas every
+        # call; identity keying would re-jit (and re-compile the XLA
+        # program) each time. Cache is class-level so short-lived executor
+        # instances share compilations. Identity-keyed fallbacks (closures
+        # capturing arrays etc.) go to a bounded LRU so they can't pin
+        # captured data for the process lifetime.
+        fkey = fn_cache_key(fn)
+        key = (fkey, self._donate)
+        if fkey is fn:  # identity fallback
+            lru = TpuExecutor._jit_lru
+            cached = lru.get(key)
+            if cached is None:
+                cached = jax.jit(fn, donate_argnums=self._donate)
+                lru[key] = cached
+                if len(lru) > TpuExecutor._jit_lru_max:
+                    lru.popitem(last=False)
+            else:
+                lru.move_to_end(key)
+            return cached
+        cached = TpuExecutor._jit_cache.get(key)
         if cached is None:
             cached = jax.jit(fn, donate_argnums=self._donate)
-            self._jit_cache[key] = cached
+            TpuExecutor._jit_cache[key] = cached
         return cached
 
     # -- executor surface ----------------------------------------------------

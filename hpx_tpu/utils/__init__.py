@@ -1,0 +1,1 @@
+from .fnkey import fn_cache_key  # noqa: F401

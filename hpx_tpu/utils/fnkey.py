@@ -1,0 +1,59 @@
+"""Structural cache keys for user callables.
+
+Why: algorithm call sites pass fresh lambda objects each call
+(`hpx.transform(pol, x, lambda v: a*v+b, y)` in a loop). Keying the jit
+cache on object identity would recompile the XLA program every iteration —
+the difference between ~0.5 s and ~0.5 ms per call. This key treats two
+functions as equal when they have the same code object, the same
+(hashable) closure-cell values and defaults, recursing into captured
+functions.
+
+Caching semantics match jax.jit's: changes to *globals* read inside the
+function are not part of the key (jit has the same behavior — the trace
+is cached). Unhashable or exotic captures fall back to identity keying,
+which is always correct, merely slower.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Any, Hashable
+
+_SCALARS = (int, float, complex, bool, str, bytes, type(None))
+
+
+def fn_cache_key(f: Any, _depth: int = 0) -> Hashable:
+    if _depth > 4 or not isinstance(f, types.FunctionType):
+        return f
+    vals = []
+    for cell in f.__closure__ or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:  # empty cell
+            return f
+        if isinstance(v, _SCALARS):
+            vals.append((type(v).__name__, v))
+        elif isinstance(v, (types.BuiltinFunctionType, type)):
+            vals.append(v)  # builtins (operator.add, ...) and classes are
+            # stable singletons — hashable by identity
+        elif isinstance(v, types.FunctionType):
+            k = fn_cache_key(v, _depth + 1)
+            if k is v:
+                return f  # captured fn not structurally keyable
+            vals.append(k)
+        elif isinstance(v, types.ModuleType):
+            vals.append(("module", v.__name__))
+        elif isinstance(v, tuple) and all(isinstance(x, _SCALARS) for x in v):
+            vals.append(("tuple", v))
+        else:
+            return f  # mutable/unhashable capture: identity key
+    defaults = f.__defaults__
+    if defaults is not None and not all(
+            isinstance(d, _SCALARS) for d in defaults):
+        return f
+    kwdefaults = f.__kwdefaults__
+    if kwdefaults is not None:
+        if not all(isinstance(d, _SCALARS) for d in kwdefaults.values()):
+            return f
+        kwdefaults = tuple(sorted(kwdefaults.items()))
+    return (f.__code__, tuple(vals), defaults, kwdefaults)

@@ -1,0 +1,306 @@
+"""Parallel algorithm tests: systematic per-algorithm × policy matrix with
+differential checks vs numpy (HPX's per-algorithm × policy × iterator
+convention — libs/core/algorithms/tests/unit/algorithms/*).
+
+Policies covered: seq (host reference), par (host chunked), par.task
+(future-returning), par.on(TpuExecutor()) (device path, CPU backend in
+tests — identical code path on real TPU).
+"""
+
+import operator
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.futures.future import Future
+
+RNG = np.random.default_rng(42)
+
+
+def device_policy():
+    return hpx.par.on(hpx.TpuExecutor())
+
+
+def policies():
+    return [hpx.seq, hpx.par, device_policy()]
+
+
+def unwrap(x):
+    return x.get(timeout=60.0) if isinstance(x, Future) else x
+
+
+def asnp(x):
+    return np.asarray(unwrap(x))
+
+
+# -- elementwise ------------------------------------------------------------
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_for_each(pol_idx):
+    pol = policies()[pol_idx]
+    data = jnp.arange(16, dtype=jnp.float32) if pol_idx == 2 else \
+        np.arange(16, dtype=np.float32)
+    out = hpx.for_each(pol, data, lambda x: x * 2)
+    np.testing.assert_allclose(asnp(out), np.arange(16) * 2)
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_transform_unary_binary(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.arange(10, dtype=np.float32))
+    b = mk(np.full(10, 3.0, np.float32))
+    np.testing.assert_allclose(asnp(hpx.transform(pol, a, lambda x: x + 1)),
+                               np.arange(10) + 1)
+    np.testing.assert_allclose(
+        asnp(hpx.transform(pol, a, lambda x, y: x * y, b)),
+        np.arange(10) * 3.0)
+
+
+def test_fill_generate_copy():
+    for pol_idx in range(3):
+        pol = policies()[pol_idx]
+        mk = jnp.asarray if pol_idx == 2 else np.asarray
+        a = mk(np.zeros(8, np.float32))
+        np.testing.assert_allclose(asnp(hpx.fill(pol, a, 7.0)), np.full(8, 7.0))
+        np.testing.assert_allclose(asnp(hpx.generate(pol, a, lambda: 2.0)),
+                                   np.full(8, 2.0))
+        c = hpx.copy(pol, a)
+        np.testing.assert_allclose(asnp(c), np.asarray(a))
+
+
+def test_copy_if_compaction():
+    data = np.arange(20)
+    out = hpx.copy_if(hpx.par, data, lambda x: x % 2 == 0)
+    np.testing.assert_array_equal(asnp(out), np.arange(0, 20, 2))
+    dev = hpx.copy_if(device_policy(), jnp.arange(20), lambda x: x % 2 == 0)
+    np.testing.assert_array_equal(asnp(dev), np.arange(0, 20, 2))
+
+
+def test_for_loop_device_and_host():
+    hits = []
+    hpx.for_loop(hpx.seq, 2, 6, hits.append)
+    assert hits == [2, 3, 4, 5]
+    out = hpx.for_loop(device_policy(), 0, 8, lambda i: i * i)
+    np.testing.assert_array_equal(asnp(out), np.arange(8) ** 2)
+
+
+# -- reductions -------------------------------------------------------------
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_reduce(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.arange(100, dtype=np.float32))
+    assert float(unwrap(hpx.reduce(pol, a, 0.0, operator.add))) == 4950.0
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_transform_reduce_saxpy_dot(pol_idx):
+    # config #1 shape: dot(x, y) via binary transform_reduce
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    x = mk(RNG.random(256).astype(np.float32))
+    y = mk(RNG.random(256).astype(np.float32))
+    got = float(unwrap(hpx.transform_reduce(
+        pol, x, 0.0, operator.add, operator.mul, rng2=y)))
+    np.testing.assert_allclose(got, float(np.dot(np.asarray(x), np.asarray(y))),
+                               rtol=1e-4)
+
+
+def test_transform_reduce_unary():
+    a = np.arange(10, dtype=np.float64)
+    got = hpx.transform_reduce(hpx.par, a, 0.0, operator.add,
+                               lambda x: x * x)
+    assert float(got) == float((a * a).sum())
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_count_and_queries(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.array([1, 2, 3, 2, 2, 5]))
+    assert int(unwrap(hpx.count(pol, a, 2))) == 3
+    assert int(unwrap(hpx.count_if(pol, a, lambda x: x > 2))) == 2
+    assert unwrap(hpx.all_of(pol, a, lambda x: x > 0))
+    assert unwrap(hpx.any_of(pol, a, lambda x: x == 5))
+    assert unwrap(hpx.none_of(pol, a, lambda x: x > 10))
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_minmax(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.array([5.0, -2.0, 9.0, 0.5]))
+    assert float(unwrap(hpx.min_element(pol, a))) == -2.0
+    assert float(unwrap(hpx.max_element(pol, a))) == 9.0
+    mm = unwrap(hpx.minmax_element(pol, a))
+    assert float(mm[0]) == -2.0 and float(mm[1]) == 9.0
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_equal_mismatch_find(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.array([1, 2, 3, 4]))
+    b = mk(np.array([1, 2, 9, 4]))
+    assert unwrap(hpx.equal(pol, a, a))
+    assert not unwrap(hpx.equal(pol, a, b))
+    assert unwrap(hpx.mismatch(pol, a, b)) == 2
+    assert unwrap(hpx.mismatch(pol, a, a)) == -1
+    assert unwrap(hpx.find(pol, a, 3)) == 2
+    assert unwrap(hpx.find(pol, a, 42)) == -1
+    assert unwrap(hpx.find_if(pol, a, lambda x: x > 2)) == 2
+
+
+# -- scans ------------------------------------------------------------------
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_scans(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.arange(1, 9, dtype=np.float32))
+    np.testing.assert_allclose(asnp(hpx.inclusive_scan(pol, a)),
+                               np.cumsum(np.arange(1, 9)))
+    np.testing.assert_allclose(
+        asnp(hpx.exclusive_scan(pol, a, 0.0)),
+        np.concatenate([[0], np.cumsum(np.arange(1, 9))[:-1]]))
+    np.testing.assert_allclose(
+        asnp(hpx.inclusive_scan(pol, a, 10.0)),
+        10.0 + np.cumsum(np.arange(1, 9)))
+
+
+def test_transform_scans():
+    a = np.arange(1, 6, dtype=np.float64)
+    np.testing.assert_allclose(
+        asnp(hpx.transform_inclusive_scan(hpx.par, a, 0.0, operator.add,
+                                          lambda x: x * x)),
+        np.cumsum(a * a))
+    d = hpx.transform_inclusive_scan(device_policy(), jnp.asarray(a), 0.0,
+                                     operator.add, lambda x: x * x)
+    np.testing.assert_allclose(asnp(d), np.cumsum(a * a))
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_adjacent_difference_and_find(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.array([1, 4, 9, 16], dtype=np.float32))
+    np.testing.assert_allclose(asnp(hpx.adjacent_difference(pol, a)),
+                               [1, 3, 5, 7])
+    b = mk(np.array([1, 2, 2, 3]))
+    assert unwrap(hpx.adjacent_find(pol, b)) == 1
+    c = mk(np.array([1, 2, 3, 4]))
+    assert unwrap(hpx.adjacent_find(pol, c)) == -1
+
+
+# -- sorting / order --------------------------------------------------------
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_sort(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(RNG.permutation(64).astype(np.float32))
+    np.testing.assert_array_equal(asnp(hpx.sort(pol, a)), np.arange(64))
+    assert unwrap(hpx.is_sorted(pol, mk(np.arange(10))))
+    assert not unwrap(hpx.is_sorted(pol, a))
+
+
+def test_sort_with_key():
+    a = np.array([3.0, -5.0, 1.0, -2.0])
+    out = hpx.sort(hpx.par, a, key=abs)
+    np.testing.assert_array_equal(asnp(out), [1.0, -2.0, 3.0, -5.0])
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_merge_reverse_rotate(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a, b = mk(np.array([1, 3, 5])), mk(np.array([2, 4, 6]))
+    np.testing.assert_array_equal(asnp(hpx.merge(pol, a, b)),
+                                  [1, 2, 3, 4, 5, 6])
+    np.testing.assert_array_equal(asnp(hpx.reverse(pol, a)), [5, 3, 1])
+    np.testing.assert_array_equal(
+        asnp(hpx.rotate(pol, mk(np.arange(6)), 2)), [2, 3, 4, 5, 0, 1])
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_unique_partition(pol_idx):
+    pol = policies()[pol_idx]
+    mk = jnp.asarray if pol_idx == 2 else np.asarray
+    a = mk(np.array([1, 1, 2, 2, 2, 3, 1]))
+    np.testing.assert_array_equal(asnp(hpx.unique(pol, a)), [1, 2, 3, 1])
+    arr, point = unwrap(hpx.partition(pol, mk(np.arange(10)),
+                                      lambda x: x % 2 == 0))
+    assert point == 5
+    np.testing.assert_array_equal(np.asarray(arr)[:5], [0, 2, 4, 6, 8])
+    np.testing.assert_array_equal(np.asarray(arr)[5:], [1, 3, 5, 7, 9])
+
+
+# -- task policy ------------------------------------------------------------
+
+def test_task_policy_returns_future_host_and_device():
+    a = np.arange(1000, dtype=np.float64)
+    f = hpx.reduce(hpx.par.task, a, 0.0, operator.add)
+    assert isinstance(f, Future)
+    assert float(f.get(timeout=30.0)) == float(a.sum())
+
+    d = hpx.transform(device_policy().task, jnp.arange(8, dtype=jnp.float32),
+                      lambda x: x + 1)
+    assert isinstance(d, Future)
+    np.testing.assert_allclose(asnp(d), np.arange(8) + 1)
+
+
+def test_chunked_host_policy_with_params():
+    a = np.arange(100, dtype=np.float64)
+    pol = hpx.par.with_(hpx.static_chunk_size(7))
+    assert float(unwrap(hpx.reduce(pol, a, 0.0, operator.add))) == float(a.sum())
+
+
+def test_empty_ranges():
+    assert float(unwrap(hpx.reduce(hpx.par, np.array([]), 5.0))) == 5.0
+    np.testing.assert_array_equal(asnp(hpx.sort(hpx.par, np.array([]))), [])
+    assert unwrap(hpx.find(hpx.par, np.array([]), 1)) == -1
+
+
+# -- regressions from review ------------------------------------------------
+
+def test_reduce_device_nonidentity_init():
+    # regression: lax.reduce would apply init per tile
+    got = hpx.reduce(device_policy(), jnp.arange(1, 9, dtype=jnp.float32),
+                     10.0, operator.add)
+    assert float(unwrap(got)) == 46.0
+
+
+def test_exclusive_scan_device_mul_init():
+    # regression: device scan assumed 0 is the op identity
+    got = hpx.exclusive_scan(device_policy(),
+                             jnp.array([2.0, 3.0, 4.0]), 1.0, operator.mul)
+    np.testing.assert_allclose(asnp(got), [1.0, 2.0, 6.0])
+    host = hpx.exclusive_scan(hpx.par, np.array([2.0, 3.0, 4.0]), 1.0,
+                              operator.mul)
+    np.testing.assert_allclose(asnp(host), [1.0, 2.0, 6.0])
+
+
+def test_copy_preserves_bool_dtype():
+    out = hpx.copy(device_policy(), jnp.array([True, False]))
+    assert asnp(out).dtype == np.bool_
+
+
+def test_kwdefault_lambdas_not_conflated():
+    def make(s):
+        return lambda x, *, k=s: x * k
+    a = hpx.transform(device_policy(), jnp.arange(4, dtype=jnp.float32),
+                      make(2.0))
+    b = hpx.transform(device_policy(), jnp.arange(4, dtype=jnp.float32),
+                      make(3.0))
+    np.testing.assert_allclose(asnp(a), np.arange(4) * 2.0)
+    np.testing.assert_allclose(asnp(b), np.arange(4) * 3.0)
+
+
+def test_for_loop_host_collects_results():
+    out = hpx.for_loop(hpx.par, 0, 8, lambda i: i * i)
+    assert out == [i * i for i in range(8)]
+    assert hpx.for_loop(hpx.par, 0, 4, lambda i: None) is None
