@@ -37,9 +37,11 @@ _KNOWN_FOLDS = {}
 def _known_folds():
     if not _KNOWN_FOLDS:
         import jax.numpy as jnp
+        # (whole-array fold, traceable binary combiner) — the combiner is
+        # needed because builtin min/max cannot run on tracers
         _KNOWN_FOLDS.update({
-            _op.add: jnp.sum, _op.mul: jnp.prod,
-            min: jnp.min, max: jnp.max,
+            _op.add: (jnp.sum, jnp.add), _op.mul: (jnp.prod, jnp.multiply),
+            min: (jnp.min, jnp.minimum), max: (jnp.max, jnp.maximum),
         })
     return _KNOWN_FOLDS
 
@@ -50,13 +52,15 @@ def _device_reduce_kernel(op: Callable, init: Any):
 
     def kernel(a):
         flat = a.reshape(-1)
-        fold = _known_folds().get(op)
-        if fold is not None:
+        known = _known_folds().get(op)
+        if known is not None:
+            fold, combine = known
             total = fold(flat)
         else:
+            combine = op
             # associative fold without an identity requirement
             total = jax.lax.associative_scan(jax.vmap(op), flat)[-1]
-        return op(jnp.asarray(init, flat.dtype), total)
+        return combine(jnp.asarray(init, flat.dtype), total)
 
     return kernel
 

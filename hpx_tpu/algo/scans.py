@@ -28,7 +28,9 @@ from ._core import (
 def _host_scan(arr, init, op, inclusive: bool, transform=None):
     import numpy as np
     if transform is None:
-        out = np.empty_like(arr)
+        # widen to the accumulator's dtype (init may promote, e.g. int
+        # input with float init) — matches device-path/std semantics
+        out = np.empty(len(arr), dtype=np.result_type(arr, np.asarray(init)))
         first = arr[0] if len(arr) else None
     else:
         # transform element 0 once: dtype probe AND iteration value
@@ -90,6 +92,8 @@ def transform_exclusive_scan(policy: ExecutionPolicy, rng: Any, init: Any,
     if is_device_policy(policy, rng):
         import jax
         import jax.numpy as jnp
+        if rng.shape[0] == 0:  # std semantics: empty in, empty out
+            return finish(policy, lambda: rng)
         ex = device_executor(policy)
 
         def kernel(a):

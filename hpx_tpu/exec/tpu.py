@@ -147,8 +147,9 @@ class TpuExecutor(BaseExecutor):
     user-facing change — BASELINE.json north star).
     """
 
-    _jit_cache: dict = {}
     import collections as _collections
+    _jit_cache: "_collections.OrderedDict" = _collections.OrderedDict()
+    _jit_cache_max = 4096
     _jit_lru: "_collections.OrderedDict" = _collections.OrderedDict()
     _jit_lru_max = 256
 
@@ -184,10 +185,18 @@ class TpuExecutor(BaseExecutor):
             else:
                 lru.move_to_end(key)
             return cached
-        cached = TpuExecutor._jit_cache.get(key)
+        cache = TpuExecutor._jit_cache
+        cached = cache.get(key)
         if cached is None:
             cached = jax.jit(fn, donate_argnums=self._donate)
-            TpuExecutor._jit_cache[key] = cached
+            cache[key] = cached
+            # structural keys embed closure scalars, so loops over varying
+            # captures (e.g. a learning-rate schedule) still create new
+            # entries — bound this cache too
+            if len(cache) > TpuExecutor._jit_cache_max:
+                cache.pop(next(iter(cache)))
+        else:
+            cache.move_to_end(key)
         return cached
 
     # -- executor surface ----------------------------------------------------

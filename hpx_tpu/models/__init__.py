@@ -1,0 +1,1 @@
+from . import stencil1d  # noqa: F401

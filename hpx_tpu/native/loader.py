@@ -102,6 +102,8 @@ class NativePool:
         self._tasks_lock = threading.Lock()
         self._next_id = 0
         self._shut = False
+        self._last_stats = {"executed": 0, "stolen": 0, "pending": 0,
+                            "threads": self._n}
 
         # The trampoline must outlive every submitted task — bind it to the
         # instance so ctypes keeps the closure alive.
@@ -128,6 +130,9 @@ class NativePool:
         return self._n
 
     def submit(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
+        if self._shut:  # the C++ pool was freed; a call would be UAF
+            from ..core.errors import Error, HpxError
+            raise HpxError(Error.invalid_status, "pool is shut down")
         with self._tasks_lock:
             tid = self._next_id
             self._next_id += 1
@@ -135,23 +140,35 @@ class NativePool:
         self._lib.hpxrt_pool_submit(self._handle, self._tramp, tid)
 
     def help_one(self) -> bool:
+        if self._shut:
+            return False
         return bool(self._lib.hpxrt_pool_help_one(self._handle))
 
     def in_worker(self) -> bool:
+        if self._shut:
+            return False
         return bool(self._lib.hpxrt_pool_in_worker(self._handle))
 
     def stats(self) -> dict:
-        return {
+        if self._shut:
+            return dict(self._last_stats, shutdown=True)
+        self._last_stats = {
             "executed": int(self._lib.hpxrt_pool_executed(self._handle)),
             "stolen": int(self._lib.hpxrt_pool_stolen(self._handle)),
             "pending": int(self._lib.hpxrt_pool_pending(self._handle)),
             "threads": self._n,
         }
+        return self._last_stats
 
     def shutdown(self, wait: bool = True) -> None:
+        # wait is accepted for interface parity with WorkStealingPool;
+        # the native pool always joins its workers before freeing.
         if not self._shut:
+            self.stats()              # snapshot final counters
             self._shut = True
+            # workers registered in _worker_of must not help a dead pool
             self._lib.hpxrt_pool_shutdown(self._handle)
+            self._handle = None
 
     def __del__(self) -> None:  # best-effort; explicit shutdown preferred
         try:

@@ -1,0 +1,1 @@
+from .stencil import heat_step, multistep, pallas_multistep, xla_multistep  # noqa: F401

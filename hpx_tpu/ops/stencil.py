@@ -1,0 +1,119 @@
+"""Stencil kernels: 3-point heat update, single- and multi-step.
+
+Reference analog: the `heat_part` inner loop of examples/1d_stencil/
+1d_stencil_4.cpp (u'[i] = u[i] + k*dt/dx^2 * (u[i-1] - 2u[i] + u[i+1]),
+periodic neighbors) — the Mcells/s hot loop of BASELINE config #2.
+
+TPU-first design: a single heat step is HBM-bandwidth-bound (read u, write
+u'). The win is fusing T steps per dispatch:
+  * pallas_multistep: whole array resident in VMEM, T updates without
+    touching HBM in between — compute-bound instead of HBM-bound for
+    arrays that fit VMEM (~<=2M f32).
+  * xla_multistep: lax.fori_loop of the fused roll-expression under jit —
+    works at any size, one HBM round-trip per step.
+Both are shape-static, branch-free, and VPU-friendly (8x128 lanes; arrays
+are laid out 2D (rows, 128)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+
+
+def heat_step(u: jax.Array, coef: float) -> jax.Array:
+    """One periodic 3-point heat update on a 1-D array (XLA-fused)."""
+    left = jnp.roll(u, 1)
+    right = jnp.roll(u, -1)
+    return u + coef * (left - 2.0 * u + right)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def xla_multistep(u: jax.Array, coef: jax.Array, steps: int) -> jax.Array:
+    """T fused steps via fori_loop in ONE compiled program."""
+    def body(_i, s):
+        return heat_step(s, coef)
+    return jax.lax.fori_loop(0, steps, body, u)
+
+
+def _pallas_kernel(u_ref, coef_ref, out_ref, *, steps: int):
+    """Whole-array-in-VMEM multi-step kernel.
+
+    Layout: (rows, 128). Periodic 1-D neighbor access on the flattened
+    view maps to lane/row shifts: left neighbor = roll(+1), which in 2-D
+    is a lane roll with row-carry; implemented with jnp.roll on the 2-D
+    block (cheap VPU shuffles) after adjusting the carry column.
+    """
+    u0 = u_ref[:]
+    coef = coef_ref[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, u0.shape, 1)
+    first_col = col == 0
+    last_col = col == LANES - 1
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    def one(_i, u):
+        # flattened roll(+1): shift lanes right by one; column 0 takes the
+        # previous row's lane 127 (row 0 wraps to the last row). Column
+        # patch via iota-mask where (a scatter would not lower on TPU);
+        # shifts use pltpu.roll — Mosaic's native circular shift.
+        lane_r = pltpu.roll(u, 1, axis=1)
+        carry_r = pltpu.roll(u[:, LANES - 1:], 1, axis=0)  # prev row's last
+        left = jnp.where(first_col, carry_r, lane_r)
+        # flattened roll(-1): shift lanes left; last lane takes next row's
+        # lane 0.
+        # pltpu.roll requires non-negative shifts: roll by size-1
+        lane_l = pltpu.roll(u, LANES - 1, axis=1)
+        carry_l = pltpu.roll(u[:, :1], u.shape[0] - 1, axis=0)  # next row's first
+        right = jnp.where(last_col, carry_l, lane_l)
+        return u + coef * (left - 2.0 * u + right)
+
+    # fori_loop (not Python unroll): bounds VMEM liveness to one
+    # iteration's temporaries regardless of `steps`
+    out_ref[:] = jax.lax.fori_loop(0, steps, one, u0)
+
+
+@functools.partial(jax.jit, static_argnames=("steps",))
+def pallas_multistep(u: jax.Array, coef, steps: int) -> jax.Array:
+    """T steps with the state held in VMEM throughout (zero intermediate
+    HBM traffic). Requires len(u) % 128 == 0 and the array to fit VMEM."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = u.shape[0]
+    assert n % LANES == 0, "pallas stencil requires length % 128 == 0"
+    u2 = u.reshape(n // LANES, LANES)
+    coef_arr = jnp.asarray([coef], dtype=u.dtype)
+
+    out = pl.pallas_call(
+        functools.partial(_pallas_kernel, steps=steps),
+        out_shape=jax.ShapeDtypeStruct(u2.shape, u2.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(u2, coef_arr)
+    return out.reshape(n)
+
+
+# Working set in the kernel is ~5 arrays (state + roll/where temporaries);
+# 512K f32 = 2 MB each keeps us ~10 MB, under the 16 MB scoped-VMEM limit.
+_VMEM_F32_LIMIT = 1 << 19
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "use_pallas"))
+def multistep(u: jax.Array, coef: jax.Array, steps: int,
+              use_pallas: Optional[bool] = None) -> jax.Array:
+    """Best-available T-step stencil: pallas when the array fits VMEM."""
+    if use_pallas is None:
+        use_pallas = (u.shape[0] % LANES == 0 and
+                      u.shape[0] <= _VMEM_F32_LIMIT)
+    if use_pallas:
+        return pallas_multistep(u, coef, steps)
+    return xla_multistep(u, coef, steps)

@@ -1,0 +1,7 @@
+from .mesh import make_mesh, replicated, shard_1d  # noqa: F401
+from .halo import (  # noqa: F401
+    halo_exchange_1d,
+    ring_shift,
+    sharded_heat_step,
+    sharded_multistep,
+)

@@ -1,0 +1,44 @@
+"""Device mesh helpers.
+
+Reference analog: HPX's resource partitioner + topology (libs/core/
+resource_partitioner, libs/core/topology) decide which cores run what;
+on TPU the analogous resource is the device mesh and its named axes.
+Localities (M5) map onto mesh coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("x",),
+              devices=None):
+    """Create a jax.sharding.Mesh. Default: all devices on one axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devs),)
+    arr = np.array(devs).reshape(tuple(shape))
+    if len(axis_names) != arr.ndim:
+        axis_names = tuple(f"ax{i}" for i in range(arr.ndim))
+    return Mesh(arr, tuple(axis_names))
+
+
+def shard_1d(arr, mesh, axis: str = "x"):
+    """Place a 1-D array sharded across the given mesh axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+
+def replicated(arr, mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(arr, NamedSharding(mesh, P()))

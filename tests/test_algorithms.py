@@ -304,3 +304,20 @@ def test_for_loop_host_collects_results():
     out = hpx.for_loop(hpx.par, 0, 8, lambda i: i * i)
     assert out == [i * i for i in range(8)]
     assert hpx.for_loop(hpx.par, 0, 4, lambda i: None) is None
+
+
+def test_reduce_device_builtin_min_max():
+    # regression: builtin min/max as reduce op on the device path
+    a = jnp.array([5.0, -2.0, 9.0])
+    assert float(unwrap(hpx.reduce(device_policy(), a, 100.0, min))) == -2.0
+    assert float(unwrap(hpx.reduce(device_policy(), a, -100.0, max))) == 9.0
+
+
+def test_host_scan_widens_dtype():
+    out = hpx.inclusive_scan(hpx.seq, np.array([1, 2, 3]), 0.5)
+    np.testing.assert_allclose(asnp(out), [1.5, 3.5, 6.5])
+
+
+def test_exclusive_scan_empty_device():
+    out = hpx.exclusive_scan(device_policy(), jnp.array([], dtype=jnp.float32))
+    assert asnp(out).shape == (0,)

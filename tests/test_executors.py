@@ -194,3 +194,18 @@ def test_get_future_on_raw_value():
 def test_policy_on_tpu_executor_roundtrip():
     p = par.on(hpx.TpuExecutor())
     assert isinstance(p.get_executor(), hpx.TpuExecutor)
+
+
+def test_native_pool_safe_after_shutdown():
+    # regression: method calls after shutdown must not touch freed memory
+    import pytest as _pytest
+    from hpx_tpu.core.errors import HpxError
+    p = NativePool(1)
+    p.submit(lambda: None)
+    p.shutdown()
+    assert p.stats().get("shutdown") is True
+    assert p.help_one() is False
+    assert p.in_worker() is False
+    with _pytest.raises(HpxError):
+        p.submit(lambda: None)
+    p.shutdown()  # idempotent

@@ -1,0 +1,113 @@
+"""1d_stencil workload tests (BASELINE config #2 parity).
+
+Reference analog: examples/1d_stencil — correctness is cross-checked
+between the serial, dataflow, fused-XLA, fused-pallas, and sharded-mesh
+variants (all must agree bitwise-ish on the same physics), mirroring how
+the reference's ladder validates against 1d_stencil_1.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.models.stencil1d import (
+    StencilParams, gather_dataflow_result, init_domain, stencil_dataflow,
+    stencil_fused, stencil_serial,
+)
+from hpx_tpu.ops.stencil import heat_step, pallas_multistep, xla_multistep
+from hpx_tpu.parallel import (
+    make_mesh, shard_1d, sharded_heat_step, sharded_multistep,
+)
+
+
+def numpy_reference(p: StencilParams) -> np.ndarray:
+    u = np.arange(p.total, dtype=np.float64)
+    for _ in range(p.nt):
+        u = u + p.coef * (np.roll(u, 1) - 2 * u + np.roll(u, -1))
+    return u
+
+
+def test_serial_matches_numpy():
+    p = StencilParams(nx=64, np_=4, nt=20, k=0.25)
+    got = np.asarray(stencil_serial(p), dtype=np.float64)
+    np.testing.assert_allclose(got, numpy_reference(p), rtol=1e-4)
+
+
+def test_dataflow_matches_serial():
+    p = StencilParams(nx=32, np_=8, nt=15, k=0.25)
+    u = stencil_dataflow(p)
+    got = np.asarray(gather_dataflow_result(u))
+    want = np.asarray(stencil_serial(p))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_fused_xla_matches_serial():
+    p = StencilParams(nx=128, np_=4, nt=40, k=0.25)
+    got = np.asarray(stencil_fused(p, steps_per_dispatch=10,
+                                   use_pallas=False))
+    want = np.asarray(stencil_serial(p))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_pallas_multistep_matches_xla():
+    # pallas path needs length % 128 == 0; runs in interpreter-compatible
+    # mode on CPU backend
+    n, steps, coef = 512, 8, jnp.float32(0.25)
+    u = jnp.arange(n, dtype=jnp.float32)
+    try:
+        got = pallas_multistep(u, coef, steps)
+    except Exception as e:  # pallas-on-CPU unavailable in this jax build
+        pytest.skip(f"pallas unavailable on CPU backend: {e}")
+    want = xla_multistep(u, coef, steps)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_sharded_heat_step_matches_serial(mesh1d):
+    n = 8 * 32
+    u = jnp.arange(n, dtype=jnp.float32)
+    us = shard_1d(u, mesh1d)
+    step = sharded_heat_step(mesh1d, "x")
+    coef = jnp.float32(0.25)
+    got = us
+    for _ in range(5):
+        got = step(got, coef)
+    want = u
+    for _ in range(5):
+        want = heat_step(want, coef)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+def test_sharded_multistep_single_program(mesh1d):
+    n = 8 * 64
+    u = jnp.arange(n, dtype=jnp.float32)
+    us = shard_1d(u, mesh1d)
+    coef = jnp.float32(0.3)
+    fn = sharded_multistep(mesh1d, "x", steps=12, halo_steps=3)
+    got = fn(us, coef)
+    want = u
+    for _ in range(12):
+        want = heat_step(want, coef)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4)
+    # sharding preserved (no implicit gather)
+    assert len(got.sharding.device_set) == 8
+
+
+def test_sharded_wide_halo_equivalence(mesh1d):
+    # halo_steps=4 (communication-avoiding) must equal halo_steps=1
+    n = 8 * 64
+    u = jnp.arange(n, dtype=jnp.float32)
+    us = shard_1d(u, mesh1d)
+    coef = jnp.float32(0.25)
+    a = sharded_multistep(mesh1d, "x", steps=8, halo_steps=1)(us, coef)
+    b = sharded_multistep(mesh1d, "x", steps=8, halo_steps=4)(us, coef)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_conservation():
+    # periodic heat equation conserves the sum
+    p = StencilParams(nx=64, np_=4, nt=50, k=0.4)
+    u = stencil_fused(p, use_pallas=False)
+    np.testing.assert_allclose(float(jnp.sum(u)),
+                               float(jnp.sum(init_domain(p))), rtol=1e-3)
