@@ -63,5 +63,14 @@ from .algo import (  # noqa: F401
     sort, stable_sort, is_sorted, merge, reverse, rotate, unique, partition,
 )
 
-# Populated as milestones land (SURVEY.md §7): runtime/localities (M5),
-# containers + segmented algorithms (M6), collectives (M7), services (M9).
+# -- distributed runtime: localities, actions, AGAS (M5) ---------------------
+from .dist import (  # noqa: F401
+    plain_action, direct_action, async_action, post_action,
+    init, finalize, get_runtime,
+    find_here, find_all_localities, find_remote_localities,
+    find_root_locality, get_num_localities,
+)
+from .dist import agas  # noqa: F401
+
+# Populated as milestones land (SURVEY.md §7): containers + segmented
+# algorithms (M6), collectives (M7), services (M9).

@@ -1,0 +1,20 @@
+from . import agas  # noqa: F401
+from .actions import (  # noqa: F401
+    Action,
+    async_action,
+    direct_action,
+    plain_action,
+    post_action,
+)
+from .runtime import (  # noqa: F401
+    Runtime,
+    finalize,
+    find_all_localities,
+    find_here,
+    find_remote_localities,
+    find_root_locality,
+    get_num_localities,
+    get_runtime,
+    init,
+)
+from .serialization import deserialize, serialize  # noqa: F401

@@ -1,0 +1,91 @@
+"""Actions: typed remote-invocable functions.
+
+Reference analog: libs/full/actions + actions_base (HPX_PLAIN_ACTION:
+macro-generated action types wrapping a function; direct vs scheduled
+execution; typed continuations setting the caller's future).
+
+    @plain_action
+    def compute(x, y): ...
+
+    f = hpx.async_action(compute, locality=2, x, y)   # Future
+    hpx.post_action(compute, 2, x, y)                 # fire-and-forget
+
+Local destinations take the AGAS-cache fast path: no serialization, the
+callable is scheduled directly (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ..core.errors import BadParameter
+from ..futures.future import Future
+
+_registry: Dict[str, Callable] = {}
+_registry_lock = threading.Lock()
+
+
+def _qualname(fn: Callable) -> str:
+    return f"{fn.__module__}.{fn.__qualname__}"
+
+
+class Action:
+    """A registered remote-invocable function."""
+
+    __slots__ = ("name", "fn", "direct")
+
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 direct: bool = False) -> None:
+        self.fn = fn
+        self.name = name or _qualname(fn)
+        # direct actions run inline on the parcel-decode path (HPX
+        # 'direct' execution for tiny handlers); scheduled ones hop to
+        # the task pool.
+        self.direct = direct
+        with _registry_lock:
+            if self.name in _registry and _registry[self.name] is not fn:
+                raise BadParameter(f"action name already registered: "
+                                   f"{self.name}")
+            _registry[self.name] = fn
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.fn(*args, **kwargs)
+
+
+def plain_action(fn: Callable = None, *, name: Optional[str] = None,
+                 direct: bool = False):
+    """HPX_PLAIN_ACTION analog (decorator)."""
+    if fn is None:
+        return lambda f: Action(f, name=name, direct=direct)
+    return Action(fn, name=name, direct=direct)
+
+
+def direct_action(fn: Callable = None, *, name: Optional[str] = None):
+    """HPX_PLAIN_DIRECT_ACTION analog."""
+    if fn is None:
+        return lambda f: Action(f, name=name, direct=True)
+    return Action(fn, name=name, direct=True)
+
+
+def resolve_action(name: str) -> Callable:
+    with _registry_lock:
+        fn = _registry.get(name)
+    if fn is None:
+        from ..core.errors import Error, HpxError
+        raise HpxError(Error.bad_action_code, f"unknown action: {name}")
+    return fn
+
+
+def async_action(action: Any, locality: int, *args: Any, **kwargs: Any) -> Future:
+    """hpx::async(Action{}, id, args...) analog: run on `locality`."""
+    from .runtime import get_runtime
+    return get_runtime().send_action(action, locality, args, kwargs,
+                                     want_result=True)
+
+
+def post_action(action: Any, locality: int, *args: Any, **kwargs: Any) -> None:
+    """hpx::post(Action{}, id, args...): fire-and-forget."""
+    from .runtime import get_runtime
+    get_runtime().send_action(action, locality, args, kwargs,
+                              want_result=False)

@@ -1,0 +1,102 @@
+"""AGAS-lite: the name/symbol service.
+
+Reference analog: libs/full/agas — of HPX's four namespaces, the TPU
+runtime needs two for real (SURVEY.md §2.8 mapping):
+  * locality namespace -> the runtime's peer table (dist/runtime.py)
+  * symbol namespace   -> THIS module: name -> value registry hosted on
+    the console locality (locality 0), used for collective rendezvous
+    (M7), distributed-object registration, and barriers.
+The primary/component namespaces (128-bit gids, credit GC) collapse away:
+single-controller jax arrays don't need global addresses, and distributed
+objects are (locality, name) pairs.
+
+All functions return Futures (AGAS requests are remote actions).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ..futures.future import Future, make_ready_future
+from .actions import async_action, plain_action
+
+_symbols: Dict[str, Any] = {}
+_symbols_lock = threading.Lock()
+_waiters: Dict[str, list] = {}
+
+
+@plain_action(name="agas.register")
+def _register(name: str, value: Any, allow_replace: bool = False) -> bool:
+    with _symbols_lock:
+        if name in _symbols and not allow_replace:
+            return False
+        _symbols[name] = value
+        waiters = _waiters.pop(name, [])
+    for st in waiters:
+        st.set_value(value)
+    return True
+
+
+@plain_action(name="agas.resolve")
+def _resolve(name: str, wait: bool = False) -> Any:
+    """Returns the value; with wait=True, blocks (as a future chain)
+    until someone registers the name — the rendezvous primitive."""
+    from ..futures.future import SharedState
+    with _symbols_lock:
+        if name in _symbols:
+            return _symbols[name]
+        if not wait:
+            raise KeyError(name)
+        st = SharedState()
+        _waiters.setdefault(name, []).append(st)
+    return Future(st)  # unwrapped into the action result
+
+
+@plain_action(name="agas.unregister")
+def _unregister(name: str) -> bool:
+    with _symbols_lock:
+        return _symbols.pop(name, None) is not None
+
+
+@plain_action(name="agas.incr")
+def _incr(name: str, amount: int = 1) -> int:
+    with _symbols_lock:
+        v = _symbols.get(name, 0) + amount
+        _symbols[name] = v
+        return v
+
+
+@plain_action(name="agas.read")
+def _read(name: str, default: Any = 0) -> Any:
+    with _symbols_lock:
+        return _symbols.get(name, default)
+
+
+# -- client API (hpx::agas::register_name etc.) -----------------------------
+
+def _console() -> int:
+    return 0
+
+
+def register_name(name: str, value: Any,
+                  allow_replace: bool = False) -> Future:
+    """hpx::register_with_basename / agas::register_name analog."""
+    return async_action(_register, _console(), name, value, allow_replace)
+
+
+def resolve_name(name: str, wait: bool = False) -> Future:
+    """agas::resolve_name; wait=True blocks until registered."""
+    return async_action(_resolve, _console(), name, wait)
+
+
+def unregister_name(name: str) -> Future:
+    return async_action(_unregister, _console(), name)
+
+
+def atomic_increment(name: str, amount: int = 1) -> Future:
+    return async_action(_incr, _console(), name, amount)
+
+
+def atomic_read(name: str, default: Any = 0) -> Future:
+    return async_action(_read, _console(), name, default)

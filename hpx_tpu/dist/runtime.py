@@ -1,0 +1,387 @@
+"""Distributed runtime: localities, bootstrap, parcel handling.
+
+Reference analog: libs/full/runtime_distributed + init_runtime (the
+startup state machine; console locality 0 bootstraps AGAS; workers
+register — SURVEY.md §3.1) and libs/full/parcelset (parcelhandler).
+
+Topology: locality = OS process. Locality 0 ("console", HPX's term) is
+the bootstrap rendezvous: workers connect to its endpoint, send a hello
+carrying their own listen port, receive the full peer table once all
+have arrived, then build the full mesh (each locality dials every
+lower-numbered peer; the accept side learns who called from an ident
+frame). Compute-plane data does NOT travel here — that is jax's job over
+ICI; this is the control plane for actions, AGAS and rendezvous.
+
+Single-locality mode (the default) starts no networking at all.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..core.config import Configuration, runtime_config, set_runtime_config
+from ..core.errors import Error, HpxError, NetworkError
+from ..futures.future import Future, SharedState, make_ready_future
+from .actions import Action, resolve_action
+from .serialization import deserialize, serialize
+
+# message tags
+_HELLO = "hello"      # (tag, locality, listen_port)
+_TABLE = "table"      # (tag, {locality: (host, port)})
+_IDENT = "ident"      # (tag, locality)
+_PARCEL = "parcel"    # (tag, action_name, args, kwargs, req_id, src_loc)
+_RESULT = "result"    # (tag, req_id, ok, payload)
+
+
+class Runtime:
+    def __init__(self, cfg: Configuration) -> None:
+        self.cfg = cfg
+        self.locality = cfg.get_int("hpx.locality", 0)
+        self.num_localities = cfg.get_int("hpx.localities", 1)
+        self._endpoint = None
+        self._peer_of_loc: Dict[int, int] = {}
+        self._loc_of_peer: Dict[int, int] = {}
+        self._routes_cv = threading.Condition()
+        self._table: Dict[int, Tuple[str, int]] = {}
+        self._table_ready = threading.Event()
+        self._hellos: Dict[int, Tuple[str, int]] = {}
+        self._boot_lock = threading.Lock()
+        self._pending: Dict[int, SharedState] = {}
+        self._pending_lock = threading.Lock()
+        self._next_req = 0
+        self._wire_lock = threading.Lock()
+        self._stopped = False
+        self._inflight = 0            # parcel handlers not yet replied
+        self._inflight_cv = threading.Condition()
+
+        if self.num_localities > 1:
+            self._bootstrap()
+
+    # -- bootstrap ----------------------------------------------------------
+    def _bootstrap(self) -> None:
+        from ..native.loader import NetEndpoint
+
+        root_host = self.cfg.get("hpx.parcel.address", "127.0.0.1")
+        root_port = self.cfg.get_int("hpx.parcel.port", 7910)
+
+        if self.locality == 0:
+            self._endpoint = NetEndpoint(root_port, self._on_message)
+            with self._boot_lock:
+                self._hellos[0] = (root_host, self._endpoint.port)
+            # workers may all have said hello before our own entry landed
+            self._maybe_broadcast_table()
+        else:
+            self._endpoint = NetEndpoint(0, self._on_message)
+            # dial the console; retry while it boots
+            deadline = time.monotonic() + self.cfg.get_float(
+                "hpx.startup_timeout", 30.0)
+            while True:
+                try:
+                    pid = self._endpoint.connect(root_host, root_port)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise NetworkError(
+                            f"cannot reach console at {root_host}:{root_port}")
+                    time.sleep(0.05)
+            self._add_route(0, pid)
+            self._send_raw(pid, (_HELLO, self.locality,
+                                 self._endpoint.port))
+
+        if not self._table_ready.wait(self.cfg.get_float(
+                "hpx.startup_timeout", 30.0)):
+            raise HpxError(Error.startup_timed_out,
+                           f"locality {self.locality}: bootstrap timed out")
+
+        # full mesh: dial every lower-numbered peer we aren't wired to
+        for loc, (host, port) in sorted(self._table.items()):
+            if loc >= self.locality or loc in self._peer_of_loc:
+                continue
+            pid = self._endpoint.connect(host, port)
+            self._add_route(loc, pid)
+            self._send_raw(pid, (_IDENT, self.locality))
+
+    # -- wire ---------------------------------------------------------------
+    def _send_raw(self, peer_id: int, msg: Any) -> None:
+        self._endpoint.send(peer_id, serialize(msg))
+
+    def _add_route(self, loc: int, peer_id: int) -> None:
+        with self._routes_cv:
+            self._peer_of_loc[loc] = peer_id
+            self._loc_of_peer[peer_id] = loc
+            self._routes_cv.notify_all()
+
+    def _send_to_locality(self, loc: int, msg: Any) -> None:
+        pid = self._peer_of_loc.get(loc)
+        if pid is None:
+            # Bootstrap race: higher-numbered localities dial us at their
+            # own pace — wait for the route instead of failing a send
+            # issued right after init.
+            with self._routes_cv:
+                if not self._routes_cv.wait_for(
+                        lambda: loc in self._peer_of_loc,
+                        self.cfg.get_float("hpx.route_timeout", 30.0)):
+                    raise NetworkError(f"no route to locality {loc}")
+                pid = self._peer_of_loc[loc]
+        self._send_raw(pid, msg)
+
+    def _on_message(self, peer_id: int, data: bytes) -> None:
+        """Runs on the IO thread: decode, then dispatch cheaply."""
+        try:
+            msg = deserialize(data)
+        except Exception:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            return
+        tag = msg[0]
+        if tag == _PARCEL:
+            self._handle_parcel(msg)
+        elif tag == _RESULT:
+            _tag, req_id, ok, payload = msg
+            with self._pending_lock:
+                st = self._pending.pop(req_id, None)
+            if st is not None:
+                if ok:
+                    st.set_value(payload)
+                else:
+                    st.set_exception(payload)
+        elif tag == _HELLO:
+            _tag, loc, port = msg
+            self._add_route(loc, peer_id)
+            with self._boot_lock:
+                self._hellos[loc] = ("127.0.0.1", port)
+            self._maybe_broadcast_table()
+        elif tag == _TABLE:
+            self._table = msg[1]
+            self._table_ready.set()
+        elif tag == _IDENT:
+            self._add_route(msg[1], peer_id)
+
+    def _maybe_broadcast_table(self) -> None:
+        with self._boot_lock:
+            if (self._table_ready.is_set()
+                    or len(self._hellos) != self.num_localities):
+                return
+            self._table = dict(self._hellos)
+        for wloc, wpid in list(self._peer_of_loc.items()):
+            if wloc != 0:
+                self._send_raw(wpid, (_TABLE, self._table))
+        self._table_ready.set()
+
+    def _reply(self, src_loc: int, req_id, ok: bool, value) -> None:
+        try:
+            self._send_to_locality(src_loc, (_RESULT, req_id, ok, value))
+        except Exception as e:  # noqa: BLE001
+            if self._stopped:
+                return
+            # unserializable result/exception: the caller must still be
+            # unblocked — send a stringified error instead of dropping
+            try:
+                err = HpxError(Error.serialization_error,
+                               f"result not serializable: {e!r}; "
+                               f"value was {value!r:.200}")
+                self._send_to_locality(src_loc, (_RESULT, req_id, False, err))
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
+
+    def _handle_parcel(self, msg) -> None:
+        _tag, action_name, args, kwargs, req_id, src_loc = msg
+        with self._inflight_cv:
+            self._inflight += 1
+
+        def done() -> None:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+        def run() -> None:
+            try:
+                fn = resolve_action(action_name)
+                value = fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                if req_id is not None:
+                    self._reply(src_loc, req_id, False, e)
+                done()
+                return
+            if isinstance(value, Future):
+                # continuation, NOT a blocking get(): a wait-style action
+                # (agas rendezvous) must not pin a pool thread, or T such
+                # parcels deadlock a T-thread pool
+                def on_ready(f: Future) -> None:
+                    try:
+                        if req_id is not None:
+                            if f.has_exception():
+                                try:
+                                    f.get()
+                                except BaseException as e:  # noqa: BLE001
+                                    self._reply(src_loc, req_id, False, e)
+                            else:
+                                self._reply(src_loc, req_id, True, f.get())
+                    finally:
+                        done()
+                value.then(on_ready)
+                return
+            if req_id is not None:
+                self._reply(src_loc, req_id, True, value)
+            done()
+
+        # scheduled execution on the task pool (HPX: parcel decode
+        # schedules an HPX thread; 'direct' actions could run inline but
+        # the IO thread must stay responsive)
+        from ..runtime.threadpool import default_pool
+        default_pool().submit(run)
+
+    # -- public -------------------------------------------------------------
+    def send_action(self, action: Any, locality: int, args: tuple,
+                    kwargs: dict, want_result: bool) -> Optional[Future]:
+        name = action.name if isinstance(action, Action) else str(action)
+        if locality == self.locality:
+            # local fast path: no serialization (AGAS cache hit analog)
+            from ..futures.async_ import async_, post
+            fn = resolve_action(name)
+            if want_result:
+                return async_(fn, *args, **kwargs)
+            post(fn, *args, **kwargs)
+            return None
+        if locality < 0 or locality >= self.num_localities:
+            raise HpxError(Error.bad_parameter,
+                           f"no such locality: {locality}")
+        req_id = None
+        fut = None
+        if want_result:
+            st: SharedState = SharedState()
+            with self._pending_lock:
+                req_id = self._next_req
+                self._next_req += 1
+                self._pending[req_id] = st
+            fut = Future(st)
+        self._send_to_locality(
+            locality, (_PARCEL, name, args, kwargs, req_id, self.locality))
+        return fut
+
+    def barrier(self, tag: str = "default") -> None:
+        """Release barrier: every locality's arrive-action on the console
+        completes only when all have arrived (and_gate on the console —
+        the reference's collectives barrier shape, SURVEY.md §3.6; the
+        full collectives module arrives with M7)."""
+        if self.num_localities == 1:
+            return
+        from .actions import async_action
+        async_action("hpx.barrier_arrive", 0, tag,
+                     self.num_localities).get(
+            self.cfg.get_float("hpx.route_timeout", 30.0) * 2)
+
+    def finalize(self) -> None:
+        """Orderly shutdown: barrier first so no locality closes its
+        endpoint while peers still await replies (the classic shutdown-
+        ordering trap — SURVEY.md §7)."""
+        if self._stopped:
+            return
+        if self.num_localities > 1:
+            try:
+                self.barrier("__finalize__")
+            except Exception:  # noqa: BLE001 — close anyway
+                pass
+            # drain: replies to peers (e.g. their barrier releases) may
+            # still be queued on the pool — closing now would strand them
+            with self._inflight_cv:
+                self._inflight_cv.wait_for(
+                    lambda: self._inflight == 0,
+                    self.cfg.get_float("hpx.shutdown_timeout", 10.0))
+        self._stopped = True
+        if self._endpoint is not None:
+            self._endpoint.close()
+
+
+_runtime: Optional[Runtime] = None
+_runtime_lock = threading.Lock()
+
+
+def init(argv: Optional[list] = None,
+         overrides: Optional[dict] = None) -> Runtime:
+    """hpx::init analog (explicit; single-locality implicit via
+    get_runtime)."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            return _runtime
+        cfg = Configuration(argv=argv, overrides=overrides)
+        set_runtime_config(cfg)
+        _runtime = Runtime(cfg)
+        return _runtime
+
+
+def get_runtime() -> Runtime:
+    global _runtime
+    if _runtime is None:
+        with _runtime_lock:
+            if _runtime is None:
+                _runtime = Runtime(runtime_config())
+    return _runtime
+
+
+def finalize() -> None:
+    """hpx::finalize analog."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            _runtime.finalize()
+            _runtime = None
+            set_runtime_config(None)
+
+
+# -- locality API (hpx::find_here etc.) -------------------------------------
+
+def find_here() -> int:
+    return get_runtime().locality
+
+
+def find_all_localities() -> list:
+    return list(range(get_runtime().num_localities))
+
+
+def find_remote_localities() -> list:
+    rt = get_runtime()
+    return [i for i in range(rt.num_localities) if i != rt.locality]
+
+def find_root_locality() -> int:
+    return 0
+
+
+def get_num_localities() -> int:
+    return get_runtime().num_localities
+
+
+# -- console-side barrier state (release barrier) ---------------------------
+
+_barrier_lock = threading.Lock()
+_barrier_state: Dict[str, list] = {}  # tag -> [count, [SharedStates]]
+
+
+def _barrier_arrive(tag: str, n: int):
+    """Console action: returns a future released when n arrivals reached.
+
+    Each generation of a tag is independent: once released, the state is
+    cleared so the same tag can barrier again."""
+    st = SharedState()
+    with _barrier_lock:
+        count, waiters = _barrier_state.setdefault(tag, [0, []])
+        _barrier_state[tag][0] += 1
+        waiters.append(st)
+        if _barrier_state[tag][0] >= n:
+            released = waiters[:]
+            del _barrier_state[tag]
+        else:
+            released = None
+    if released:
+        for w in released:
+            w.set_value(True)
+    return Future(st)
+
+
+from .actions import plain_action as _pa  # noqa: E402
+_pa(_barrier_arrive, name="hpx.barrier_arrive")

@@ -1,0 +1,121 @@
+"""Parcel serialization.
+
+Reference analog: libs/core/serialization (input/output archives with
+zero-copy `serialize_buffer` chunks for large arrays). TPU-first shape:
+pickle protocol 5 with out-of-band buffers — numpy arrays travel as raw
+buffer chunks after the pickle stream (no copy into the pickle), the
+direct analog of HPX's zero-copy chunk vector. jax.Arrays are converted
+to host numpy for the wire (bulk device data should ride ICI collectives
+instead — the parcel plane is the control plane) and restored as device
+arrays on the receiving side.
+
+Wire format: u32 LE count | u64 LE sizes... | pickle bytes | raw buffers.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+
+class _JaxArrayMarker:
+    """Round-trips a jax.Array through numpy across the wire."""
+
+    __slots__ = ("np_value",)
+
+    def __init__(self, np_value) -> None:
+        self.np_value = np_value
+
+    def restore(self):
+        import jax.numpy as jnp
+        return jnp.asarray(self.np_value)
+
+
+def _rebuild_seq(obj, converted: list):
+    """Rebuild a list/tuple preserving subclass (incl. namedtuples)."""
+    if isinstance(obj, tuple):
+        cls = type(obj)
+        if hasattr(cls, "_make"):     # namedtuple
+            return cls._make(converted)
+        if cls is tuple:
+            return tuple(converted)
+        try:
+            return cls(converted)
+        except TypeError:
+            return tuple(converted)
+    return converted
+
+
+def _map_tree(obj: Any, leaf) -> Any:
+    """Deep map that returns obj UNCHANGED (same identity) when no leaf
+    conversion happened — pickle then round-trips exotic containers
+    untouched."""
+    new = leaf(obj)
+    if new is not obj:
+        return new
+    if isinstance(obj, (list, tuple)):
+        converted = [_map_tree(x, leaf) for x in obj]
+        if all(a is b for a, b in zip(converted, obj)):
+            return obj
+        return _rebuild_seq(obj, converted)
+    if isinstance(obj, dict):
+        converted = {k: _map_tree(v, leaf) for k, v in obj.items()}
+        if all(converted[k] is obj[k] for k in obj):
+            return obj
+        return converted
+    return obj
+
+
+def _encode_jax(obj: Any) -> Any:
+    """Deep-convert jax arrays (the only non-picklable payload we bless)."""
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return _JaxArrayMarker(np.asarray(x))
+        return x
+
+    return _map_tree(obj, leaf)
+
+
+def _decode_jax(obj: Any) -> Any:
+    def leaf(x):
+        if isinstance(x, _JaxArrayMarker):
+            return x.restore()
+        return x
+
+    return _map_tree(obj, leaf)
+
+
+def serialize(obj: Any) -> bytes:
+    buffers: List[pickle.PickleBuffer] = []
+    payload = pickle.dumps(_encode_jax(obj), protocol=5,
+                           buffer_callback=buffers.append)
+    raws = [b.raw() for b in buffers]
+    header = struct.pack("<I", len(raws)) + b"".join(
+        struct.pack("<Q", len(r)) for r in raws)
+    # pickle length so the decoder can split
+    header += struct.pack("<Q", len(payload))
+    return header + payload + b"".join(bytes(r) for r in raws)
+
+
+def deserialize(data: bytes) -> Any:
+    off = 0
+    (nbuf,) = struct.unpack_from("<I", data, off)
+    off += 4
+    sizes = []
+    for _ in range(nbuf):
+        (s,) = struct.unpack_from("<Q", data, off)
+        sizes.append(s)
+        off += 8
+    (plen,) = struct.unpack_from("<Q", data, off)
+    off += 8
+    payload = data[off:off + plen]
+    off += plen
+    buffers = []
+    for s in sizes:
+        buffers.append(data[off:off + s])
+        off += s
+    return _decode_jax(pickle.loads(payload, buffers=buffers))

@@ -175,3 +175,85 @@ class NativePool:
             self.shutdown()
         except Exception:
             pass
+
+
+# -- TCP parcel transport binding -------------------------------------------
+
+_NET_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int,
+                           ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64)
+
+
+def _bind_net(lib: ctypes.CDLL) -> None:
+    if getattr(lib, "_net_bound", False):
+        return
+    lib.hpxrt_net_create.restype = ctypes.c_void_p
+    lib.hpxrt_net_create.argtypes = [ctypes.c_uint16]
+    lib.hpxrt_net_port.restype = ctypes.c_uint16
+    lib.hpxrt_net_port.argtypes = [ctypes.c_void_p]
+    lib.hpxrt_net_set_callback.argtypes = [ctypes.c_void_p, _NET_CB,
+                                           ctypes.c_void_p]
+    lib.hpxrt_net_start.argtypes = [ctypes.c_void_p]
+    lib.hpxrt_net_connect.restype = ctypes.c_int
+    lib.hpxrt_net_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint16]
+    lib.hpxrt_net_send.restype = ctypes.c_int
+    lib.hpxrt_net_send.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.c_char_p, ctypes.c_uint64]
+    lib.hpxrt_net_destroy.argtypes = [ctypes.c_void_p]
+    lib._net_bound = True
+
+
+class NetEndpoint:
+    """Framed TCP endpoint over the native epoll transport.
+
+    on_message(peer_id, bytes) is invoked on the IO thread (under the
+    GIL); keep it cheap — the parcel layer enqueues to the task pool.
+    """
+
+    def __init__(self, port: int = 0,
+                 on_message: Optional[Callable[[int, bytes], None]] = None):
+        lib = native_lib()
+        if lib is None:
+            raise RuntimeError("native runtime library unavailable")
+        _bind_net(lib)
+        self._lib = lib
+        self._h = lib.hpxrt_net_create(port)
+        if not self._h:
+            raise OSError(f"cannot listen on 127.0.0.1:{port}")
+        self.on_message = on_message
+
+        def _cb(_user, peer_id, data, length):
+            payload = ctypes.string_at(data, length)
+            handler = self.on_message
+            if handler is not None:
+                handler(peer_id, payload)
+
+        self._cb = _NET_CB(_cb)
+        lib.hpxrt_net_set_callback(self._h, self._cb, None)
+        lib.hpxrt_net_start(self._h)
+        self._closed = False
+
+    @property
+    def port(self) -> int:
+        if self._closed:
+            raise OSError("endpoint closed")
+        return int(self._lib.hpxrt_net_port(self._h))
+
+    def connect(self, host: str, port: int) -> int:
+        if self._closed:
+            raise OSError("endpoint closed")
+        pid = self._lib.hpxrt_net_connect(self._h, host.encode(), port)
+        if pid < 0:
+            raise OSError(f"connect to {host}:{port} failed")
+        return pid
+
+    def send(self, peer_id: int, data: bytes) -> None:
+        if self._closed:
+            raise OSError("endpoint closed")
+        if self._lib.hpxrt_net_send(self._h, peer_id, data, len(data)) != 0:
+            raise OSError(f"send to peer {peer_id} failed")
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.hpxrt_net_destroy(self._h)

@@ -1,0 +1,300 @@
+// Native TCP parcel transport.
+//
+// Reference analog: the parcelport layer (libs/full/parcelset +
+// plugins/parcelport/tcp; the fork's libfabric parcelport is the RDMA
+// sibling) — re-designed for the TPU runtime's control plane: bulk data
+// rides ICI via XLA collectives, so this transport carries parcels
+// (serialized actions, AGAS traffic, host-side collective rendezvous),
+// which are small and latency-sensitive. Design:
+//   * one epoll IO thread per endpoint: accepts, reads 4-byte-LE length
+//     prefixed frames, invokes a callback (the Python binding re-enters
+//     the interpreter under the GIL and enqueues the parcel)
+//   * sends happen on the caller's thread over a per-peer mutex —
+//     blocking socket writes; fine for control-plane message sizes
+//   * peers are small integer ids assigned by hpxrt_net_connect /
+//     accept order; the handshake protocol above this (loader.py) maps
+//     them to locality ids.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// cb(user, peer_id, data, len): data valid only during the call
+typedef void (*hpxrt_net_cb)(void* user, int peer_id, const uint8_t* data,
+                             uint64_t len);
+}
+
+namespace {
+
+struct Peer {
+  int fd = -1;           // guarded by send_mu for close-vs-send races
+  std::mutex send_mu;
+  // receive reassembly (IO thread only)
+  std::vector<uint8_t> buf;
+};
+
+struct Net {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;
+  uint16_t port = 0;
+  std::thread io;
+  std::atomic<bool> stop{false};
+  hpxrt_net_cb cb = nullptr;
+  void* cb_user = nullptr;
+
+  std::mutex peers_mu;
+  std::map<int, std::shared_ptr<Peer>> peers;
+  int next_peer = 0;
+
+  int add_peer(int fd) {
+    auto p = std::make_shared<Peer>();
+    p->fd = fd;
+    int id;
+    {
+      std::lock_guard<std::mutex> lk(peers_mu);
+      id = next_peer++;
+      peers[id] = p;
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd;
+    // map fd->peer id via events on fd; store id in u64 alongside
+    ev.data.u64 = (static_cast<uint64_t>(id) << 32) | static_cast<uint32_t>(fd);
+    epoll_ctl(epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+    return id;
+  }
+
+  std::shared_ptr<Peer> get_peer(int id) {
+    std::lock_guard<std::mutex> lk(peers_mu);
+    auto it = peers.find(id);
+    return it == peers.end() ? nullptr : it->second;
+  }
+
+  void drop_peer_by_fd(int fd) {
+    std::shared_ptr<Peer> victim;
+    {
+      std::lock_guard<std::mutex> lk(peers_mu);
+      for (auto it = peers.begin(); it != peers.end(); ++it) {
+        if (it->second->fd == fd) {
+          victim = it->second;
+          peers.erase(it);
+          break;
+        }
+      }
+    }
+    if (victim) {
+      // a sender may be mid-writev: take its send mutex before closing,
+      // and mark fd invalid so later sends fail cleanly instead of
+      // writing into a recycled fd number
+      std::lock_guard<std::mutex> lk(victim->send_mu);
+      close(victim->fd);
+      victim->fd = -1;
+    }
+  }
+
+  void io_loop() {
+    std::vector<epoll_event> events(64);
+    std::vector<uint8_t> rdbuf(1 << 16);
+    while (!stop.load(std::memory_order_relaxed)) {
+      int n = epoll_wait(epoll_fd, events.data(),
+                         static_cast<int>(events.size()), 200);
+      for (int i = 0; i < n; ++i) {
+        int fd = static_cast<uint32_t>(events[i].data.u64 & 0xffffffffu);
+        int pid = static_cast<int>(events[i].data.u64 >> 32);
+        if (fd == wake_fd) {
+          uint64_t tmp;
+          (void)!read(wake_fd, &tmp, sizeof(tmp));
+          continue;
+        }
+        if (fd == listen_fd) {
+          for (;;) {
+            int cfd = accept(listen_fd, nullptr, nullptr);
+            if (cfd < 0) break;
+            int one = 1;
+            setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            add_peer(cfd);
+          }
+          continue;
+        }
+        // data on a peer socket
+        ssize_t r = read(fd, rdbuf.data(), rdbuf.size());
+        if (r <= 0) {
+          if (r == 0 || (errno != EAGAIN && errno != EINTR)) {
+            epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+            drop_peer_by_fd(fd);
+          }
+          continue;
+        }
+        auto p = get_peer(pid);
+        if (!p) continue;
+        p->buf.insert(p->buf.end(), rdbuf.data(), rdbuf.data() + r);
+        // extract complete frames
+        size_t off = 0;
+        while (p->buf.size() - off >= 4) {
+          uint32_t len;
+          std::memcpy(&len, p->buf.data() + off, 4);
+          if (p->buf.size() - off - 4 < len) break;
+          if (cb) cb(cb_user, pid, p->buf.data() + off + 4, len);
+          off += 4 + len;
+        }
+        if (off) p->buf.erase(p->buf.begin(), p->buf.begin() + off);
+      }
+    }
+  }
+};
+
+int make_listener(uint16_t* port) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(*port);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(fd, 64) < 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  *port = ntohs(addr.sin_port);
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create endpoint listening on 127.0.0.1:port (0 = ephemeral). Returns
+// handle or null.
+void* hpxrt_net_create(uint16_t port) {
+  auto* net = new Net();
+  net->port = port;
+  net->listen_fd = make_listener(&net->port);
+  if (net->listen_fd < 0) {
+    delete net;
+    return nullptr;
+  }
+  net->epoll_fd = epoll_create1(0);
+  net->wake_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = (0ull << 32) | static_cast<uint32_t>(net->listen_fd);
+  epoll_ctl(net->epoll_fd, EPOLL_CTL_ADD, net->listen_fd, &ev);
+  epoll_event wev{};
+  wev.events = EPOLLIN;
+  wev.data.u64 = (0ull << 32) | static_cast<uint32_t>(net->wake_fd);
+  epoll_ctl(net->epoll_fd, EPOLL_CTL_ADD, net->wake_fd, &wev);
+  return net;
+}
+
+uint16_t hpxrt_net_port(void* h) { return static_cast<Net*>(h)->port; }
+
+void hpxrt_net_set_callback(void* h, hpxrt_net_cb cb, void* user) {
+  auto* net = static_cast<Net*>(h);
+  net->cb = cb;
+  net->cb_user = user;
+}
+
+void hpxrt_net_start(void* h) {
+  auto* net = static_cast<Net*>(h);
+  net->io = std::thread([net] { net->io_loop(); });
+}
+
+// Connect to host:port; returns peer id (>=0) or -1.
+int hpxrt_net_connect(void* h, const char* host, uint16_t port) {
+  auto* net = static_cast<Net*>(h);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return net->add_peer(fd);
+}
+
+// Blocking framed send on the caller's thread. Returns 0 on success.
+int hpxrt_net_send(void* h, int peer_id, const uint8_t* data, uint64_t len) {
+  auto* net = static_cast<Net*>(h);
+  if (len > 0xffffffffull) return -1;  // u32 frame-length limit
+  auto p = net->get_peer(peer_id);
+  if (!p) return -1;
+  std::lock_guard<std::mutex> lk(p->send_mu);
+  if (p->fd < 0) return -1;            // peer dropped while we waited
+  uint32_t hdr = static_cast<uint32_t>(len);
+  struct iovec iov[2];
+  iov[0].iov_base = &hdr;
+  iov[0].iov_len = 4;
+  iov[1].iov_base = const_cast<uint8_t*>(data);
+  iov[1].iov_len = len;
+  size_t total = 4 + len;
+  size_t sent = 0;
+  while (sent < total) {
+    ssize_t w = writev(p->fd, iov, 2);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    sent += static_cast<size_t>(w);
+    // adjust iov for partial writes
+    size_t skip = static_cast<size_t>(w);
+    for (auto& v : iov) {
+      size_t s = std::min(skip, v.iov_len);
+      v.iov_base = static_cast<uint8_t*>(v.iov_base) + s;
+      v.iov_len -= s;
+      skip -= s;
+    }
+  }
+  return 0;
+}
+
+void hpxrt_net_destroy(void* h) {
+  auto* net = static_cast<Net*>(h);
+  net->stop.store(true);
+  uint64_t one = 1;
+  (void)!write(net->wake_fd, &one, sizeof(one));
+  if (net->io.joinable()) net->io.join();
+  {
+    std::lock_guard<std::mutex> lk(net->peers_mu);
+    for (auto& kv : net->peers) {
+      std::lock_guard<std::mutex> slk(kv.second->send_mu);
+      close(kv.second->fd);
+      kv.second->fd = -1;
+    }
+    net->peers.clear();
+  }
+  close(net->listen_fd);
+  close(net->epoll_fd);
+  close(net->wake_fd);
+  delete net;
+}
+
+}  // extern "C"

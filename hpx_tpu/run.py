@@ -1,0 +1,81 @@
+"""Multi-locality launcher — the hpxrun.py analog.
+
+Reference analog: cmake/templates/hpxrun.py.in (launch N OS processes on
+localhost wired via the TCP parcelport — SURVEY.md §4).
+
+    python -m hpx_tpu.run -l 4 [-t 2] script.py [script args...]
+
+Spawns N copies of script.py with HPX_TPU_LOCALITY/LOCALITIES/PARCEL__*
+env vars set; locality 0 shares the console port with everyone. Exit
+status is the max of the children's (HPX convention: nonzero = failures).
+Children default to the CPU jax platform (multi-process dev harness —
+the real-TPU path is single-process per host, as on actual pods).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+from typing import List
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(script: str, script_args: List[str], localities: int,
+           threads: int = 0, jax_platform: str = "cpu",
+           timeout: float = 300.0) -> int:
+    port = _free_port()
+    procs = []
+    for loc in range(localities):
+        env = dict(os.environ)
+        env["HPX_TPU_LOCALITY"] = str(loc)
+        env["HPX_TPU_LOCALITIES"] = str(localities)
+        env["HPX_TPU_PARCEL__PORT"] = str(port)
+        if threads:
+            env["HPX_TPU_OS_THREADS"] = str(threads)
+        if jax_platform:
+            env["JAX_PLATFORMS"] = jax_platform
+        procs.append(subprocess.Popen(
+            [sys.executable, script, *script_args], env=env))
+    rc = 0
+    try:
+        for p in procs:
+            try:
+                p.wait(timeout=timeout)
+                code = p.returncode or 0
+                # signal deaths are negative — report as failure, not 0
+                rc = max(rc, code if code > 0 else (1 if code else 0))
+            except subprocess.TimeoutExpired:
+                rc = max(rc, 1)   # hung locality counts as failure
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                rc = max(rc, 1)
+    return rc
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="hpx_tpu.run")
+    ap.add_argument("-l", "--localities", type=int, default=2)
+    ap.add_argument("-t", "--threads", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args()
+    sys.exit(launch(ns.script, ns.script_args, ns.localities, ns.threads,
+                    ns.platform, ns.timeout))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Multi-locality smoke workload (run under hpx_tpu.run).
+
+Exercises: bootstrap, remote actions with results and exceptions, AGAS
+register/resolve rendezvous, fire-and-forget, barrier. Exit code 0 on
+success per locality (the launcher maxes them).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import hpx_tpu as hpx
+from hpx_tpu.dist import agas
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+
+@hpx.plain_action
+def square(x):
+    return x * x
+
+
+@hpx.plain_action
+def whoami():
+    return hpx.find_here()
+
+
+@hpx.plain_action
+def fail_with(msg):
+    raise ValueError(msg)
+
+
+def main() -> int:
+    rt = hpx.init()
+    here = hpx.find_here()
+    n = hpx.get_num_localities()
+    HPX_TEST(n >= 2, "need multiple localities")
+
+    # every locality calls an action on every other
+    futs = [hpx.async_action(square, loc, here * 10 + loc)
+            for loc in hpx.find_all_localities()]
+    for loc, f in enumerate(futs):
+        HPX_TEST_EQ(f.get(timeout=30.0), (here * 10 + loc) ** 2)
+
+    # identity: remote action runs remotely
+    for loc in hpx.find_remote_localities():
+        HPX_TEST_EQ(hpx.async_action(whoami, loc).get(timeout=30.0), loc)
+
+    # exceptions propagate across the wire
+    try:
+        hpx.async_action(fail_with, (here + 1) % n, f"boom-{here}").get(
+            timeout=30.0)
+        HPX_TEST(False, "expected ValueError")
+    except ValueError as e:
+        HPX_TEST_EQ(str(e), f"boom-{here}")
+
+    # AGAS rendezvous: everyone registers; everyone resolves everyone
+    agas.register_name(f"value/{here}", here * 100).get(timeout=30.0)
+    for loc in hpx.find_all_localities():
+        got = agas.resolve_name(f"value/{loc}", wait=True).get(timeout=30.0)
+        HPX_TEST_EQ(got, loc * 100)
+
+    rt.barrier("smoke-done")
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
