@@ -72,5 +72,16 @@ from .dist import (  # noqa: F401
 )
 from .dist import agas  # noqa: F401
 
-# Populated as milestones land (SURVEY.md §7): containers + segmented
-# algorithms (M6), collectives (M7), services (M9).
+# -- partitioned data + segmented algorithms (M6) ----------------------------
+from .containers import (  # noqa: F401
+    PartitionedVector, PartitionedVectorView, Segment,
+)
+from .dist.distribution_policies import (  # noqa: F401
+    ContainerLayout, container_layout, default_layout, target_layout,
+)
+
+# the HPX spelling
+partitioned_vector = PartitionedVector
+
+# Populated as milestones land (SURVEY.md §7): collectives (M7),
+# services (M9).

@@ -1,55 +1,76 @@
-"""Parallel algorithms (local surface).
+"""Parallel algorithms (local + segmented surface).
 
 Reference analog: libs/core/algorithms — the CPO set over execution
-policies. Segmented (distributed) overlays dispatch from the same entry
-points once containers are partitioned (M6, libs/full/segmented_algorithms
-analog).
+policies — plus libs/full/segmented_algorithms: the SAME entry points
+accept partitioned_vector arguments and dispatch the segmented overlay
+(segmented.py), exactly as HPX routes segmented iterators through
+segmented_iterator_traits. `preserves_shape` marks the algorithms whose
+result is a same-length range (rewrapped in the source's layout).
 """
 
-from .elementwise import (  # noqa: F401
-    copy,
-    copy_if,
-    copy_n,
-    fill,
-    fill_n,
-    for_each,
-    for_each_n,
-    for_loop,
-    generate,
-    generate_n,
-    transform,
-)
-from .reductions import (  # noqa: F401
-    all_of,
-    any_of,
-    count,
-    count_if,
-    equal,
-    find,
-    find_if,
-    max_element,
-    min_element,
-    minmax_element,
-    mismatch,
-    none_of,
-    reduce,
-    transform_reduce,
-)
-from .scans import (  # noqa: F401
-    adjacent_difference,
-    adjacent_find,
-    exclusive_scan,
-    inclusive_scan,
-    transform_exclusive_scan,
-    transform_inclusive_scan,
-)
-from .sorting import (  # noqa: F401
-    is_sorted,
-    merge,
-    partition,
-    reverse,
-    rotate,
-    sort,
-    stable_sort,
-    unique,
-)
+from . import elementwise as _ew
+from . import reductions as _red
+from . import scans as _sc
+from . import sorting as _so
+from .segmented import segmentable as _seg
+
+# -- elementwise (shape-preserving) ------------------------------------------
+for_each = _seg(_ew.for_each, preserves_shape=True)
+for_each_n = _seg(_ew.for_each_n)
+for_loop = _seg(_ew.for_loop)
+transform = _seg(_ew.transform, preserves_shape=True)
+copy = _seg(_ew.copy, preserves_shape=True)
+copy_n = _seg(_ew.copy_n)
+copy_if = _seg(_ew.copy_if)
+fill = _seg(_ew.fill, preserves_shape=True)
+fill_n = _seg(_ew.fill_n)
+generate = _seg(_ew.generate, preserves_shape=True)
+generate_n = _seg(_ew.generate_n)
+
+# -- reductions / searches (scalar results) ----------------------------------
+reduce = _seg(_red.reduce)
+transform_reduce = _seg(_red.transform_reduce)
+count = _seg(_red.count)
+count_if = _seg(_red.count_if)
+all_of = _seg(_red.all_of)
+any_of = _seg(_red.any_of)
+none_of = _seg(_red.none_of)
+min_element = _seg(_red.min_element)
+max_element = _seg(_red.max_element)
+minmax_element = _seg(_red.minmax_element)
+equal = _seg(_red.equal)
+mismatch = _seg(_red.mismatch)
+find = _seg(_red.find)
+find_if = _seg(_red.find_if)
+
+# -- scans (shape-preserving) ------------------------------------------------
+inclusive_scan = _seg(_sc.inclusive_scan, preserves_shape=True)
+exclusive_scan = _seg(_sc.exclusive_scan, preserves_shape=True)
+transform_inclusive_scan = _seg(_sc.transform_inclusive_scan,
+                                preserves_shape=True)
+transform_exclusive_scan = _seg(_sc.transform_exclusive_scan,
+                                preserves_shape=True)
+adjacent_difference = _seg(_sc.adjacent_difference, preserves_shape=True)
+adjacent_find = _seg(_sc.adjacent_find)
+
+# -- sorting / permutations --------------------------------------------------
+sort = _seg(_so.sort, preserves_shape=True)
+stable_sort = _seg(_so.stable_sort, preserves_shape=True)
+is_sorted = _seg(_so.is_sorted)
+merge = _seg(_so.merge)
+reverse = _seg(_so.reverse, preserves_shape=True)
+rotate = _seg(_so.rotate, preserves_shape=True)
+unique = _seg(_so.unique)
+partition = _seg(_so.partition)
+
+__all__ = [
+    "for_each", "for_each_n", "for_loop", "transform", "copy", "copy_n",
+    "copy_if", "fill", "fill_n", "generate", "generate_n",
+    "reduce", "transform_reduce", "count", "count_if",
+    "all_of", "any_of", "none_of", "min_element", "max_element",
+    "minmax_element", "equal", "mismatch", "find", "find_if",
+    "inclusive_scan", "exclusive_scan", "transform_inclusive_scan",
+    "transform_exclusive_scan", "adjacent_difference", "adjacent_find",
+    "sort", "stable_sort", "is_sorted", "merge", "reverse", "rotate",
+    "unique", "partition",
+]

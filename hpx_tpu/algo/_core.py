@@ -102,8 +102,13 @@ def host_bulk(policy: ExecutionPolicy, count: int,
 
 
 def to_numpy_view(rng: Any):
-    """Host path works on numpy views (zero-copy for arrays/lists copy)."""
+    """Host path works on numpy views (zero-copy for numpy input; device
+    arrays materialize as read-only views, so those are copied to keep
+    the mutate-in-place algorithms working)."""
     import numpy as np
     if isinstance(rng, np.ndarray):
         return rng
-    return np.asarray(rng)
+    arr = np.asarray(rng)
+    if not arr.flags.writeable:
+        arr = arr.copy()
+    return arr

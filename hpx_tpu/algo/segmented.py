@@ -1,0 +1,82 @@
+"""Segmented algorithm dispatch.
+
+Reference analog: libs/full/segmented_algorithms — when an algorithm
+receives segmented iterators (partitioned_vector), HPX splits it into
+per-segment local invocations (remote async to each segment's locality)
+plus a combine step, dispatched via segmented_iterator_traits.
+
+TPU-first collapse (SURVEY.md §7): the per-segment split IS the sharding.
+Unwrapping a PartitionedVector yields its sharded jax.Array; the existing
+device path then compiles ONE XLA program whose GSPMD partitioning runs
+each shard's slice on its own device and inserts the combine collectives
+(psum for reductions, all-to-all for sorts) over ICI. No per-segment
+remote calls, no fan-in component — the compiler does the segmentation.
+
+Shape-preserving algorithms rewrap the result in a PartitionedVector with
+the source layout (sharding is propagated by XLA, so the rewrap is
+zero-copy); reductions return scalars/host values unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+from ..containers.partitioned_vector import (
+    PartitionedVector,
+    PartitionedVectorView,
+)
+from ..futures.future import is_future
+
+
+def _rewrap(result: Any, src: PartitionedVector) -> Any:
+    """Wrap a same-length 1-D array result in a vector with src's layout.
+
+    Host-path results are numpy arrays — those rewrap too, so the
+    'shape-preserving algorithms return a PartitionedVector' contract
+    holds regardless of which execution path the policy selected.
+    """
+    shape = getattr(result, "shape", None)
+    if shape is not None and len(shape) == 1 and int(shape[0]) == src.size:
+        return PartitionedVector.from_array(result, src.layout)
+    return result
+
+
+def segmentable(fn: Callable, preserves_shape: bool = False) -> Callable:
+    """Add segmented-container dispatch to an algorithm entry point."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        src: Optional[PartitionedVector] = None
+        segmented = False
+        for a in args:
+            if isinstance(a, PartitionedVector):
+                src = src or a
+                segmented = True
+            elif isinstance(a, PartitionedVectorView):
+                segmented = True
+        for a in kwargs.values():
+            if isinstance(a, PartitionedVector):
+                src = src or a
+                segmented = True
+            elif isinstance(a, PartitionedVectorView):
+                segmented = True
+        if not segmented:
+            return fn(*args, **kwargs)
+        uargs = tuple(
+            a.valid_array() if isinstance(a, PartitionedVector)
+            else a.array() if isinstance(a, PartitionedVectorView) else a
+            for a in args)
+        ukw = {
+            k: (v.valid_array() if isinstance(v, PartitionedVector)
+                else v.array() if isinstance(v, PartitionedVectorView)
+                else v)
+            for k, v in kwargs.items()}
+        result = fn(*uargs, **ukw)
+        if not preserves_shape or src is None:
+            return result
+        if is_future(result):
+            return result.then(lambda f: _rewrap(f.get(), src))
+        return _rewrap(result, src)
+
+    return wrapper

@@ -1,0 +1,7 @@
+"""Distributed containers (components/containers analog)."""
+
+from .partitioned_vector import (  # noqa: F401
+    PartitionedVector,
+    PartitionedVectorView,
+    Segment,
+)

@@ -1,0 +1,268 @@
+"""partitioned_vector: the distributed container.
+
+Reference analog: components/containers/partitioned_vector — a vector
+split into partition components spread over localities per a distribution
+policy, with segmented iterators and named registration for multi-locality
+access (SURVEY.md §2.4).
+
+TPU-first design (SURVEY.md §7): a PartitionedVector is a mutable HANDLE
+over an immutable sharded jax.Array. The distribution policy fixes the
+NamedSharding; XLA/GSPMD owns byte placement and inserts any collectives.
+"Segments" are logical (index-range, device) views, not separate objects —
+there is no per-partition component server because the single-controller
+model addresses every shard directly. Segmented algorithms (algo/
+segmented.py) dispatch whole-container ops as ONE sharded XLA program,
+which is the shard_map/pjit equivalent of HPX's per-segment remote asyncs.
+
+Uneven sizes: jax shardings want divisible extents, so the backing array
+is padded up to a multiple of the partition count; `size` stays logical
+and `valid_array()` returns the unpadded prefix (a lazy device slice; a
+no-op view when the size divides evenly — the performance case).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional, Sequence, Union
+
+from ..dist.distribution_policies import ContainerLayout, default_layout
+
+
+@dataclass(frozen=True)
+class Segment:
+    """One logical partition: [begin, end) on a device.
+
+    The analog of HPX's segment iterator position: identifies which
+    partition and where it lives (partitioned_vector_segmented_iterator).
+    """
+    index: int
+    begin: int
+    end: int
+    device: Any
+
+    def __len__(self) -> int:
+        return self.end - self.begin
+
+
+class PartitionedVectorView:
+    """A contiguous sub-range view (partitioned_vector_view analog).
+
+    Used for SPMD-style sub-range access; algorithms accept views and
+    operate on the underlying device slice.
+    """
+
+    def __init__(self, pv: "PartitionedVector", begin: int, end: int) -> None:
+        begin = max(0, min(begin, pv.size))
+        end = max(begin, min(end, pv.size))
+        self.pv = pv
+        self.begin = begin
+        self.end = end
+
+    def array(self):
+        return self.pv.valid_array()[self.begin:self.end]
+
+    def __len__(self) -> int:
+        return self.end - self.begin
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(len(self))
+            if step != 1:
+                raise IndexError("views are contiguous (step must be 1)")
+            return PartitionedVectorView(
+                self.pv, self.begin + start, self.begin + stop)
+        return self.pv[self.begin + self._check(i)]
+
+    def _check(self, i: int) -> int:
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        return i
+
+    def to_numpy(self):
+        import numpy as np
+        return np.asarray(self.array())
+
+    def __repr__(self) -> str:
+        return f"<PartitionedVectorView [{self.begin}, {self.end}) of {self.pv!r}>"
+
+
+class PartitionedVector:
+    """hpx::partitioned_vector<T> analog over a sharded jax.Array."""
+
+    def __init__(self, size: int, value: Any = 0, dtype: Any = None,
+                 layout: Optional[ContainerLayout] = None) -> None:
+        import jax.numpy as jnp
+        self._layout = layout or default_layout()
+        self._size = int(size)
+        if dtype is None:
+            dtype = jnp.asarray(value).dtype if value is not None \
+                else jnp.float32
+        padded = self._padded_size(self._size, self._layout)
+        import jax
+        self._data = jax.device_put(
+            jnp.full((padded,), value, dtype=dtype),
+            self._layout.sharding())
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def _padded_size(n: int, layout: ContainerLayout) -> int:
+        p = max(layout.num_partitions, layout.axis_size)
+        return ((max(n, 1) + p - 1) // p) * p
+
+    @classmethod
+    def from_array(cls, arr: Any,
+                   layout: Optional[ContainerLayout] = None
+                   ) -> "PartitionedVector":
+        """Build from an existing 1-D array (host or device)."""
+        import jax
+        import jax.numpy as jnp
+        layout = layout or default_layout()
+        arr = jnp.asarray(arr)
+        if arr.ndim != 1:
+            raise ValueError("partitioned_vector is 1-D; got shape "
+                             f"{arr.shape}")
+        self = cls.__new__(cls)
+        self._layout = layout
+        self._size = int(arr.shape[0])
+        padded = cls._padded_size(self._size, layout)
+        if padded != self._size:
+            arr = jnp.pad(arr, (0, padded - self._size))
+        self._data = jax.device_put(arr, layout.sharding())
+        return self
+
+    # -- basic surface -------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def __len__(self) -> int:
+        return self._size
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def layout(self) -> ContainerLayout:
+        return self._layout
+
+    @property
+    def mesh(self):
+        return self._layout.mesh
+
+    @property
+    def num_partitions(self) -> int:
+        return self._layout.num_partitions
+
+    @property
+    def data(self):
+        """The backing (padded) sharded jax.Array."""
+        return self._data
+
+    def valid_array(self):
+        """The logical contents as a device array (lazy slice if padded)."""
+        if self._data.shape[0] == self._size:
+            return self._data
+        return self._data[:self._size]
+
+    def to_numpy(self):
+        import numpy as np
+        return np.asarray(self.valid_array())
+
+    # -- element access (get_value/set_value analogs) ------------------------
+    def get(self, i: int) -> Any:
+        """Synchronous element fetch (hpx::partitioned_vector::get_value)."""
+        return self._data[self._check(i)].item()
+
+    def get_async(self, i: int):
+        """get_value(launch::async) analog: Future of the element."""
+        from ..futures.future import make_ready_future
+        v = self._data[self._check(i)]
+        return make_ready_future(v)
+
+    def set(self, i: int, value: Any) -> None:
+        """set_value analog: functional update swapped into the handle."""
+        self._data = self._data.at[self._check(i)].set(value)
+
+    def _check(self, i: int) -> int:
+        if i < 0:
+            i += self._size
+        if not 0 <= i < self._size:
+            raise IndexError(i)
+        return i
+
+    def __getitem__(self, i: Union[int, slice]):
+        if isinstance(i, slice):
+            start, stop, step = i.indices(self._size)
+            if step != 1:
+                raise IndexError("views are contiguous (step must be 1)")
+            return PartitionedVectorView(self, start, stop)
+        return self.get(i)
+
+    def __setitem__(self, i: int, value: Any) -> None:
+        self.set(i, value)
+
+    def view(self, begin: int = 0,
+             end: Optional[int] = None) -> PartitionedVectorView:
+        return PartitionedVectorView(
+            self, begin, self._size if end is None else end)
+
+    # -- segments (segmented iterator surface) -------------------------------
+    def segments(self) -> Sequence[Segment]:
+        """Logical partitions with their devices, in index order."""
+        npart = self.num_partitions
+        chunk = self._data.shape[0] // npart
+        axis_devs = self._axis_devices()
+        out = []
+        for k in range(npart):
+            b, e = k * chunk, (k + 1) * chunk
+            b, e = min(b, self._size), min(e, self._size)
+            # NamedSharding places contiguous blocks: device d along the
+            # axis holds [d*P/A, (d+1)*P/A) of the padded extent
+            out.append(Segment(k, b, e,
+                               axis_devs[k * len(axis_devs) // npart]))
+        return out
+
+    def _axis_devices(self):
+        mesh = self._layout.mesh
+        axis_index = mesh.axis_names.index(self._layout.axis)
+        import numpy as np
+        devs = np.moveaxis(np.asarray(mesh.devices), axis_index, 0)
+        devs = devs.reshape(devs.shape[0], -1)
+        return [devs[k, 0] for k in range(devs.shape[0])]
+
+    def __iter__(self) -> Iterator[Any]:
+        import numpy as np
+        return iter(np.asarray(self.valid_array()))
+
+    # -- named registration (AGAS symbol namespace) --------------------------
+    def register_as(self, name: str):
+        """HPX_REGISTER_PARTITIONED_VECTOR + register_as analog: publish
+        this handle under a global name (returns Future[bool])."""
+        from ..dist import agas
+        return agas.register_name(f"containers/{name}", self)
+
+    @classmethod
+    def connect_to(cls, name: str, wait: bool = True) -> "PartitionedVector":
+        """connect_to analog: look up a registered vector by name."""
+        from ..dist import agas
+        return agas.resolve_name(f"containers/{name}", wait=wait).get()
+
+    def unregister(self, name: str):
+        from ..dist import agas
+        return agas.unregister_name(f"containers/{name}")
+
+    # -- misc ----------------------------------------------------------------
+    def copy(self) -> "PartitionedVector":
+        out = PartitionedVector.__new__(PartitionedVector)
+        out._layout = self._layout
+        out._size = self._size
+        out._data = self._data
+        return out
+
+    def __repr__(self) -> str:
+        return (f"<partitioned_vector size={self._size} dtype={self.dtype} "
+                f"partitions={self.num_partitions} axis="
+                f"'{self._layout.axis}'>")

@@ -1,0 +1,76 @@
+"""Distribution policies: where partitioned data lives on the mesh.
+
+Reference analog: libs/full/distribution_policies — `hpx::container_layout
+(num_partitions, localities)`, `default_layout`, `binpacking_distribution_
+policy`, `target_distribution_policy`. TPU-first reinterpretation: a
+"locality" for data placement is a mesh position; a layout names the mesh
+axis a container is sharded over and how many partitions it has. XLA/GSPMD
+then owns the actual byte placement — the policy only fixes the sharding
+spec, which is the whole game on TPU (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+
+class ContainerLayout:
+    """Maps a 1-D container onto a mesh axis.
+
+    num_partitions defaults to the axis size (one partition per device
+    along the axis) — HPX's `container_layout(localities)` default. A
+    partition count that's a multiple of the axis size gives several
+    blocks per device (HPX's `container_layout(n, localities)`).
+    """
+
+    def __init__(self, num_partitions: Optional[int] = None,
+                 mesh: Any = None, axis: str = "x",
+                 targets: Optional[Sequence[Any]] = None) -> None:
+        if mesh is None:
+            from ..parallel.mesh import make_mesh
+            devs = [t.device for t in targets] if targets else None
+            mesh = make_mesh((len(devs),) if devs else None, (axis,), devs)
+        self.mesh = mesh
+        self.axis = axis
+        axis_size = mesh.shape[axis]
+        self.num_partitions = int(num_partitions or axis_size)
+        if self.num_partitions % axis_size != 0 and \
+                axis_size % self.num_partitions != 0:
+            raise ValueError(
+                f"num_partitions={self.num_partitions} incompatible with "
+                f"mesh axis '{axis}' of size {axis_size}")
+
+    @property
+    def axis_size(self) -> int:
+        return int(self.mesh.shape[self.axis])
+
+    def sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def __repr__(self) -> str:
+        return (f"<ContainerLayout {self.num_partitions} partitions over "
+                f"axis '{self.axis}' of {self.mesh.shape}>")
+
+
+def container_layout(num_partitions: Optional[int] = None,
+                     mesh: Any = None, axis: str = "x",
+                     targets: Optional[Sequence[Any]] = None
+                     ) -> ContainerLayout:
+    """hpx::container_layout analog."""
+    return ContainerLayout(num_partitions, mesh, axis, targets)
+
+
+def default_layout(mesh: Any = None) -> ContainerLayout:
+    """hpx::container_layout() / default_distribution_policy analog: one
+    partition per device over the whole default mesh."""
+    return ContainerLayout(mesh=mesh)
+
+
+def target_layout(targets: Sequence[Any]) -> ContainerLayout:
+    """target_distribution_policy analog: place over explicit targets."""
+    return ContainerLayout(targets=targets)
