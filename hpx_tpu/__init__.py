@@ -83,5 +83,12 @@ from .dist.distribution_policies import (  # noqa: F401
 # the HPX spelling
 partitioned_vector = PartitionedVector
 
-# Populated as milestones land (SURVEY.md §7): collectives (M7),
+# -- collectives + channels (M7) ---------------------------------------------
+from . import collectives  # noqa: F401
+from .collectives import (  # noqa: F401
+    Communicator, create_communicator, create_channel_communicator,
+    ChannelCommunicator, DistributedChannel, DistributedLatch,
+)
+
+# Populated as milestones land (SURVEY.md §7): jacobi/block executor (M8),
 # services (M9).

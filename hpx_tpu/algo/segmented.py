@@ -49,15 +49,10 @@ def segmentable(fn: Callable, preserves_shape: bool = False) -> Callable:
     def wrapper(*args, **kwargs):
         src: Optional[PartitionedVector] = None
         segmented = False
-        for a in args:
+        for a in list(args) + list(kwargs.values()):
             if isinstance(a, PartitionedVector):
-                src = src or a
-                segmented = True
-            elif isinstance(a, PartitionedVectorView):
-                segmented = True
-        for a in kwargs.values():
-            if isinstance(a, PartitionedVector):
-                src = src or a
+                if src is None:     # `or` would skip empty (falsy) vectors
+                    src = a
                 segmented = True
             elif isinstance(a, PartitionedVectorView):
                 segmented = True

@@ -1,0 +1,29 @@
+"""Collectives (libs/full/collectives analog), two planes:
+
+  * host/control plane (communicator.py, channels.py): futures-based, any
+    payload, HPX's exact API and semantics;
+  * device/data plane (device.py): the same verbs compiled to XLA
+    collectives over ICI inside shard_map.
+"""
+
+from .communicator import (  # noqa: F401
+    Communicator,
+    create_communicator,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    exclusive_scan,
+    gather,
+    inclusive_scan,
+    reduce,
+    scatter,
+)
+from .channels import (  # noqa: F401
+    ChannelCommunicator,
+    DistributedChannel,
+    DistributedLatch,
+    create_channel_communicator,
+)
+from . import device  # noqa: F401

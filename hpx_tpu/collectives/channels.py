@@ -1,0 +1,204 @@
+"""Point-to-point channels over the distributed runtime.
+
+Reference analogs:
+  * channel_communicator (libs/full/collectives/.../channel_communicator.hpp):
+    p2p set/get between sites of a communicator, FIFO per (from, to) pair;
+  * hpx::distributed::channel (libs/full/lcos_distributed): a named
+    channel COMPONENT hosted on one locality, accessed from anywhere;
+  * hpx::distributed::latch (libs/full/collectives/latch.hpp).
+
+TPU-first shape: channel state lives on a hosting locality (root for the
+channel_communicator, the creating locality for distributed::channel) as
+plain lcos Channel objects; set/get travel as actions and return futures.
+This is control-plane messaging — bulk arrays should ride device.py
+collectives instead (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..dist.actions import async_action, plain_action
+from ..dist.runtime import find_here, get_num_localities
+from ..futures.future import Future, SharedState
+
+# ---------------------------------------------------------------------------
+# Mailbox state (hosted on the root/hosting locality): one local lcos
+# Channel per key — the same FIFO value/getter pairing, one implementation.
+# ---------------------------------------------------------------------------
+
+from ..lcos.local import Channel as _LocalChannel
+
+_lock = threading.Lock()
+_mailboxes: Dict[Tuple, _LocalChannel] = {}
+
+
+def _mailbox(key: Tuple) -> _LocalChannel:
+    with _lock:
+        return _mailboxes.setdefault(key, _LocalChannel())
+
+
+@plain_action(name="channels.put")
+def _put_action(key: Tuple, value: Any) -> bool:
+    _mailbox(key).set(value)
+    return True
+
+
+@plain_action(name="channels.get")
+def _get_action(key: Tuple) -> Future:
+    return _mailbox(key).get()   # parcel layer chains the continuation
+
+
+# ---------------------------------------------------------------------------
+# channel_communicator
+# ---------------------------------------------------------------------------
+
+class ChannelCommunicator:
+    """hpx::collectives::channel_communicator analog.
+
+    set(to, value) / get(from) between sites; FIFO per directed pair.
+    All mailboxes live on the root locality (the component host in HPX).
+    """
+
+    def __init__(self, basename: str, num_sites: Optional[int] = None,
+                 this_site: Optional[int] = None,
+                 root_locality: int = 0) -> None:
+        self.basename = basename
+        self.num_sites = (num_sites if num_sites is not None
+                          else get_num_localities())
+        self.this_site = (this_site if this_site is not None
+                          else find_here())
+        self.root_locality = root_locality
+
+    def _key(self, frm: int, to: int, tag: Optional[int]) -> Tuple:
+        return ("chan_comm", self.basename, frm, to, tag)
+
+    def set(self, to: int, value: Any, tag: Optional[int] = None) -> Future:
+        if not 0 <= to < self.num_sites:
+            raise IndexError(to)
+        return async_action(_put_action, self.root_locality,
+                            self._key(self.this_site, to, tag), value)
+
+    def get(self, frm: int, tag: Optional[int] = None) -> Future:
+        if not 0 <= frm < self.num_sites:
+            raise IndexError(frm)
+        return async_action(_get_action, self.root_locality,
+                            self._key(frm, self.this_site, tag))
+
+
+def create_channel_communicator(basename: str,
+                                num_sites: Optional[int] = None,
+                                this_site: Optional[int] = None,
+                                root_locality: int = 0
+                                ) -> ChannelCommunicator:
+    return ChannelCommunicator(basename, num_sites, this_site, root_locality)
+
+
+# ---------------------------------------------------------------------------
+# hpx::distributed::channel — a named channel hosted where it was created
+# ---------------------------------------------------------------------------
+
+class DistributedChannel:
+    """Named cross-locality channel (lcos_distributed analog).
+
+    The creator hosts the state and registers `(name -> host locality)`
+    in AGAS; `connect` resolves the host and routes set/get there.
+    """
+
+    def __init__(self, name: str, host_locality: int) -> None:
+        self.name = name
+        self.host_locality = host_locality
+
+    @classmethod
+    def create(cls, name: str) -> "DistributedChannel":
+        from ..dist import agas
+        here = find_here()
+        ok = agas.register_name(f"dchannel/{name}", here).get()
+        if not ok:
+            raise ValueError(f"channel name already registered: {name}")
+        return cls(name, here)
+
+    @classmethod
+    def connect(cls, name: str) -> "DistributedChannel":
+        from ..dist import agas
+        host = agas.resolve_name(f"dchannel/{name}", wait=True).get()
+        return cls(name, host)
+
+    def _key(self) -> Tuple:
+        return ("dchannel", self.name)
+
+    def set(self, value: Any) -> Future:
+        return async_action(_put_action, self.host_locality,
+                            self._key(), value)
+
+    def get(self) -> Future:
+        return async_action(_get_action, self.host_locality, self._key())
+
+    def unregister(self) -> None:
+        from ..dist import agas
+        agas.unregister_name(f"dchannel/{self.name}").get()
+
+
+# ---------------------------------------------------------------------------
+# hpx::distributed::latch
+# ---------------------------------------------------------------------------
+
+_latch_lock = threading.Lock()
+_latches: Dict[str, list] = {}  # name -> [arrived, released, [SharedStates]]
+
+
+@plain_action(name="channels.latch_arrive")
+def _latch_arrive(name: str, count: int, n: int, wait: bool):
+    """Hosted on root: accumulate arrivals; with wait, future released
+    once arrivals reach the threshold.
+
+    Arrival-count semantics (not remaining-count) make the exchange
+    order-independent: actions from concurrent localities — or from one
+    caller, reordered by the task pool — commute, and a wait landing
+    after release completes immediately. One-shot per name, matching
+    std::latch / hpx::distributed::latch."""
+    st = SharedState() if wait else None
+    released = None
+    with _latch_lock:
+        state = _latches.setdefault(name, [0, False, []])
+        state[0] += count
+        already_released = state[1]
+        if st is not None and not already_released:
+            state[2].append(st)
+        if not state[1] and state[0] >= n:
+            state[1] = True
+            released = state[2]
+            state[2] = []
+    if released is not None:
+        for w in released:
+            w.set_value(True)
+    if st is not None and already_released:
+        st.set_value(True)
+    if st is None:
+        return True
+    return Future(st)
+
+
+class DistributedLatch:
+    """hpx::distributed::latch: created with a threshold, counted down
+    from any locality; wait() completes when the count reaches zero.
+    One-shot per name (as std::latch is per instance)."""
+
+    def __init__(self, name: str, count: int,
+                 root_locality: int = 0) -> None:
+        self.name = name
+        self.count = count
+        self.root_locality = root_locality
+
+    def count_down(self, n: int = 1) -> Future:
+        return async_action(_latch_arrive, self.root_locality,
+                            self.name, n, self.count, False)
+
+    def arrive_and_wait(self, n: int = 1) -> Future:
+        return async_action(_latch_arrive, self.root_locality,
+                            self.name, n, self.count, True)
+
+    def wait(self) -> Future:
+        return async_action(_latch_arrive, self.root_locality,
+                            self.name, 0, self.count, True)

@@ -1,0 +1,249 @@
+"""Futures-based collectives over a communicator.
+
+Reference analog: libs/full/collectives — `create_communicator(basename,
+num_sites, this_site)` rendezvous, then `all_reduce / all_gather /
+all_to_all / broadcast / gather / scatter / reduce / inclusive_scan /
+exclusive_scan / barrier`, each returning a future. HPX implements these
+as a communicator COMPONENT on a root locality holding per-operation
+and_gate state; each participant contributes via action and receives a
+future of its per-site result (SURVEY.md §3.6 — O(P) star fan-in).
+
+TPU-first split (SURVEY.md §5.8): THIS module is the control-plane
+implementation — host values, small payloads, exact HPX semantics, any
+num_sites (sites may be threads within one locality or distinct
+localities; contributions travel as actions to the root). The DATA plane
+— bulk arrays over ICI — is collectives/device.py, where the same verbs
+compile to XLA collectives inside shard_map and never touch the host.
+
+Exceptions: an error raised while combining (e.g. a reducing op failing)
+propagates to every participating site's future.
+"""
+
+from __future__ import annotations
+
+import operator
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..dist.actions import async_action, plain_action
+from ..dist.runtime import find_here, get_num_localities
+from ..futures.future import Future, SharedState
+
+# ---------------------------------------------------------------------------
+# Root-side exchange state. One generic primitive: every site contributes a
+# value under (name, kind, generation); when the last arrives, a per-kind
+# combine computes each site's result and releases all futures.
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_exchanges: Dict[Tuple[str, str, int], dict] = {}
+
+
+def _combine(kind: str, contribs: Dict[int, Any], num_sites: int,
+             op: Optional[Callable], root: int) -> Dict[int, Any]:
+    values = [contribs[i] for i in range(num_sites)]
+    if kind == "all_reduce" or kind == "reduce":
+        acc = values[0]
+        for v in values[1:]:
+            acc = op(acc, v)
+        if kind == "reduce":
+            return {i: (acc if i == root else None)
+                    for i in range(num_sites)}
+        return {i: acc for i in range(num_sites)}
+    if kind == "all_gather":
+        return {i: list(values) for i in range(num_sites)}
+    if kind == "gather":
+        return {i: (list(values) if i == root else None)
+                for i in range(num_sites)}
+    if kind == "broadcast":
+        return {i: values[root] for i in range(num_sites)}
+    if kind == "scatter":
+        parts = values[root]
+        if len(parts) != num_sites:
+            raise ValueError(
+                f"scatter: root provided {len(parts)} parts for "
+                f"{num_sites} sites")
+        return {i: parts[i] for i in range(num_sites)}
+    if kind == "all_to_all":
+        for i, v in enumerate(values):
+            if len(v) != num_sites:
+                raise ValueError(
+                    f"all_to_all: site {i} provided {len(v)} parts for "
+                    f"{num_sites} sites")
+        return {i: [values[j][i] for j in range(num_sites)]
+                for i in range(num_sites)}
+    if kind == "inclusive_scan":
+        out, acc = {}, None
+        for i, v in enumerate(values):
+            acc = v if acc is None else op(acc, v)
+            out[i] = acc
+        return out
+    if kind == "exclusive_scan":
+        # site i gets the fold of sites [0, i); site 0 has no prefix
+        out, acc = {0: None}, None
+        for i in range(1, num_sites):
+            acc = values[i - 1] if acc is None else op(acc, values[i - 1])
+            out[i] = acc
+        return out
+    if kind == "barrier":
+        return {i: True for i in range(num_sites)}
+    raise ValueError(f"unknown collective kind: {kind}")
+
+
+@plain_action(name="collectives.contribute")
+def _contribute(name: str, kind: str, gen: int, site: int, num_sites: int,
+                value: Any, op: Optional[Callable], root: int):
+    """Root action: register a contribution; future completes when all
+    sites have arrived (and_gate) with this site's combined result."""
+    key = (name, kind, gen)
+    st = SharedState()
+    with _lock:
+        ex = _exchanges.setdefault(key, {"contribs": {}, "waiters": {}})
+        if site in ex["contribs"]:
+            raise ValueError(
+                f"duplicate contribution from site {site} to {key}")
+        ex["contribs"][site] = value
+        ex["waiters"][site] = st
+        complete = len(ex["contribs"]) == num_sites
+        if complete:
+            del _exchanges[key]
+    if complete:
+        try:
+            results = _combine(kind, ex["contribs"], num_sites, op, root)
+        except BaseException as e:  # noqa: BLE001 — fan the error out
+            for w in ex["waiters"].values():
+                w.set_exception(e)
+            return Future(st)
+        for s, w in ex["waiters"].items():
+            w.set_value(results[s])
+    return Future(st)
+
+
+# ---------------------------------------------------------------------------
+# Client surface
+# ---------------------------------------------------------------------------
+
+class Communicator:
+    """hpx::collectives::communicator analog.
+
+    The HPX component + AGAS-symbol rendezvous collapses: the communicator
+    is fully described by (basename, num_sites, this_site, root locality),
+    so creation is immediate and the rendezvous happens implicitly at the
+    first exchange (the and_gate on the root). Generations are tracked
+    per operation kind — every site must issue the same sequence of calls
+    on a given communicator, the same contract HPX has.
+    """
+
+    def __init__(self, basename: str, num_sites: Optional[int] = None,
+                 this_site: Optional[int] = None,
+                 root_locality: int = 0) -> None:
+        self.basename = basename
+        self.num_sites = (num_sites if num_sites is not None
+                          else get_num_localities())
+        self.this_site = (this_site if this_site is not None
+                          else find_here())
+        self.root_locality = root_locality
+        self._gen: Dict[str, int] = {}
+        self._gen_lock = threading.Lock()
+
+    def _next_gen(self, kind: str, generation: Optional[int]) -> int:
+        with self._gen_lock:
+            if generation is not None:
+                # fast-forward so later implicit calls don't collide
+                # with explicitly-numbered rounds
+                self._gen[kind] = max(self._gen.get(kind, 0),
+                                      generation + 1)
+                return generation
+            g = self._gen.get(kind, 0)
+            self._gen[kind] = g + 1
+            return g
+
+    def _exchange(self, kind: str, value: Any,
+                  op: Optional[Callable] = None, root: int = 0,
+                  generation: Optional[int] = None) -> Future:
+        gen = self._next_gen(kind, generation)
+        return async_action(
+            _contribute, self.root_locality, self.basename, kind, gen,
+            self.this_site, self.num_sites, value, op, root)
+
+    def __repr__(self) -> str:
+        return (f"<communicator '{self.basename}' site {self.this_site}/"
+                f"{self.num_sites}>")
+
+
+def create_communicator(basename: str, num_sites: Optional[int] = None,
+                        this_site: Optional[int] = None,
+                        root_locality: int = 0) -> Communicator:
+    """hpx::collectives::create_communicator analog."""
+    return Communicator(basename, num_sites, this_site, root_locality)
+
+
+def all_reduce(comm: Communicator, value: Any,
+               op: Callable = operator.add,
+               generation: Optional[int] = None) -> Future:
+    """Every site gets op-fold of all contributions (future)."""
+    return comm._exchange("all_reduce", value, op=op, generation=generation)
+
+
+def reduce(comm: Communicator, value: Any, op: Callable = operator.add,
+           root: int = 0, generation: Optional[int] = None) -> Future:
+    """Root site gets the fold; other sites get None."""
+    return comm._exchange("reduce", value, op=op, root=root,
+                          generation=generation)
+
+
+def all_gather(comm: Communicator, value: Any,
+               generation: Optional[int] = None) -> Future:
+    """Every site gets [site 0's value, ..., site N-1's value]."""
+    return comm._exchange("all_gather", value, generation=generation)
+
+
+def gather(comm: Communicator, value: Any, root: int = 0,
+           generation: Optional[int] = None) -> Future:
+    """Root gets the list of values; other sites get None (gather_there/
+    gather_here collapse into the root parameter)."""
+    return comm._exchange("gather", value, root=root, generation=generation)
+
+
+def broadcast(comm: Communicator, value: Any = None, root: int = 0,
+              generation: Optional[int] = None) -> Future:
+    """Every site gets root's value (broadcast_to on root, broadcast_from
+    elsewhere — non-root sites may pass value=None)."""
+    return comm._exchange("broadcast", value, root=root,
+                          generation=generation)
+
+
+def scatter(comm: Communicator, parts: Any = None, root: int = 0,
+            generation: Optional[int] = None) -> Future:
+    """Root provides a list of num_sites parts; site i's future yields
+    parts[i] (scatter_to/scatter_from collapse)."""
+    return comm._exchange("scatter", parts, root=root, generation=generation)
+
+
+def all_to_all(comm: Communicator, parts: Any,
+               generation: Optional[int] = None) -> Future:
+    """Site i provides [to site 0, ..., to site N-1]; gets
+    [from site 0, ..., from site N-1]."""
+    return comm._exchange("all_to_all", parts, generation=generation)
+
+
+def inclusive_scan(comm: Communicator, value: Any,
+                   op: Callable = operator.add,
+                   generation: Optional[int] = None) -> Future:
+    """Site i gets op-fold of sites [0, i]."""
+    return comm._exchange("inclusive_scan", value, op=op,
+                          generation=generation)
+
+
+def exclusive_scan(comm: Communicator, value: Any,
+                   op: Callable = operator.add,
+                   generation: Optional[int] = None) -> Future:
+    """Site i gets op-fold of sites [0, i); site 0 gets None."""
+    return comm._exchange("exclusive_scan", value, op=op,
+                          generation=generation)
+
+
+def barrier(comm: Communicator,
+            generation: Optional[int] = None) -> Future:
+    """Future completes when every site has arrived."""
+    return comm._exchange("barrier", None, generation=generation)

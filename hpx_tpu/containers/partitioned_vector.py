@@ -30,15 +30,21 @@ from ..dist.distribution_policies import ContainerLayout, default_layout
 
 @dataclass(frozen=True)
 class Segment:
-    """One logical partition: [begin, end) on a device.
+    """One logical partition: [begin, end) and where it lives.
 
-    The analog of HPX's segment iterator position: identifies which
-    partition and where it lives (partitioned_vector_segmented_iterator).
+    The analog of HPX's segment iterator position (partitioned_vector_
+    segmented_iterator). With fewer partitions than devices along the
+    axis a segment spans several devices — `devices` lists them all in
+    axis order; `device` is the first (where the segment starts).
     """
     index: int
     begin: int
     end: int
-    device: Any
+    devices: Tuple[Any, ...]
+
+    @property
+    def device(self) -> Any:
+        return self.devices[0]
 
     def __len__(self) -> int:
         return self.end - self.begin
@@ -213,16 +219,20 @@ class PartitionedVector:
     def segments(self) -> Sequence[Segment]:
         """Logical partitions with their devices, in index order."""
         npart = self.num_partitions
-        chunk = self._data.shape[0] // npart
+        padded = self._data.shape[0]
+        chunk = padded // npart
         axis_devs = self._axis_devices()
+        per_dev = padded // len(axis_devs)
         out = []
         for k in range(npart):
-            b, e = k * chunk, (k + 1) * chunk
-            b, e = min(b, self._size), min(e, self._size)
+            pb, pe = k * chunk, (k + 1) * chunk   # padded coords
             # NamedSharding places contiguous blocks: device d along the
-            # axis holds [d*P/A, (d+1)*P/A) of the padded extent
-            out.append(Segment(k, b, e,
-                               axis_devs[k * len(axis_devs) // npart]))
+            # axis holds [d*per_dev, (d+1)*per_dev) of the padded extent;
+            # a segment spans every device its padded range overlaps
+            d0, d1 = pb // per_dev, (pe - 1) // per_dev
+            devs = tuple(axis_devs[d] for d in range(d0, d1 + 1))
+            b, e = min(pb, self._size), min(pe, self._size)
+            out.append(Segment(k, b, e, devs))
         return out
 
     def _axis_devices(self):

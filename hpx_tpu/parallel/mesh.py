@@ -29,6 +29,18 @@ def make_mesh(shape: Optional[Sequence[int]] = None,
     return Mesh(arr, tuple(axis_names))
 
 
+_default_mesh = None
+
+
+def default_mesh():
+    """The cached all-devices 1-D mesh ('x'). Sharing one Mesh object
+    lets compiled-program caches keyed on meshes hit across callers."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
 def shard_1d(arr, mesh, axis: str = "x"):
     """Place a 1-D array sharded across the given mesh axis."""
     import jax

@@ -1,0 +1,86 @@
+"""Multi-locality collectives workload (run under hpx_tpu.run).
+
+Reference analog: libs/full/collectives/tests/unit run at LOCALITIES>1
+(SURVEY.md §4). Exercises every verb + channels + latch across real
+processes over the TCP parcelport; exit code 0 per locality on success.
+"""
+
+import operator
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import hpx_tpu as hpx
+from hpx_tpu.collectives import (
+    all_gather, all_reduce, all_to_all, barrier, broadcast,
+    exclusive_scan, gather, inclusive_scan, reduce, scatter,
+)
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+T = 60.0
+
+
+def main() -> int:
+    rt = hpx.init()
+    me = hpx.find_here()
+    n = hpx.get_num_localities()
+    HPX_TEST(n >= 2, "need multiple localities")
+    comm = hpx.create_communicator("smoke", num_sites=n, this_site=me)
+
+    HPX_TEST_EQ(all_reduce(comm, me + 1).get(timeout=T),
+                n * (n + 1) // 2)
+    HPX_TEST_EQ(all_gather(comm, me * 2).get(timeout=T),
+                [2 * i for i in range(n)])
+
+    got = reduce(comm, me, op=operator.add, root=1).get(timeout=T)
+    if me == 1:
+        HPX_TEST_EQ(got, n * (n - 1) // 2)
+    else:
+        HPX_TEST(got is None)
+
+    HPX_TEST_EQ(broadcast(comm, "root-data" if me == 0 else None,
+                          root=0).get(timeout=T), "root-data")
+    HPX_TEST_EQ(scatter(comm, [f"p{i}" for i in range(n)]
+                        if me == 0 else None).get(timeout=T), f"p{me}")
+    HPX_TEST_EQ(all_to_all(comm, [(me, j) for j in range(n)]).get(timeout=T),
+                [(j, me) for j in range(n)])
+    HPX_TEST_EQ(inclusive_scan(comm, me + 1).get(timeout=T),
+                (me + 1) * (me + 2) // 2)
+    exc = exclusive_scan(comm, me + 1).get(timeout=T)
+    HPX_TEST(exc is None if me == 0 else exc == me * (me + 1) // 2)
+
+    # numpy payload across the wire
+    arr = all_reduce(comm, np.full(16, float(me))).get(timeout=T)
+    np.testing.assert_allclose(arr, np.full(16, float(n * (n - 1) / 2)))
+
+    HPX_TEST(barrier(comm).get(timeout=T))
+
+    # channel communicator: ring send
+    cc = hpx.create_channel_communicator("ring", num_sites=n, this_site=me)
+    cc.set((me + 1) % n, f"from-{me}")
+    HPX_TEST_EQ(cc.get((me - 1) % n).get(timeout=T), f"from-{(me - 1) % n}")
+
+    # distributed channel hosted on locality 0
+    if me == 0:
+        dch = hpx.DistributedChannel.create("mpchan")
+    else:
+        dch = hpx.DistributedChannel.connect("mpchan")
+    dch.set(me * 100).get(timeout=T)
+    total = sum(dch.get().get(timeout=T) for _ in range(n)) if me == 0 else 0
+    if me == 0:
+        HPX_TEST_EQ(total, 100 * n * (n - 1) // 2)
+
+    # distributed latch: everyone arrives
+    latch = hpx.DistributedLatch("mplatch", n)
+    HPX_TEST(latch.arrive_and_wait().get(timeout=T))
+
+    rt.barrier("collectives-done")
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

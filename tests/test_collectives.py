@@ -1,0 +1,280 @@
+"""Collectives (M7): host-plane communicator semantics in-process (sites
+as distinct Communicator instances), device-plane collectives on the
+8-device CPU mesh, channels, distributed latch.
+
+Reference analog: libs/full/collectives/tests/unit/*.cpp — per-verb
+tests over num_sites participants.
+"""
+
+import operator
+
+import numpy as np
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.collectives import (
+    all_gather, all_reduce, all_to_all, barrier, broadcast,
+    exclusive_scan, gather, inclusive_scan, reduce, scatter,
+)
+from hpx_tpu.collectives import device as dev
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+N = 4
+
+
+def comms(basename, n=N):
+    return [hpx.create_communicator(basename, num_sites=n, this_site=i)
+            for i in range(n)]
+
+
+class TestCommunicator:
+    def test_all_reduce(self):
+        cs = comms("t_allreduce")
+        futs = [all_reduce(c, i + 1) for i, c in enumerate(cs)]
+        for f in futs:
+            HPX_TEST_EQ(f.get(timeout=10.0), sum(range(1, N + 1)))
+
+    def test_all_reduce_custom_op(self):
+        cs = comms("t_allreduce_max")
+        futs = [all_reduce(c, i * 7 % 5, op=max) for i, c in enumerate(cs)]
+        expect = max(i * 7 % 5 for i in range(N))
+        for f in futs:
+            HPX_TEST_EQ(f.get(timeout=10.0), expect)
+
+    def test_reduce_root_only(self):
+        cs = comms("t_reduce")
+        futs = [reduce(c, i + 1, root=2) for i, c in enumerate(cs)]
+        results = [f.get(timeout=10.0) for f in futs]
+        HPX_TEST_EQ(results[2], sum(range(1, N + 1)))
+        for i in (0, 1, 3):
+            HPX_TEST(results[i] is None)
+
+    def test_all_gather(self):
+        cs = comms("t_allgather")
+        futs = [all_gather(c, f"s{i}") for i, c in enumerate(cs)]
+        for f in futs:
+            HPX_TEST_EQ(f.get(timeout=10.0), [f"s{i}" for i in range(N)])
+
+    def test_gather(self):
+        cs = comms("t_gather")
+        futs = [gather(c, i * i, root=0) for i, c in enumerate(cs)]
+        results = [f.get(timeout=10.0) for f in futs]
+        HPX_TEST_EQ(results[0], [i * i for i in range(N)])
+        assert all(r is None for r in results[1:])
+
+    def test_broadcast(self):
+        cs = comms("t_bcast")
+        futs = [broadcast(c, "payload" if i == 1 else None, root=1)
+                for i, c in enumerate(cs)]
+        for f in futs:
+            HPX_TEST_EQ(f.get(timeout=10.0), "payload")
+
+    def test_scatter(self):
+        cs = comms("t_scatter")
+        parts = [f"part{i}" for i in range(N)]
+        futs = [scatter(c, parts if i == 0 else None, root=0)
+                for i, c in enumerate(cs)]
+        for i, f in enumerate(futs):
+            HPX_TEST_EQ(f.get(timeout=10.0), f"part{i}")
+
+    def test_scatter_wrong_arity_raises_everywhere(self):
+        cs = comms("t_scatter_bad")
+        futs = [scatter(c, ["only", "three", "parts"] if i == 0 else None)
+                for i, c in enumerate(cs)]
+        for f in futs:
+            with pytest.raises(ValueError):
+                f.get(timeout=10.0)
+
+    def test_all_to_all(self):
+        cs = comms("t_a2a")
+        futs = [all_to_all(c, [(i, j) for j in range(N)])
+                for i, c in enumerate(cs)]
+        for i, f in enumerate(futs):
+            HPX_TEST_EQ(f.get(timeout=10.0), [(j, i) for j in range(N)])
+
+    def test_scans(self):
+        cs = comms("t_scan")
+        inc = [inclusive_scan(c, i + 1) for i, c in enumerate(cs)]
+        exc = [exclusive_scan(c, i + 1) for i, c in enumerate(cs)]
+        got_inc = [f.get(timeout=10.0) for f in inc]
+        got_exc = [f.get(timeout=10.0) for f in exc]
+        HPX_TEST_EQ(got_inc, [1, 3, 6, 10])
+        HPX_TEST(got_exc[0] is None)
+        HPX_TEST_EQ(got_exc[1:], [1, 3, 6])
+
+    def test_barrier(self):
+        cs = comms("t_barrier")
+        futs = [barrier(c) for c in cs[:-1]]
+        HPX_TEST(not any(f.is_ready() for f in futs))
+        last = barrier(cs[-1])
+        for f in futs + [last]:
+            HPX_TEST(f.get(timeout=10.0))
+
+    def test_explicit_generation_fast_forwards_implicit(self):
+        # regression: an explicit generation must advance the implicit
+        # counter, or the next implicit round collides and hangs
+        cs = comms("t_gen_explicit")
+        r1 = [all_reduce(c, 1, generation=0) for c in cs]
+        r2 = [all_reduce(c, 5) for c in cs]   # implicit: must be gen 1
+        for f in r1:
+            HPX_TEST_EQ(f.get(timeout=10.0), N)
+        for f in r2:
+            HPX_TEST_EQ(f.get(timeout=10.0), 5 * N)
+
+    def test_generations_keep_rounds_separate(self):
+        cs = comms("t_gen")
+        r1 = [all_reduce(c, 1) for c in cs]
+        r2 = [all_reduce(c, 10) for c in cs]
+        for f in r1:
+            HPX_TEST_EQ(f.get(timeout=10.0), N)
+        for f in r2:
+            HPX_TEST_EQ(f.get(timeout=10.0), 10 * N)
+
+    def test_numpy_payload(self):
+        cs = comms("t_np")
+        futs = [all_reduce(c, np.full(8, float(i))) for i, c in enumerate(cs)]
+        expect = np.full(8, float(sum(range(N))))
+        for f in futs:
+            np.testing.assert_allclose(f.get(timeout=10.0), expect)
+
+
+class TestChannelCommunicator:
+    def test_pairwise_fifo(self):
+        cc = [hpx.create_channel_communicator("cc1", num_sites=3,
+                                              this_site=i) for i in range(3)]
+        cc[0].set(1, "a").get(timeout=10.0)
+        cc[0].set(1, "b").get(timeout=10.0)
+        cc[2].set(1, "c").get(timeout=10.0)
+        HPX_TEST_EQ(cc[1].get(0).get(timeout=10.0), "a")
+        HPX_TEST_EQ(cc[1].get(0).get(timeout=10.0), "b")
+        HPX_TEST_EQ(cc[1].get(2).get(timeout=10.0), "c")
+
+    def test_get_before_set(self):
+        cc = [hpx.create_channel_communicator("cc2", num_sites=2,
+                                              this_site=i) for i in range(2)]
+        f = cc[1].get(0)
+        HPX_TEST(not f.is_ready())
+        cc[0].set(1, 42)
+        HPX_TEST_EQ(f.get(timeout=10.0), 42)
+
+    def test_out_of_range(self):
+        cc = hpx.create_channel_communicator("cc3", num_sites=2, this_site=0)
+        with pytest.raises(IndexError):
+            cc.set(5, "x")
+
+
+class TestDistributedChannel:
+    def test_create_connect_roundtrip(self):
+        ch = hpx.DistributedChannel.create("dc1")
+        other = hpx.DistributedChannel.connect("dc1")
+        ch.set("hello").get(timeout=10.0)
+        HPX_TEST_EQ(other.get().get(timeout=10.0), "hello")
+        ch.unregister()
+
+    def test_duplicate_name_raises(self):
+        ch = hpx.DistributedChannel.create("dc2")
+        with pytest.raises(ValueError):
+            hpx.DistributedChannel.create("dc2")
+        ch.unregister()
+
+
+class TestDistributedLatch:
+    def test_count_down_releases_waiters(self):
+        latch = hpx.DistributedLatch("l1", 3)
+        w = latch.wait()
+        HPX_TEST(not w.is_ready())
+        latch.count_down().get(timeout=10.0)
+        latch.count_down(2).get(timeout=10.0)
+        HPX_TEST(w.get(timeout=10.0))
+
+    def test_wait_after_release_completes_immediately(self):
+        # regression: the task pool may execute a wait() action AFTER the
+        # count_down that released the latch; arrival-count semantics must
+        # complete it immediately instead of re-creating the latch
+        latch = hpx.DistributedLatch("l3", 2)
+        latch.count_down(2).get(timeout=10.0)
+        HPX_TEST(latch.wait().get(timeout=10.0))
+
+    def test_arrive_and_wait(self):
+        latch = hpx.DistributedLatch("l2", 2)
+        f1 = latch.arrive_and_wait()
+        HPX_TEST(not f1.is_ready())
+        f2 = latch.arrive_and_wait()
+        HPX_TEST(f1.get(timeout=10.0) and f2.get(timeout=10.0))
+
+
+def test_multiprocess_collectives_3_localities():
+    import os
+    from hpx_tpu.run import launch
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    rc = launch(os.path.join(repo, "tests", "mp_scripts",
+                             "collectives_smoke.py"),
+                [], localities=3, timeout=180.0)
+    assert rc == 0
+
+
+class TestDeviceCollectives:
+    """Data-plane: sharded arrays over the 8-device CPU mesh."""
+
+    def _sharded(self, mesh, n=64, dtype=np.float32, seed=0):
+        from hpx_tpu.parallel.mesh import shard_1d
+        import jax.numpy as jnp
+        src = np.random.default_rng(seed).random(n).astype(dtype)
+        return src, shard_1d(jnp.asarray(src), mesh, "x")
+
+    def test_all_reduce_add(self, mesh1d):
+        src, x = self._sharded(mesh1d)
+        out = dev.all_reduce(x, mesh1d, "x", "add")
+        expect = src.reshape(8, -1).sum(axis=0)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+        # replicated result
+        assert len(out.sharding.device_set) == 8
+
+    def test_all_reduce_max(self, mesh1d):
+        src, x = self._sharded(mesh1d)
+        out = dev.all_reduce(x, mesh1d, "x", "max")
+        np.testing.assert_allclose(
+            np.asarray(out), src.reshape(8, -1).max(axis=0), rtol=1e-6)
+
+    def test_all_gather(self, mesh1d):
+        src, x = self._sharded(mesh1d)
+        out = dev.all_gather(x, mesh1d, "x")
+        np.testing.assert_allclose(np.asarray(out), src, rtol=1e-6)
+
+    def test_broadcast(self, mesh1d):
+        src, x = self._sharded(mesh1d)
+        out = dev.broadcast(x, mesh1d, "x", root=3)
+        np.testing.assert_allclose(
+            np.asarray(out), src.reshape(8, -1)[3], rtol=1e-6)
+
+    def test_all_to_all_is_transpose(self, mesh1d):
+        # 8 devices x 8 blocks of 2: block (i, j) moves to (j, i)
+        src = np.arange(8 * 8 * 2, dtype=np.float32)
+        from hpx_tpu.parallel.mesh import shard_1d
+        import jax.numpy as jnp
+        x = shard_1d(jnp.asarray(src), mesh1d, "x")
+        out = np.asarray(dev.all_to_all(x, mesh1d, "x"))
+        blocks = src.reshape(8, 8, 2)
+        expect = blocks.transpose(1, 0, 2).reshape(-1)
+        np.testing.assert_allclose(out, expect)
+
+    def test_reduce_scatter(self, mesh1d):
+        src, x = self._sharded(mesh1d)
+        out = np.asarray(dev.reduce_scatter(x, mesh1d, "x", "add"))
+        expect = src.reshape(8, -1).sum(axis=0)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_reduce_scatter_rejects_non_add(self, mesh1d):
+        src, x = self._sharded(mesh1d)
+        with pytest.raises(ValueError):
+            dev.reduce_scatter(x, mesh1d, "x", "max")
+
+    def test_ring_shift(self, mesh1d):
+        src, x = self._sharded(mesh1d)
+        out = np.asarray(dev.ring_shift(x, mesh1d, "x", 1))
+        blocks = src.reshape(8, -1)
+        expect = np.roll(blocks, 1, axis=0).reshape(-1)
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_barrier_runs(self, mesh1d):
+        dev.barrier(mesh1d, "x")

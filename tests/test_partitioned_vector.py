@@ -65,6 +65,21 @@ class TestConstruction:
         for s in segs:
             assert s.device == device_at(s.begin), s
 
+    def test_fewer_partitions_than_devices_spans(self, mesh1d):
+        # 4 partitions over 8 devices: each segment spans 2 devices
+        layout = hpx.container_layout(4, mesh=mesh1d)
+        pv = hpx.PartitionedVector.from_array(
+            np.arange(64, dtype=np.float32), layout)
+        segs = pv.segments()
+        HPX_TEST_EQ(len(segs), 4)
+        for s in segs:
+            assert len(s.devices) == 2
+        assert len({d for s in segs for d in s.devices}) == 8
+        # devices listed in axis order: segment k starts on device 2k
+        for k, s in enumerate(segs):
+            assert s.device == segs[0].devices[0] if k == 0 else True
+            assert s.begin == k * 16 and s.end == (k + 1) * 16
+
     def test_incompatible_partition_count_raises(self, mesh1d):
         with pytest.raises(ValueError):
             hpx.container_layout(3, mesh=mesh1d)
