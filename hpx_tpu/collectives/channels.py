@@ -39,15 +39,97 @@ def _mailbox(key: Tuple) -> _LocalChannel:
         return _mailboxes.setdefault(key, _LocalChannel())
 
 
-@plain_action(name="channels.put")
-def _put_action(key: Tuple, value: Any) -> bool:
-    _mailbox(key).set(value)
+# Per-sender sequence reordering: two un-awaited set() calls race through
+# the work-stealing pool (or the parcel decode path), so arrival order is
+# not send order. Each sender stamps a monotonic seq; the host applies a
+# sender's stream to the mailbox strictly in seq order, buffering gaps.
+_ord_lock = threading.Lock()
+_ordered: Dict[Tuple, list] = {}  # (key, sender) -> [next_seq, {seq: value}]
+
+
+@plain_action(name="channels.put_ordered")
+def _put_ordered_action(key: Tuple, sender: Tuple, seq: int,
+                        value: Any) -> bool:
+    with _ord_lock:
+        st = _ordered.setdefault((key, sender), [0, {}])
+        st[1][seq] = value
+        # delivery stays under the lock: releasing between pops would let
+        # two callers interleave their mailbox.set calls out of order
+        while st[0] in st[1]:
+            _mailbox(key).set(st[1].pop(st[0]))
+            st[0] += 1
     return True
 
 
-@plain_action(name="channels.get")
-def _get_action(key: Tuple) -> Future:
-    return _mailbox(key).get()   # parcel layer chains the continuation
+# Receive-side ordering: the same pool-reordering hazard exists for two
+# un-awaited get() futures, so get requests are seq-stamped per receiver
+# and the host pairs them with the mailbox strictly in seq order.
+_get_ord: Dict[Tuple, list] = {}  # (key, getter) -> [next_seq, {seq: state}]
+
+
+def _forward(src: Future, dst: SharedState) -> None:
+    def cb(fut: Future) -> None:
+        try:
+            dst.set_value(fut.get())
+        except BaseException as e:  # noqa: BLE001
+            dst.set_exception(e)
+    src.then(cb)
+
+
+@plain_action(name="channels.get_ordered")
+def _get_ordered_action(key: Tuple, getter: Tuple, seq: int) -> Future:
+    st: SharedState = SharedState()
+    issued = []
+    with _ord_lock:
+        state = _get_ord.setdefault((key, getter), [0, {}])
+        state[1][seq] = st
+        while state[0] in state[1]:
+            issued.append((_mailbox(key).get(), state[1].pop(state[0])))
+            state[0] += 1
+    for src, dst in issued:
+        _forward(src, dst)
+    return Future(st)
+
+
+@plain_action(name="channels.drop")
+def _drop_action(key: Tuple) -> bool:
+    from ..core.errors import Error, HpxError
+    with _lock:
+        mb = _mailboxes.pop(key, None)
+    orphans = []
+    with _ord_lock:
+        for k in [k for k in _ordered if k[0] == key]:
+            del _ordered[k]
+        for k in [k for k in _get_ord if k[0] == key]:
+            orphans.extend(_get_ord.pop(k)[1].values())
+    if mb is not None:
+        mb.close()  # fails pending getters with 'channel is closed'
+    for st in orphans:  # gap-buffered get requests never paired
+        st.set_exception(HpxError(Error.invalid_status, "channel is closed"))
+    return True
+
+
+@plain_action(name="channels.drop_peer")
+def _drop_peer_action(token: Tuple) -> bool:
+    """Drop the per-sender/per-getter reorder state of a closed peer."""
+    with _ord_lock:
+        for k in [k for k in _ordered if k[1] == token]:
+            del _ordered[k]
+        for k in [k for k in _get_ord if k[1] == token]:
+            del _get_ord[k]
+    return True
+
+
+# Peer tokens must be unique for the life of the HOST's reorder state:
+# id(self) can be reused after GC, which would resume a dead sender's seq
+# numbering and stall delivery forever. A process-unique counter cannot.
+import itertools as _itertools
+
+_peer_counter = _itertools.count()
+
+
+def _peer_token() -> Tuple:
+    return (find_here(), next(_peer_counter))
 
 
 # ---------------------------------------------------------------------------
@@ -70,6 +152,11 @@ class ChannelCommunicator:
         self.this_site = (this_site if this_site is not None
                           else find_here())
         self.root_locality = root_locality
+        # peer token unique to this communicator instance; seq counters
+        # per (to, tag) give FIFO per directed pair from this instance
+        self._sender = _peer_token()
+        self._seq: Dict[Tuple, int] = {}
+        self._seq_lock = threading.Lock()
 
     def _key(self, frm: int, to: int, tag: Optional[int]) -> Tuple:
         return ("chan_comm", self.basename, frm, to, tag)
@@ -77,14 +164,35 @@ class ChannelCommunicator:
     def set(self, to: int, value: Any, tag: Optional[int] = None) -> Future:
         if not 0 <= to < self.num_sites:
             raise IndexError(to)
-        return async_action(_put_action, self.root_locality,
-                            self._key(self.this_site, to, tag), value)
+        key = self._key(self.this_site, to, tag)
+        with self._seq_lock:
+            seq = self._seq.get(key, 0)
+            self._seq[key] = seq + 1
+        return async_action(_put_ordered_action, self.root_locality,
+                            key, self._sender, seq, value)
 
     def get(self, frm: int, tag: Optional[int] = None) -> Future:
         if not 0 <= frm < self.num_sites:
             raise IndexError(frm)
-        return async_action(_get_action, self.root_locality,
-                            self._key(frm, self.this_site, tag))
+        key = self._key(frm, self.this_site, tag)
+        with self._seq_lock:
+            seq = self._seq.get(("get", key), 0)
+            self._seq[("get", key)] = seq + 1
+        return async_action(_get_ordered_action, self.root_locality,
+                            key, self._sender, seq)
+
+    def close(self) -> None:
+        """Release this instance's reorder state on the host. Optional —
+        the state is tiny — but long-running programs churning through
+        communicators should call it (or use `with`)."""
+        async_action(_drop_peer_action, self.root_locality,
+                     self._sender).get()
+
+    def __enter__(self) -> "ChannelCommunicator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def create_channel_communicator(basename: str,
@@ -109,6 +217,10 @@ class DistributedChannel:
     def __init__(self, name: str, host_locality: int) -> None:
         self.name = name
         self.host_locality = host_locality
+        self._sender = _peer_token()
+        self._next_seq = 0
+        self._next_get_seq = 0
+        self._seq_lock = threading.Lock()
 
     @classmethod
     def create(cls, name: str) -> "DistributedChannel":
@@ -129,15 +241,25 @@ class DistributedChannel:
         return ("dchannel", self.name)
 
     def set(self, value: Any) -> Future:
-        return async_action(_put_action, self.host_locality,
-                            self._key(), value)
+        with self._seq_lock:
+            seq = self._next_seq
+            self._next_seq = seq + 1
+        return async_action(_put_ordered_action, self.host_locality,
+                            self._key(), self._sender, seq, value)
 
     def get(self) -> Future:
-        return async_action(_get_action, self.host_locality, self._key())
+        with self._seq_lock:
+            seq = self._next_get_seq
+            self._next_get_seq = seq + 1
+        return async_action(_get_ordered_action, self.host_locality,
+                            self._key(), self._sender, seq)
 
     def unregister(self) -> None:
+        """Remove the AGAS name AND the hosted mailbox — a channel
+        re-created under the same name starts empty."""
         from ..dist import agas
         agas.unregister_name(f"dchannel/{self.name}").get()
+        async_action(_drop_action, self.host_locality, self._key()).get()
 
 
 # ---------------------------------------------------------------------------

@@ -130,6 +130,12 @@ def all_to_all(x: Any, mesh, axis: str = "x") -> Any:
     moves to device j's i-th block — the Ulysses/sequence-parallel
     primitive (SURVEY.md §5.7). x stays sharded over the axis."""
     sharded, _ = _specs(axis)
+    n_ = mesh.shape[axis]
+    shard_len = x.shape[0] // n_
+    if x.shape[0] % n_ or shard_len % n_:
+        raise ValueError(
+            f"all_to_all needs leading dim divisible by n*n (n={n_} devices,"
+            f" so a multiple of {n_ * n_}); got shape {tuple(x.shape)}")
 
     def build():
         from jax import lax

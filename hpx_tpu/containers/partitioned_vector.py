@@ -23,7 +23,7 @@ no-op view when the size divides evenly — the performance case).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Iterator, Optional, Sequence, Union
+from typing import Any, Iterator, Optional, Sequence, Tuple, Union
 
 from ..dist.distribution_policies import ContainerLayout, default_layout
 

@@ -27,14 +27,12 @@ class ContainerLayout:
                  mesh: Any = None, axis: str = "x",
                  targets: Optional[Sequence[Any]] = None) -> None:
         if mesh is None:
-            from ..parallel.mesh import default_mesh, make_mesh
+            from ..parallel.mesh import make_mesh
             if targets:
                 devs = [t.device for t in targets]
                 mesh = make_mesh((len(devs),), (axis,), devs)
-            elif axis == "x":
-                mesh = default_mesh()
             else:
-                mesh = make_mesh(None, (axis,))
+                mesh = make_mesh(None, (axis,))  # cached per (shape, axis)
         self.mesh = mesh
         self.axis = axis
         axis_size = mesh.shape[axis]

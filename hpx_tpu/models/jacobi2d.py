@@ -1,0 +1,185 @@
+"""2-D Jacobi workloads — the reference's examples/jacobi ladder (config #5).
+
+Reference analog: examples/jacobi/ and examples/jacobi_smp/ (row-block
+decomposition with dataflow dependencies between iterations), plus the
+block_executor NUMA configuration the reference's Jacobi benchmarks use.
+Physics: 5-point Laplace smoothing with Dirichlet boundaries (top edge
+held at 1, other edges at 0 — the heated-plate problem), identical across
+all variants so they can be differentially tested:
+
+  jacobi_serial    whole-grid sweeps in one jitted fori_loop — the honest
+                   single-program TPU baseline.
+  jacobi_dataflow  row-block decomposition; each iteration builds
+                   dataflow(jacobi_part, up, mid, down) nodes exchanging
+                   1-row halos — the examples/jacobi dependency DAG with
+                   device dispatches as task bodies.
+  jacobi_sharded   production path: grid sharded over a 2-D device mesh,
+                   per-sweep halos via lax.ppermute on both axes, many
+                   sweeps fused per dispatch (parallel/halo2d.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..exec.tpu import TpuExecutor
+from ..futures.async_ import Launch
+from ..futures.dataflow import dataflow
+from ..futures.future import Future, make_ready_future
+
+
+@dataclasses.dataclass
+class JacobiParams:
+    nx: int = 256           # grid rows
+    ny: int = 256           # grid cols
+    nb: int = 8             # row blocks (dataflow variant)
+    iterations: int = 100
+
+    @property
+    def grid(self) -> Tuple[int, int]:
+        return self.nx, self.ny
+
+
+def init_grid(p: JacobiParams) -> jax.Array:
+    """Zero interior; top boundary row = 1 (heated plate)."""
+    u = jnp.zeros((p.nx, p.ny), dtype=jnp.float32)
+    return u.at[0, 1:-1].set(1.0)
+
+
+def _sweep(u: jax.Array) -> jax.Array:
+    """One whole-grid Jacobi sweep; boundary rows/cols carried through."""
+    interior = 0.25 * (u[:-2, 1:-1] + u[2:, 1:-1] +
+                       u[1:-1, :-2] + u[1:-1, 2:])
+    return u.at[1:-1, 1:-1].set(interior)
+
+
+# -- serial -------------------------------------------------------------------
+
+def jacobi_serial(p: JacobiParams, u0: Optional[jax.Array] = None,
+                  ) -> jax.Array:
+    u = init_grid(p) if u0 is None else u0
+
+    @jax.jit
+    def run(u):
+        return jax.lax.fori_loop(0, p.iterations, lambda _i, s: _sweep(s), u)
+
+    return run(u)
+
+
+def residual(u_prev: jax.Array, u_next: jax.Array) -> jax.Array:
+    return jnp.sum((u_next - u_prev) ** 2)
+
+
+# -- dataflow over row blocks (examples/jacobi dependency DAG) ---------------
+
+def jacobi_part(top: jax.Array, mid: jax.Array, bot: jax.Array
+                ) -> jax.Array:
+    """Update one row block given 1-row neighbor halos.
+
+    top/bot are (1, ny) halo rows (the neighbor block's adjacent row; the
+    block's own outer row where the block touches the global boundary —
+    the caller passes the block's own edge row there, which keeps
+    Dirichlet cells fixed because the 5-point update is masked below).
+    """
+    ext = jnp.concatenate([top, mid, bot], axis=0)
+    interior = 0.25 * (ext[:-2, 1:-1] + ext[2:, 1:-1] +
+                       ext[1:-1, :-2] + ext[1:-1, 2:])
+    return mid.at[:, 1:-1].set(interior)
+
+
+# jitted once at module scope: repeated jacobi_dataflow calls with the
+# same block shapes hit jit's trace cache instead of recompiling
+_part = jax.jit(jacobi_part)
+
+
+@jax.jit
+def _part_top(mid: jax.Array, bot: jax.Array) -> jax.Array:
+    # first block: row 0 is Dirichlet — update rows 1.., restore row 0
+    new = jacobi_part(mid[:1], mid, bot)
+    return new.at[0].set(mid[0])
+
+
+@jax.jit
+def _part_bot(top: jax.Array, mid: jax.Array) -> jax.Array:
+    new = jacobi_part(top, mid, mid[-1:])
+    return new.at[-1].set(mid[-1])
+
+
+@jax.jit
+def _part_single(mid: jax.Array) -> jax.Array:
+    # nb == 1: the block owns BOTH Dirichlet rows — restore both
+    new = jacobi_part(mid[:1], mid, mid[-1:])
+    new = new.at[0].set(mid[0])
+    return new.at[-1].set(mid[-1])
+
+
+def jacobi_dataflow(p: JacobiParams,
+                    executor: Optional[TpuExecutor] = None,
+                    u0: Optional[jax.Array] = None) -> List[Future]:
+    """Row-block DAG: U[t+1][b] = dataflow(jacobi_part, U[t][b-1] tail,
+    U[t][b], U[t][b+1] head). Global top/bottom blocks mask their boundary
+    row by passing their own edge row as the halo AND restoring it after
+    the update (the update would otherwise smooth the Dirichlet row)."""
+    assert p.nx % p.nb == 0, (p.nx, p.nb)
+    bh = p.nx // p.nb
+    ex = executor or TpuExecutor()
+    full = init_grid(p) if u0 is None else u0
+    blocks = [full[b * bh:(b + 1) * bh] for b in range(p.nb)]
+    u: List[Future] = [make_ready_future(x) for x in blocks]
+
+    def node(b: int, uf: Future, df: Future, bf2: Future) -> Future:
+        if p.nb == 1:
+            return ex.async_execute_raw(_part_single, df.get())
+        if b == 0:
+            return ex.async_execute_raw(_part_top, df.get(), bf2.get()[:1])
+        if b == p.nb - 1:
+            return ex.async_execute_raw(_part_bot, uf.get()[-1:], df.get())
+        return ex.async_execute_raw(
+            _part, uf.get()[-1:], df.get(), bf2.get()[:1])
+
+    for _t in range(p.iterations):
+        u = [
+            dataflow(node, b, u[max(b - 1, 0)], u[b],
+                     u[min(b + 1, p.nb - 1)], policy=Launch.sync)
+            for b in range(p.nb)
+        ]
+    return u
+
+
+def gather_blocks(u: List[Future]) -> jax.Array:
+    return jnp.concatenate([f.get() for f in u], axis=0)
+
+
+# -- sharded over a 2-D mesh (production path) -------------------------------
+
+def jacobi_sharded(p: JacobiParams, mesh, ax: str = "x", ay: str = "y",
+                   u0: Optional[jax.Array] = None,
+                   steps_per_dispatch: Optional[int] = None):
+    """Run p.iterations sweeps sharded over `mesh`; returns (u, residual).
+
+    The grid lives sharded P(ax, ay) for the whole run; each dispatch
+    fuses `steps_per_dispatch` sweeps (default: all of them).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.halo2d import sharded_jacobi_multistep
+
+    u = init_grid(p) if u0 is None else u0
+    u = jax.device_put(u, NamedSharding(mesh, P(ax, ay)))
+    if p.iterations <= 0:
+        return u, jnp.zeros((), u.dtype)
+    spd = steps_per_dispatch or p.iterations
+    step = sharded_jacobi_multistep(mesh, p.grid, spd, ax, ay)
+    done, res = 0, None
+    while done + spd <= p.iterations:
+        u, res = step(u)
+        done += spd
+    if done < p.iterations:  # remainder program for the tail
+        tail = sharded_jacobi_multistep(mesh, p.grid,
+                                        p.iterations - done, ax, ay)
+        u, res = tail(u)
+    return u, res

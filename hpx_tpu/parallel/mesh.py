@@ -13,32 +13,42 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 
+_mesh_cache = {}
+
+
 def make_mesh(shape: Optional[Sequence[int]] = None,
               axis_names: Sequence[str] = ("x",),
               devices=None):
-    """Create a jax.sharding.Mesh. Default: all devices on one axis."""
+    """Create a jax.sharding.Mesh. Default: all devices on one axis.
+
+    All-device meshes are cached per (shape, axis_names) so that
+    compiled-program caches keyed on meshes hit across callers, whatever
+    the axis is called; explicit device subsets are not cached.
+    """
     import jax
     from jax.sharding import Mesh
 
-    devs = list(devices) if devices is not None else jax.devices()
+    explicit = devices is not None
+    devs = list(devices) if explicit else jax.devices()
     if shape is None:
         shape = (len(devs),)
-    arr = np.array(devs).reshape(tuple(shape))
+    shape = tuple(shape)
+    arr = np.array(devs).reshape(shape)
     if len(axis_names) != arr.ndim:
         axis_names = tuple(f"ax{i}" for i in range(arr.ndim))
-    return Mesh(arr, tuple(axis_names))
-
-
-_default_mesh = None
+    axis_names = tuple(axis_names)
+    if explicit:
+        return Mesh(arr, axis_names)
+    key = (shape, axis_names)
+    mesh = _mesh_cache.get(key)
+    if mesh is None:
+        mesh = _mesh_cache.setdefault(key, Mesh(arr, axis_names))
+    return mesh
 
 
 def default_mesh():
-    """The cached all-devices 1-D mesh ('x'). Sharing one Mesh object
-    lets compiled-program caches keyed on meshes hit across callers."""
-    global _default_mesh
-    if _default_mesh is None:
-        _default_mesh = make_mesh()
-    return _default_mesh
+    """The cached all-devices 1-D mesh ('x')."""
+    return make_mesh()
 
 
 def shard_1d(arr, mesh, axis: str = "x"):

@@ -162,6 +162,29 @@ class TestChannelCommunicator:
         with pytest.raises(IndexError):
             cc.set(5, "x")
 
+    def test_unawaited_gets_stay_fifo(self):
+        # regression: racing un-awaited get() futures must pair in order
+        cc = [hpx.create_channel_communicator("cc5", num_sites=2,
+                                              this_site=i) for i in range(2)]
+        n = 100
+        gets = [cc[1].get(0) for _ in range(n)]   # issued before any set
+        for k in range(n):
+            cc[0].set(1, k)
+        HPX_TEST_EQ([f.get(timeout=10.0) for f in gets], list(range(n)))
+        cc[0].close()
+        cc[1].close()
+
+    def test_unawaited_sets_stay_fifo(self):
+        # regression: racing un-awaited set() futures must not reorder
+        cc = [hpx.create_channel_communicator("cc4", num_sites=2,
+                                              this_site=i) for i in range(2)]
+        n = 200
+        futs = [cc[0].set(1, k) for k in range(n)]
+        got = [cc[1].get(0).get(timeout=10.0) for _ in range(n)]
+        HPX_TEST_EQ(got, list(range(n)))
+        for f in futs:
+            f.get(timeout=10.0)
+
 
 class TestDistributedChannel:
     def test_create_connect_roundtrip(self):
@@ -176,6 +199,18 @@ class TestDistributedChannel:
         with pytest.raises(ValueError):
             hpx.DistributedChannel.create("dc2")
         ch.unregister()
+
+    def test_recreate_after_unregister_starts_empty(self):
+        # regression: unregister must drop the hosted mailbox too
+        ch = hpx.DistributedChannel.create("dc3")
+        ch.set("stale").get(timeout=10.0)
+        ch.unregister()
+        ch2 = hpx.DistributedChannel.create("dc3")
+        f = ch2.get()
+        HPX_TEST(not f.is_ready())
+        ch2.set("fresh").get(timeout=10.0)
+        HPX_TEST_EQ(f.get(timeout=10.0), "fresh")
+        ch2.unregister()
 
 
 class TestDistributedLatch:

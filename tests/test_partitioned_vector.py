@@ -76,8 +76,9 @@ class TestConstruction:
             assert len(s.devices) == 2
         assert len({d for s in segs for d in s.devices}) == 8
         # devices listed in axis order: segment k starts on device 2k
+        axis_devices = list(mesh1d.devices.flat)
         for k, s in enumerate(segs):
-            assert s.device == segs[0].devices[0] if k == 0 else True
+            assert s.device == axis_devices[2 * k], (k, s)
             assert s.begin == k * 16 and s.end == (k + 1) * 16
 
     def test_incompatible_partition_count_raises(self, mesh1d):
