@@ -90,5 +90,28 @@ from .collectives import (  # noqa: F401
     ChannelCommunicator, DistributedChannel, DistributedLatch,
 )
 
-# Populated as milestones land (SURVEY.md §7): jacobi/block executor (M8),
-# services (M9).
+# -- block executor + 2-D halo substrate (M8) --------------------------------
+from .exec.block import BlockExecutor, place_blocks  # noqa: F401
+
+# -- services (M9) ------------------------------------------------------------
+from .svc import performance_counters  # noqa: F401
+from .svc.performance_counters import (  # noqa: F401
+    CounterValue, GaugeCounter, CallbackCounter, ElapsedTimeCounter,
+    AverageCounter, counter_name, parse_counter_name, register_counter,
+    unregister_counter, discover_counters, query_counter, query_counters,
+    print_counters, start_counter_printing,
+)
+from .svc.checkpoint import (  # noqa: F401
+    Checkpoint, save_checkpoint, save_checkpoint_sync, restore_checkpoint,
+    save_checkpoint_to_file, restore_checkpoint_from_file,
+)
+from .svc.resiliency import (  # noqa: F401
+    AbortReplayException, AbortReplicateException, ReplayValidationError,
+    ReplicateVotingError, async_replay, async_replay_validate,
+    async_replicate, async_replicate_validate, async_replicate_vote,
+    async_replay_distributed, majority_vote, ReplayExecutor,
+    ReplicateExecutor,
+)
+from .svc.logging import get_logger, set_log_level  # noqa: F401
+from .svc.iostreams import cout, cerr  # noqa: F401
+from .svc import profiling  # noqa: F401

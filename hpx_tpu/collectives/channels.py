@@ -67,15 +67,6 @@ def _put_ordered_action(key: Tuple, sender: Tuple, seq: int,
 _get_ord: Dict[Tuple, list] = {}  # (key, getter) -> [next_seq, {seq: state}]
 
 
-def _forward(src: Future, dst: SharedState) -> None:
-    def cb(fut: Future) -> None:
-        try:
-            dst.set_value(fut.get())
-        except BaseException as e:  # noqa: BLE001
-            dst.set_exception(e)
-    src.then(cb)
-
-
 @plain_action(name="channels.get_ordered")
 def _get_ordered_action(key: Tuple, getter: Tuple, seq: int) -> Future:
     st: SharedState = SharedState()
@@ -87,7 +78,7 @@ def _get_ordered_action(key: Tuple, getter: Tuple, seq: int) -> Future:
             issued.append((_mailbox(key).get(), state[1].pop(state[0])))
             state[0] += 1
     for src, dst in issued:
-        _forward(src, dst)
+        dst.set_value(src)   # SharedState adopts the future's outcome
     return Future(st)
 
 
@@ -111,12 +102,17 @@ def _drop_action(key: Tuple) -> bool:
 
 @plain_action(name="channels.drop_peer")
 def _drop_peer_action(token: Tuple) -> bool:
-    """Drop the per-sender/per-getter reorder state of a closed peer."""
+    """Drop the per-sender/per-getter reorder state of a closed peer;
+    gap-buffered get requests fail rather than hang."""
+    from ..core.errors import Error, HpxError
+    orphans = []
     with _ord_lock:
         for k in [k for k in _ordered if k[1] == token]:
             del _ordered[k]
         for k in [k for k in _get_ord if k[1] == token]:
-            del _get_ord[k]
+            orphans.extend(_get_ord.pop(k)[1].values())
+    for st in orphans:
+        st.set_exception(HpxError(Error.invalid_status, "peer closed"))
     return True
 
 
@@ -162,6 +158,7 @@ class ChannelCommunicator:
         return ("chan_comm", self.basename, frm, to, tag)
 
     def set(self, to: int, value: Any, tag: Optional[int] = None) -> Future:
+        self._check_open()
         if not 0 <= to < self.num_sites:
             raise IndexError(to)
         key = self._key(self.this_site, to, tag)
@@ -172,6 +169,7 @@ class ChannelCommunicator:
                             key, self._sender, seq, value)
 
     def get(self, frm: int, tag: Optional[int] = None) -> Future:
+        self._check_open()
         if not 0 <= frm < self.num_sites:
             raise IndexError(frm)
         key = self._key(frm, self.this_site, tag)
@@ -182,11 +180,18 @@ class ChannelCommunicator:
                             key, self._sender, seq)
 
     def close(self) -> None:
-        """Release this instance's reorder state on the host. Optional —
-        the state is tiny — but long-running programs churning through
-        communicators should call it (or use `with`)."""
+        """Release this instance's reorder state on the host and
+        invalidate the instance (further set/get raise): reusing the seq
+        counters after the host state is gone would stall delivery."""
+        self._closed = True
         async_action(_drop_peer_action, self.root_locality,
                      self._sender).get()
+
+    def _check_open(self) -> None:
+        if getattr(self, "_closed", False):
+            from ..core.errors import Error, HpxError
+            raise HpxError(Error.invalid_status,
+                           "channel_communicator is closed")
 
     def __enter__(self) -> "ChannelCommunicator":
         return self
@@ -210,13 +215,18 @@ def create_channel_communicator(basename: str,
 class DistributedChannel:
     """Named cross-locality channel (lcos_distributed analog).
 
-    The creator hosts the state and registers `(name -> host locality)`
-    in AGAS; `connect` resolves the host and routes set/get there.
+    The creator hosts the state and registers `(name -> (host locality,
+    incarnation))` in AGAS; `connect` resolves both and routes set/get
+    there. The incarnation number makes each create() a fresh mailbox
+    key, so handles of an unregistered previous incarnation can never
+    poison (or read from) a recreated channel of the same name.
     """
 
-    def __init__(self, name: str, host_locality: int) -> None:
+    def __init__(self, name: str, host_locality: int,
+                 incarnation: int) -> None:
         self.name = name
         self.host_locality = host_locality
+        self.incarnation = incarnation
         self._sender = _peer_token()
         self._next_seq = 0
         self._next_get_seq = 0
@@ -226,19 +236,20 @@ class DistributedChannel:
     def create(cls, name: str) -> "DistributedChannel":
         from ..dist import agas
         here = find_here()
-        ok = agas.register_name(f"dchannel/{name}", here).get()
+        inc = next(_peer_counter)
+        ok = agas.register_name(f"dchannel/{name}", (here, inc)).get()
         if not ok:
             raise ValueError(f"channel name already registered: {name}")
-        return cls(name, here)
+        return cls(name, here, inc)
 
     @classmethod
     def connect(cls, name: str) -> "DistributedChannel":
         from ..dist import agas
-        host = agas.resolve_name(f"dchannel/{name}", wait=True).get()
-        return cls(name, host)
+        host, inc = agas.resolve_name(f"dchannel/{name}", wait=True).get()
+        return cls(name, host, inc)
 
     def _key(self) -> Tuple:
-        return ("dchannel", self.name)
+        return ("dchannel", self.name, self.incarnation)
 
     def set(self, value: Any) -> Future:
         with self._seq_lock:

@@ -56,6 +56,10 @@ class Runtime:
         self._stopped = False
         self._inflight = 0            # parcel handlers not yet replied
         self._inflight_cv = threading.Condition()
+        self.parcels_sent = 0         # perf-counter feeds
+        self.parcels_received = 0
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
         if self.num_localities > 1:
             self._bootstrap()
@@ -106,7 +110,10 @@ class Runtime:
 
     # -- wire ---------------------------------------------------------------
     def _send_raw(self, peer_id: int, msg: Any) -> None:
-        self._endpoint.send(peer_id, serialize(msg))
+        data = serialize(msg)
+        self.parcels_sent += 1          # counter feeds (svc/performance_
+        self.bytes_sent += len(data)    # counters.py); GIL-atomic enough
+        self._endpoint.send(peer_id, data)
 
     def _add_route(self, loc: int, peer_id: int) -> None:
         with self._routes_cv:
@@ -130,6 +137,8 @@ class Runtime:
 
     def _on_message(self, peer_id: int, data: bytes) -> None:
         """Runs on the IO thread: decode, then dispatch cheaply."""
+        self.parcels_received += 1
+        self.bytes_received += len(data)
         try:
             msg = deserialize(data)
         except Exception:  # noqa: BLE001

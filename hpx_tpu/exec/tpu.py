@@ -152,6 +152,11 @@ class TpuExecutor(BaseExecutor):
     _jit_cache_max = 4096
     _jit_lru: "_collections.OrderedDict" = _collections.OrderedDict()
     _jit_lru_max = 256
+    # perf-counter feeds (class-level: all instances share the device
+    # path). compile_count counts jit-wrapper cache misses — a proxy for
+    # XLA compilations, which happen per (wrapper, shape) at first call.
+    dispatch_count = 0
+    compile_count = 0
 
     def __init__(self, target: Optional[Target] = None,
                  eager: Optional[bool] = None,
@@ -178,6 +183,7 @@ class TpuExecutor(BaseExecutor):
             lru = TpuExecutor._jit_lru
             cached = lru.get(key)
             if cached is None:
+                TpuExecutor.compile_count += 1
                 cached = jax.jit(fn, donate_argnums=self._donate)
                 lru[key] = cached
                 if len(lru) > TpuExecutor._jit_lru_max:
@@ -188,6 +194,7 @@ class TpuExecutor(BaseExecutor):
         cache = TpuExecutor._jit_cache
         cached = cache.get(key)
         if cached is None:
+            TpuExecutor.compile_count += 1
             cached = jax.jit(fn, donate_argnums=self._donate)
             cache[key] = cached
             # structural keys embed closure scalars, so loops over varying
@@ -210,15 +217,18 @@ class TpuExecutor(BaseExecutor):
 
     def post_compiled(self, fn: Callable[..., Any], *args: Any,
                       **kwargs: Any) -> None:
+        TpuExecutor.dispatch_count += 1
         self._compiled(fn)(*args, **kwargs)
 
     def sync_execute(self, fn: Callable[..., Any], *args: Any,
                      **kwargs: Any) -> Any:
         import jax
+        TpuExecutor.dispatch_count += 1
         return jax.block_until_ready(self._compiled(fn)(*args, **kwargs))
 
     def async_execute(self, fn: Callable[..., Any], *args: Any,
                       **kwargs: Any) -> Future:
+        TpuExecutor.dispatch_count += 1
         try:
             value = self._compiled(fn)(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001 — trace/compile errors
@@ -230,6 +240,7 @@ class TpuExecutor(BaseExecutor):
     def async_execute_raw(self, fn: Callable[..., Any], *args: Any,
                           **kwargs: Any) -> Future:
         """Dispatch an already-compiled/arbitrary callable (no jit wrap)."""
+        TpuExecutor.dispatch_count += 1
         try:
             value = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001

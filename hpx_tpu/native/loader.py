@@ -117,11 +117,25 @@ class NativePool:
             if task is None:
                 return
             fn, args, kwargs = task
+            from ..runtime import threadpool as _tp
+            obs = _tp._task_observer
+            if obs is not None:
+                import time as _time
+                try:  # observers must never break tasks or kill workers
+                    obs("start", fn, None, args)
+                except BaseException:  # noqa: BLE001
+                    pass
+                t0 = _time.monotonic()
             try:
                 fn(*args, **kwargs)
             except BaseException:  # noqa: BLE001 — mirror Python pool
                 import traceback
                 traceback.print_exc()
+            if obs is not None:
+                try:
+                    obs("stop", fn, _time.monotonic() - t0, args)
+                except BaseException:  # noqa: BLE001
+                    pass
 
         self._tramp = _TASK_FN(_tramp)
 
@@ -133,6 +147,12 @@ class NativePool:
         if self._shut:  # the C++ pool was freed; a call would be UAF
             from ..core.errors import Error, HpxError
             raise HpxError(Error.invalid_status, "pool is shut down")
+        from ..runtime import threadpool as _tp
+        if _tp._task_observer is not None:
+            try:
+                _tp._task_observer("submit", fn, None, args)
+            except BaseException:  # noqa: BLE001
+                pass
         with self._tasks_lock:
             tid = self._next_id
             self._next_id += 1

@@ -110,9 +110,13 @@ _VMEM_F32_LIMIT = 1 << 19
 @functools.partial(jax.jit, static_argnames=("steps", "use_pallas"))
 def multistep(u: jax.Array, coef: jax.Array, steps: int,
               use_pallas: Optional[bool] = None) -> jax.Array:
-    """Best-available T-step stencil: pallas when the array fits VMEM."""
+    """Best-available T-step stencil: pallas when the array fits VMEM.
+
+    Auto mode only picks pallas on a real TPU backend — the mosaic
+    kernel doesn't run on the CPU test platform."""
     if use_pallas is None:
-        use_pallas = (u.shape[0] % LANES == 0 and
+        use_pallas = (jax.default_backend() not in ("cpu",) and
+                      u.shape[0] % LANES == 0 and
                       u.shape[0] <= _VMEM_F32_LIMIT)
     if use_pallas:
         return pallas_multistep(u, coef, steps)

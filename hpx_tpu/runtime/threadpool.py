@@ -24,9 +24,21 @@ import collections
 import itertools
 import os
 import threading
+import time
 from typing import Any, Callable, Deque, List, Optional, Tuple
 
 _Task = Tuple[Callable[..., Any], tuple, dict]
+
+# APEX-style external-timer hook (svc/profiling.py): called with
+# (event, fn, seconds-or-None, task_args) at task submit/start/stop when
+# set. task_args lets hooks unwrap scheduling shims (e.g. futures'
+# _run_into) to attribute time to the user function.
+_task_observer: Optional[Callable[..., None]] = None
+
+
+def set_task_observer(obs: Optional[Callable[..., None]]) -> None:
+    global _task_observer
+    _task_observer = obs
 
 # Which pool the current OS thread is a worker of (if any). Futures consult
 # this to "work-help" instead of blocking — the analog of an HPX thread
@@ -69,6 +81,11 @@ class WorkStealingPool:
         A worker submits to its own queue (children run hot, LIFO — HPX
         thread_queue does the same); external threads round-robin across
         queues."""
+        if _task_observer is not None:
+            try:
+                _task_observer("submit", fn, None, args)
+            except BaseException:  # noqa: BLE001
+                pass
         wid = getattr(self._tls, "wid", None)
         if wid is None:
             wid = next(self._rr) % len(self._queues)
@@ -104,11 +121,23 @@ class WorkStealingPool:
         with self._cv:
             self._pending -= 1
         fn, args, kwargs = task
+        obs = _task_observer
+        if obs is not None:
+            try:  # observers must never break tasks or kill workers
+                obs("start", fn, None, args)
+            except BaseException:  # noqa: BLE001
+                pass
+            t0 = time.monotonic()
         try:
             fn(*args, **kwargs)
         except BaseException:  # noqa: BLE001 — see _worker note
             import traceback
             traceback.print_exc()
+        if obs is not None:
+            try:
+                obs("stop", fn, time.monotonic() - t0, args)
+            except BaseException:  # noqa: BLE001
+                pass
         self._executed += 1
 
     def help_one(self) -> bool:

@@ -1,0 +1,2 @@
+"""Services layer (SURVEY.md §2.5): performance counters, checkpoint,
+resiliency, logging, distributed iostreams, profiler bridge."""

@@ -1,0 +1,154 @@
+"""Checkpoint/restore — coarse-grained recovery (SURVEY.md §5.4).
+
+Reference analog: libs/full/checkpoint (+checkpoint_base):
+`save_checkpoint(args...) -> future<checkpoint>` serializes an argument
+pack with the parcel serializer (anything action-serializable
+checkpoints for free, futures contribute their values);
+`restore_checkpoint(cp, args&...)`; checkpoints stream to/from files.
+
+TPU-first: device arrays are pulled to host per-shard through the parcel
+serializer's jax encoding; PartitionedVector checkpoints carry layout
+metadata (partition count + mesh axis) and are re-placed onto the
+CURRENT process's mesh on restore — a checkpoint written on an 8-chip
+mesh restores onto whatever mesh the restoring run has, which is the
+useful elasticity story for device counts that changed between runs.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Any, BinaryIO, List, Tuple, Union
+
+from ..dist.serialization import deserialize, serialize
+from ..futures.async_ import async_
+from ..futures.future import Future, is_future
+
+_MAGIC = b"HPXTPUCKPT1\n"
+
+
+class _PVMarker:
+    """PartitionedVector wire form: host data + layout metadata."""
+
+    __slots__ = ("np_value", "num_partitions", "axis")
+
+    def __init__(self, np_value, num_partitions: int, axis: str) -> None:
+        self.np_value = np_value
+        self.num_partitions = num_partitions
+        self.axis = axis
+
+    def restore(self):
+        from ..containers import PartitionedVector
+        from ..dist.distribution_policies import container_layout
+        layout = container_layout(self.num_partitions, axis=self.axis)
+        return PartitionedVector.from_array(self.np_value, layout)
+
+
+def _encode(obj: Any) -> Any:
+    """Resolve futures to their values; lower PartitionedVectors."""
+    import numpy as np
+    from ..containers import PartitionedVector
+    if is_future(obj):
+        return _encode(obj.get())
+    if isinstance(obj, PartitionedVector):
+        return _PVMarker(np.asarray(obj.to_numpy()),
+                         obj.num_partitions, obj.layout.axis)
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        vals = [_encode(x) for x in obj]
+        return t(vals) if t in (list, tuple) else vals
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    return obj
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, _PVMarker):
+        return obj.restore()
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        vals = [_decode(x) for x in obj]
+        return t(vals) if t in (list, tuple) else vals
+    if isinstance(obj, dict):
+        return {k: _decode(v) for k, v in obj.items()}
+    return obj
+
+
+class Checkpoint:
+    """An opaque serialized argument pack (hpx::util::checkpoint)."""
+
+    def __init__(self, data: bytes = b"") -> None:
+        self.data = data
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Checkpoint) and self.data == other.data
+
+    # -- streaming (operator<< / operator>> analogs) ------------------------
+    def write(self, stream: BinaryIO) -> None:
+        stream.write(_MAGIC)
+        stream.write(len(self.data).to_bytes(8, "little"))
+        stream.write(self.data)
+
+    @classmethod
+    def read(cls, stream: BinaryIO) -> "Checkpoint":
+        magic = stream.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError("not a hpx_tpu checkpoint stream")
+        (n,) = (int.from_bytes(stream.read(8), "little"),)
+        data = stream.read(n)
+        if len(data) != n:
+            raise ValueError("truncated checkpoint stream")
+        return cls(data)
+
+
+def save_checkpoint(*args: Any) -> Future:
+    """Serialize the argument pack (futures are awaited, their VALUES are
+    stored). Returns future<Checkpoint> — serialization runs as a task."""
+
+    def build() -> Checkpoint:
+        return Checkpoint(serialize(_encode(list(args))))
+
+    return async_(build)
+
+
+def save_checkpoint_sync(*args: Any) -> Checkpoint:
+    return save_checkpoint(*args).get()
+
+
+def restore_checkpoint(cp: Checkpoint) -> Tuple:
+    """Returns the restored argument pack as a tuple (Python can't fill
+    out-params; a 1-arg checkpoint restores as a 1-tuple)."""
+    return tuple(_decode(deserialize(cp.data)))
+
+
+def save_checkpoint_to_file(path: Union[str, os.PathLike],
+                            *args: Any) -> Future:
+    def build() -> Checkpoint:
+        import tempfile
+        cp = Checkpoint(serialize(_encode(list(args))))
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        # unique temp per call: concurrent saves to one path must not
+        # interleave into the same tmp file before the atomic publish
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(
+            str(path)) + ".tmp.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                cp.write(f)
+            os.replace(tmp, path)    # atomic publish
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return cp
+
+    return async_(build)
+
+
+def restore_checkpoint_from_file(path: Union[str, os.PathLike]) -> Tuple:
+    with open(path, "rb") as f:
+        return restore_checkpoint(Checkpoint.read(f))

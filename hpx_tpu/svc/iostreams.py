@@ -1,0 +1,73 @@
+"""Distributed console output — hpx::cout (SURVEY.md §2.5 'iostreams').
+
+Reference analog: components/iostreams — output written to hpx::cout on
+ANY locality is marshalled to the console locality (0) and printed
+there, so multi-process runs produce one coherent stream instead of N
+interleaved stdouts.
+
+Usage:
+    from hpx_tpu.svc.iostreams import cout, cerr
+    cout.println(f"locality {hpx.find_here()} ready")
+    cout.write("partial "); cout.write("line\\n"); cout.flush()
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, List
+
+from ..dist.actions import async_action, plain_action
+from ..dist.runtime import find_here, find_root_locality
+from ..futures.future import Future
+
+
+@plain_action(name="iostreams.write")
+def _console_write(stream: str, text: str) -> bool:
+    out = sys.stderr if stream == "cerr" else sys.stdout
+    out.write(text)
+    out.flush()
+    return True
+
+
+class _DistStream:
+    """Buffers locally per line; ships to the console locality on flush
+    (and on newline, matching hpx::endl / hpx::flush behavior)."""
+
+    def __init__(self, stream: str) -> None:
+        self._stream = stream
+        self._buf: List[str] = []
+        self._lock = threading.Lock()
+
+    def write(self, text: Any) -> "_DistStream":
+        s = str(text)
+        with self._lock:
+            self._buf.append(s)
+        if "\n" in s:
+            self.flush()
+        return self
+
+    def println(self, text: Any = "") -> "_DistStream":
+        return self.write(f"{text}\n")
+
+    # operator<< spelling for easy porting from the reference API
+    __lshift__ = write
+
+    def flush(self) -> Future:
+        with self._lock:
+            text = "".join(self._buf)
+            self._buf.clear()
+        if not text:
+            from ..futures.future import make_ready_future
+            return make_ready_future(True)
+        root = find_root_locality()
+        if find_here() == root:
+            _console_write.fn(self._stream, text)
+            from ..futures.future import make_ready_future
+            return make_ready_future(True)
+        # async ship to console; returned future completes when printed
+        return async_action(_console_write, root, self._stream, text)
+
+
+cout = _DistStream("cout")
+cerr = _DistStream("cerr")

@@ -1,0 +1,77 @@
+"""Leveled runtime logging (SURVEY.md §2.5 'logging').
+
+Reference analog: libs/core/logging — printf-style leveled logs routed
+to destinations, enabled by --hpx:debug-hpx-log / ini keys. Here: a thin
+layer over stdlib logging wired to the layered config
+(hpx.logging.level, hpx.logging.destination), with the locality id
+stamped into every record the way HPX prefixes its log lines.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from typing import Optional
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "always": logging.CRITICAL,
+    "off": logging.CRITICAL + 10,
+}
+
+_configured = False
+_lock = threading.Lock()
+
+
+class _LocalityFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        from ..dist.runtime import find_here
+        record.locality = find_here()
+        return True
+
+
+def _configure() -> None:
+    global _configured
+    with _lock:
+        if _configured:
+            return
+        from ..core.config import runtime_config
+        cfg = runtime_config()
+        root = logging.getLogger("hpx_tpu")
+        level = _LEVELS.get(cfg.get("hpx.logging.level", "warning"),
+                            logging.WARNING)
+        root.setLevel(level)
+        dest = cfg.get("hpx.logging.destination", "")
+        handler: logging.Handler
+        if dest in ("", "cerr", "stderr"):
+            handler = logging.StreamHandler(sys.stderr)
+        elif dest in ("cout", "stdout"):
+            handler = logging.StreamHandler(sys.stdout)
+        else:
+            handler = logging.FileHandler(dest)
+        handler.setFormatter(logging.Formatter(
+            "[%(asctime)s] [locality#%(locality)s] [%(levelname)s] "
+            "[%(name)s] %(message)s"))
+        handler.addFilter(_LocalityFilter())
+        root.addHandler(handler)
+        root.propagate = False
+        _configured = True
+
+
+def get_logger(module: str = "runtime") -> logging.Logger:
+    """Module loggers hang under 'hpx_tpu.' (agas, parcel, threads...)."""
+    _configure()
+    return logging.getLogger(f"hpx_tpu.{module}")
+
+
+def set_log_level(level: str) -> None:
+    """--hpx:debug-hpx-log analog at runtime; level name per HPX."""
+    _configure()
+    if level not in _LEVELS:
+        raise ValueError(f"unknown log level {level!r}; "
+                         f"one of {sorted(_LEVELS)}")
+    logging.getLogger("hpx_tpu").setLevel(_LEVELS[level])

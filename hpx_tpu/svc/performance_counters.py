@@ -1,0 +1,333 @@
+"""Performance counters — the reference's primary observability surface.
+
+Reference analog: libs/full/performance_counters (SURVEY.md §2.5, §5.1):
+hierarchical named counters `/object{locality#N/instance}/counter`, a
+registry with discovery, query (with optional reset), remote query via
+actions, and `--hpx:print-counter[-interval]` style printing.
+
+TPU-first feeds: the host task pools (executed/stolen/pending), the
+TpuExecutor (dispatches, XLA compilations = jit-cache misses), the
+parcel layer (count/bytes sent+received), and runtime uptime. Device-side
+metrics (HBM in use, per-program stats) come from jax's memory_stats on
+the counter's locality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.errors import Error, HpxError
+
+# ---------------------------------------------------------------------------
+# Counter naming: /objectname{locality#N/instance}/countername
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(
+    r"^/(?P<object>[^{/]+)\{locality#(?P<locality>\d+|\*)/"
+    r"(?P<instance>[^}]+)\}/(?P<counter>.+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class CounterPath:
+    object: str
+    locality: str          # digits or "*"
+    instance: str
+    counter: str
+
+    def format(self) -> str:
+        return (f"/{self.object}{{locality#{self.locality}/"
+                f"{self.instance}}}/{self.counter}")
+
+
+def parse_counter_name(name: str) -> CounterPath:
+    m = _NAME_RE.match(name)
+    if not m:
+        raise HpxError(Error.bad_parameter,
+                       f"malformed counter name: {name!r} (expected "
+                       "/object{locality#N/instance}/counter)")
+    return CounterPath(m.group("object"), m.group("locality"),
+                       m.group("instance"), m.group("counter"))
+
+
+def counter_name(object: str, counter: str, instance: str = "total",
+                 locality: Optional[int] = None) -> str:
+    if locality is None:
+        from ..dist.runtime import find_here
+        locality = find_here()
+    return f"/{object}{{locality#{locality}/{instance}}}/{counter}"
+
+
+# ---------------------------------------------------------------------------
+# Counter kinds
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CounterValue:
+    value: float
+    timestamp: float
+    count: int = 1         # samples aggregated (1 for raw counters)
+
+
+class Counter:
+    def get_value(self, reset: bool = False) -> CounterValue:
+        raise NotImplementedError
+
+
+class GaugeCounter(Counter):
+    """Manually incremented/set value (monotonic or gauge)."""
+
+    def __init__(self, initial: float = 0.0) -> None:
+        self._v = initial
+        self._lock = threading.Lock()
+
+    def add(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._v += delta
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._v = value
+
+    def get_value(self, reset: bool = False) -> CounterValue:
+        with self._lock:
+            v = self._v
+            if reset:
+                self._v = 0.0
+        return CounterValue(v, time.time())
+
+
+class CallbackCounter(Counter):
+    """Value pulled from a callback at query time (most built-ins)."""
+
+    def __init__(self, fn: Callable[[], float],
+                 reset_fn: Optional[Callable[[], None]] = None) -> None:
+        self._fn = fn
+        self._reset = reset_fn
+        self._base = 0.0   # software reset: subtract snapshot
+
+    def get_value(self, reset: bool = False) -> CounterValue:
+        raw = float(self._fn())
+        v = raw - self._base
+        if reset:
+            if self._reset is not None:
+                self._reset()
+                self._base = 0.0
+            else:
+                self._base = raw
+        return CounterValue(v, time.time())
+
+
+class ElapsedTimeCounter(Counter):
+    def __init__(self) -> None:
+        self._t0 = time.time()
+
+    def get_value(self, reset: bool = False) -> CounterValue:
+        now = time.time()
+        v = now - self._t0
+        if reset:
+            self._t0 = now
+        return CounterValue(v, now)
+
+
+class AverageCounter(Counter):
+    """Accumulates samples; value = mean since last reset."""
+
+    def __init__(self) -> None:
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def sample(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._n += 1
+
+    def get_value(self, reset: bool = False) -> CounterValue:
+        with self._lock:
+            v = self._sum / self._n if self._n else 0.0
+            n = self._n
+            if reset:
+                self._sum, self._n = 0.0, 0
+        return CounterValue(v, time.time(), max(n, 1))
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+_registry_lock = threading.RLock()
+_registry: Dict[str, Counter] = {}
+_refresh_hooks: List[Callable[[], None]] = []
+
+
+def register_counter(name: str, counter: Counter) -> Counter:
+    parse_counter_name(name)   # validate
+    with _registry_lock:
+        _registry[name] = counter
+    return counter
+
+
+def unregister_counter(name: str) -> None:
+    with _registry_lock:
+        _registry.pop(name, None)
+
+
+def register_refresh_hook(fn: Callable[[], None]) -> None:
+    """Hook run before discovery/query to (re)register counters for
+    dynamically created objects (pools, executors, parcel layer)."""
+    with _registry_lock:
+        if fn not in _refresh_hooks:
+            _refresh_hooks.append(fn)
+
+
+def _refresh() -> None:
+    with _registry_lock:
+        hooks = list(_refresh_hooks)
+    for fn in hooks:
+        fn()
+
+
+def discover_counters(pattern: str = "*") -> List[str]:
+    """All registered counter names matching the fnmatch pattern.
+    `locality#*` in the pattern matches any locality."""
+    _refresh()
+    with _registry_lock:
+        names = list(_registry)
+    return sorted(n for n in names if fnmatch.fnmatchcase(n, pattern))
+
+
+def query_counter(name: str, reset: bool = False,
+                  _do_refresh: bool = True) -> CounterValue:
+    """Query one counter. A name addressed to another locality routes
+    there as an action (remote counter query, as in the reference)."""
+    path = parse_counter_name(name)
+    from ..dist.runtime import find_here
+    if path.locality != "*" and int(path.locality) != find_here():
+        from ..dist.actions import async_action
+        v, ts, n = async_action(_query_action, int(path.locality),
+                                name, reset).get()
+        return CounterValue(v, ts, n)
+    if _do_refresh:
+        _refresh()
+    with _registry_lock:
+        c = _registry.get(name)
+    if c is None:
+        raise HpxError(Error.bad_parameter, f"no such counter: {name}")
+    return c.get_value(reset)
+
+
+def query_counters(pattern: str = "*", reset: bool = False
+                   ) -> Dict[str, CounterValue]:
+    # discover_counters already ran the refresh hooks once for this call
+    return {n: query_counter(n, reset, _do_refresh=False)
+            for n in discover_counters(pattern)}
+
+
+def print_counters(pattern: str = "*", file=None, reset: bool = False) -> None:
+    """--hpx:print-counter analog: one aligned line per counter."""
+    import sys
+    out = file or sys.stdout
+    for name, cv in query_counters(pattern, reset).items():
+        print(f"{name},{cv.count},{cv.timestamp:.6f},{cv.value:g}", file=out)
+
+
+def start_counter_printing(interval_s: float, pattern: str = "*",
+                           file=None) -> Callable[[], None]:
+    """--hpx:print-counter-interval analog; returns a stop() function."""
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval_s):
+            print_counters(pattern, file)
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="hpx-counter-printer")
+    t.start()
+
+    def stopper() -> None:
+        stop.set()
+        t.join(timeout=2.0)
+
+    return stopper
+
+
+# remote query action (registered lazily to avoid import cycles)
+def _query_action_impl(name: str, reset: bool):
+    cv = query_counter(name, reset)
+    return (cv.value, cv.timestamp, cv.count)
+
+
+from ..dist.actions import plain_action as _plain_action  # noqa: E402
+
+_query_action = _plain_action(name="perf_counters.query")(_query_action_impl)
+
+
+# ---------------------------------------------------------------------------
+# Built-in counters
+# ---------------------------------------------------------------------------
+
+def _register_builtins() -> None:
+    from ..dist.runtime import find_here
+    loc = find_here()
+
+    def put(object: str, counter: str, c: Counter, instance: str = "total"):
+        name = counter_name(object, counter, instance, loc)
+        with _registry_lock:
+            if name not in _registry:
+                _registry[name] = c
+
+    # host task pool (scheduler counters)
+    from ..runtime.threadpool import default_pool
+    pool = default_pool()
+    put("threads", "count/cumulative",
+        CallbackCounter(lambda: pool.stats()["executed"]), "pool#default")
+    put("threads", "count/stolen",
+        CallbackCounter(lambda: pool.stats()["stolen"]), "pool#default")
+    put("threads", "queue/length",
+        CallbackCounter(lambda: pool.stats().get("pending", 0)),
+        "pool#default")
+
+    # runtime uptime
+    name = counter_name("runtime", "uptime", "total", loc)
+    with _registry_lock:
+        if name not in _registry:
+            _registry[name] = ElapsedTimeCounter()
+
+    # device executor
+    from ..exec.tpu import TpuExecutor
+    put("tpu", "count/dispatches",
+        CallbackCounter(lambda: TpuExecutor.dispatch_count), "executor")
+    put("tpu", "count/compilations",
+        CallbackCounter(lambda: TpuExecutor.compile_count), "executor")
+
+    # device memory (best-effort: not all backends report)
+    def hbm_in_use() -> float:
+        import jax
+        try:
+            st = jax.devices()[0].memory_stats()
+            return float((st or {}).get("bytes_in_use", 0))
+        except Exception:  # noqa: BLE001
+            return 0.0
+    put("tpu", "memory/bytes_in_use", CallbackCounter(hbm_in_use),
+        "device#0")
+
+    # parcel layer (only once the distributed runtime is up)
+    from ..dist import runtime as rt
+    if rt._runtime is not None:
+        r = rt._runtime
+        put("parcels", "count/sent",
+            CallbackCounter(lambda: r.parcels_sent))
+        put("parcels", "count/received",
+            CallbackCounter(lambda: r.parcels_received))
+        put("data", "count/sent",
+            CallbackCounter(lambda: r.bytes_sent))
+        put("data", "count/received",
+            CallbackCounter(lambda: r.bytes_received))
+
+
+register_refresh_hook(_register_builtins)

@@ -1,0 +1,255 @@
+"""Task-level software resiliency (SURVEY.md §2.5/§5.3).
+
+Reference analog: libs/core/resiliency + libs/full/resiliency_distributed:
+  async_replay(n, f, ...)            re-run up to n times on exception
+  async_replay_validate(n, pred, f)  ...or on validation failure
+  async_replicate(n, f, ...)         run n concurrent copies, first good
+  async_replicate_validate / _vote   validated / voted consensus result
+  replay_executor / replicate_executor   executor wrappers
+  distributed replay                 retarget other localities per attempt
+
+TPU-first notes: a "task" here is a host callable whose payload is
+usually a device dispatch; XLA programs are deterministic, so replay
+guards against transient HOST/runtime failures and validation guards
+against numerical corruption (the reference's use case is identical).
+Replicate+vote runs the copies concurrently through the task pool and
+elects by value equality (arrays compare by bytes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..core.errors import Error, HpxError
+from ..futures.async_ import async_
+from ..futures.combinators import when_all
+from ..futures.future import Future, SharedState
+
+
+class AbortReplayException(HpxError):
+    """Raised by a task to stop further replays (hpx::resiliency analog)."""
+
+    def __init__(self, msg: str = "replay aborted") -> None:
+        super().__init__(Error.yield_aborted, msg)
+
+
+class AbortReplicateException(AbortReplayException):
+    pass
+
+
+class ReplayValidationError(HpxError):
+    def __init__(self, attempts: int) -> None:
+        super().__init__(Error.invalid_status,
+                         f"validation failed on all {attempts} replays")
+        self.attempts = attempts
+
+
+class ReplicateVotingError(HpxError):
+    def __init__(self, msg: str) -> None:
+        super().__init__(Error.invalid_status, msg)
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+def _replay_loop(n: int, validate: Optional[Callable[[Any], bool]],
+                 fn: Callable[..., Any], args: tuple, kwargs: dict) -> Any:
+    last_exc: Optional[BaseException] = None
+    for _attempt in range(n):
+        try:
+            result = fn(*args, **kwargs)
+        except AbortReplayException:
+            raise
+        except BaseException as e:  # noqa: BLE001
+            last_exc = e
+            continue
+        if validate is None or validate(result):
+            return result
+        last_exc = None
+    if last_exc is not None:
+        raise last_exc
+    raise ReplayValidationError(n)
+
+
+def async_replay(n: int, fn: Callable[..., Any], *args: Any,
+                 **kwargs: Any) -> Future:
+    """Run fn; on exception re-run, up to n attempts total."""
+    return async_(_replay_loop, n, None, fn, args, kwargs)
+
+
+def async_replay_validate(n: int, validate: Callable[[Any], bool],
+                          fn: Callable[..., Any], *args: Any,
+                          **kwargs: Any) -> Future:
+    """Re-run until validate(result) is truthy, up to n attempts."""
+    return async_(_replay_loop, n, validate, fn, args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# replicate
+# ---------------------------------------------------------------------------
+
+def _values_equal(a: Any, b: Any) -> bool:
+    try:
+        import numpy as np
+        if hasattr(a, "shape") or hasattr(b, "shape"):
+            return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+    except Exception:  # noqa: BLE001
+        pass
+    return bool(a == b)
+
+
+def _replicate_gather(n: int, fn: Callable[..., Any], args: tuple,
+                      kwargs: dict) -> List[Future]:
+    return [async_(fn, *args, **kwargs) for _ in range(n)]
+
+
+def _elect(futs: List[Future],
+           validate: Optional[Callable[[Any], bool]],
+           vote: Optional[Callable[[List[Any]], Any]]) -> Any:
+    when_all(futs).get()
+    goods: List[Any] = []
+    last_exc: Optional[BaseException] = None
+    for f in futs:
+        try:
+            v = f.get()
+        except AbortReplicateException:
+            raise
+        except BaseException as e:  # noqa: BLE001
+            last_exc = e
+            continue
+        if validate is None or validate(v):
+            goods.append(v)
+    if not goods:
+        if last_exc is not None:
+            raise last_exc
+        raise ReplicateVotingError("no replica produced a valid result")
+    if vote is not None:
+        return vote(goods)
+    return goods[0]
+
+
+def async_replicate(n: int, fn: Callable[..., Any], *args: Any,
+                    **kwargs: Any) -> Future:
+    """n concurrent copies; first successful result wins."""
+    futs = _replicate_gather(n, fn, args, kwargs)
+    return async_(_elect, futs, None, None)
+
+
+def async_replicate_validate(n: int, validate: Callable[[Any], bool],
+                             fn: Callable[..., Any], *args: Any,
+                             **kwargs: Any) -> Future:
+    futs = _replicate_gather(n, fn, args, kwargs)
+    return async_(_elect, futs, validate, None)
+
+
+def majority_vote(values: List[Any]) -> Any:
+    """Default voter: the most frequent value (ties -> first seen)."""
+    best, best_count = None, -1
+    for i, v in enumerate(values):
+        c = sum(1 for w in values if _values_equal(v, w))
+        if c > best_count:
+            best, best_count = v, c
+    if best_count * 2 <= len(values) and len(values) > 2:
+        raise ReplicateVotingError(
+            f"no majority among {len(values)} replicas")
+    return best
+
+
+def async_replicate_vote(n: int, vote: Callable[[List[Any]], Any],
+                         fn: Callable[..., Any], *args: Any,
+                         **kwargs: Any) -> Future:
+    futs = _replicate_gather(n, fn, args, kwargs)
+    return async_(_elect, futs, None, vote)
+
+
+# ---------------------------------------------------------------------------
+# executor wrappers (replay_executor / replicate_executor)
+# ---------------------------------------------------------------------------
+
+class ReplayExecutor:
+    """Wraps an executor; every async_execute is replayed on failure."""
+
+    def __init__(self, n: int, executor: Any = None,
+                 validate: Optional[Callable[[Any], bool]] = None) -> None:
+        from ..exec.executors import ParallelExecutor
+        self.n = n
+        self.validate = validate
+        self.executor = executor or ParallelExecutor()
+
+    def async_execute(self, fn: Callable[..., Any], *args: Any,
+                      **kwargs: Any) -> Future:
+        return self.executor.async_execute(
+            _replay_loop, self.n, self.validate, fn, args, kwargs)
+
+    def sync_execute(self, fn: Callable[..., Any], *args: Any,
+                     **kwargs: Any) -> Any:
+        return _replay_loop(self.n, self.validate, fn, args, kwargs)
+
+    def post(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
+        self.executor.post(_replay_loop, self.n, self.validate, fn, args,
+                           kwargs)
+
+
+class ReplicateExecutor:
+    """Wraps an executor; every async_execute runs n replicas + election."""
+
+    def __init__(self, n: int, executor: Any = None,
+                 validate: Optional[Callable[[Any], bool]] = None,
+                 vote: Optional[Callable[[List[Any]], Any]] = None) -> None:
+        from ..exec.executors import ParallelExecutor
+        self.n = n
+        self.validate = validate
+        self.vote = vote
+        self.executor = executor or ParallelExecutor()
+
+    def async_execute(self, fn: Callable[..., Any], *args: Any,
+                      **kwargs: Any) -> Future:
+        futs = [self.executor.async_execute(fn, *args, **kwargs)
+                for _ in range(self.n)]
+        return async_(_elect, futs, self.validate, self.vote)
+
+    def sync_execute(self, fn: Callable[..., Any], *args: Any,
+                     **kwargs: Any) -> Any:
+        return self.async_execute(fn, *args, **kwargs).get()
+
+
+# ---------------------------------------------------------------------------
+# distributed replay: retarget other localities per attempt
+# ---------------------------------------------------------------------------
+
+def async_replay_distributed(n: int, action: Any, *args: Any,
+                             localities: Optional[Sequence[int]] = None,
+                             validate: Optional[Callable[[Any], bool]] = None,
+                             ) -> Future:
+    """Attempt the action on a sequence of localities (default: here,
+    then the others round-robin); each failure moves to the next
+    (libs/full/resiliency_distributed behavior)."""
+    from ..dist.actions import async_action
+    from ..dist.runtime import find_all_localities, find_here
+
+    if localities is None:
+        here = find_here()
+        rest = [l for l in find_all_localities() if l != here]
+        localities = [here] + rest
+
+    def run() -> Any:
+        last_exc: Optional[BaseException] = None
+        for attempt in range(n):
+            loc = localities[attempt % len(localities)]
+            try:
+                result = async_action(action, loc, *args).get()
+            except AbortReplayException:
+                raise
+            except BaseException as e:  # noqa: BLE001
+                last_exc = e
+                continue
+            if validate is None or validate(result):
+                return result
+            last_exc = None
+        if last_exc is not None:
+            raise last_exc
+        raise ReplayValidationError(n)
+
+    return async_(run)

@@ -1,0 +1,44 @@
+"""Multi-locality perf-counter smoke: remote counter query via actions.
+
+Locality 0 queries locality 1's thread counter by name (the reference
+queries any locality's counters the same way — SURVEY.md §2.5).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import hpx_tpu as hpx
+from hpx_tpu.svc import performance_counters as pc
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+
+    # generate some local pool work everywhere
+    hpx.wait_all([hpx.async_(lambda: None) for _ in range(10)])
+
+    if here == 0:
+        other = 1
+        name = (f"/threads{{locality#{other}/pool#default}}"
+                "/count/cumulative")
+        v = pc.query_counter(name).value
+        HPX_TEST(v >= 10, v)
+        # parcel counters registered once the distributed runtime is up
+        sent = pc.query_counter(
+            f"/parcels{{locality#{here}/total}}/count/sent").value
+        HPX_TEST(sent >= 1, sent)
+        # remote uptime too
+        up = pc.query_counter(
+            f"/runtime{{locality#{other}/total}}/uptime").value
+        HPX_TEST(up > 0)
+    hpx.get_runtime().barrier("pc-done")
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Multi-locality services smoke: hpx::cout marshalling to the console
+locality + distributed replay retargeting localities."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import hpx_tpu as hpx
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+_fail_on = {0}   # locality 0's attempt fails -> replay must move to 1
+
+
+@hpx.plain_action
+def flaky_where():
+    here = hpx.find_here()
+    if here in _fail_on:
+        raise RuntimeError(f"injected failure on locality {here}")
+    return here
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+
+    # every locality writes through hpx.cout; all output lands on the
+    # console (locality 0) stdout — the launcher surfaces it either way,
+    # what we verify here is that the flush future completes remotely.
+    hpx.cout.println(f"[cout] locality {here} says hello")
+    hpx.cout.flush().get(timeout=15.0)
+
+    if here == 0:
+        # distributed replay: first attempt (here=0) fails, retargets 1
+        v = hpx.async_replay_distributed(3, flaky_where).get(timeout=30.0)
+        HPX_TEST_EQ(v, 1)
+
+    hpx.get_runtime().barrier("svc-done")
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""M9a tests: performance counters (SURVEY.md §2.5/§5.1)."""
+
+import io
+import os
+import time
+
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.svc import performance_counters as pc
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class TestNaming:
+    def test_parse_roundtrip(self):
+        n = "/threads{locality#0/pool#default}/count/cumulative"
+        p = pc.parse_counter_name(n)
+        HPX_TEST_EQ(p.object, "threads")
+        HPX_TEST_EQ(p.locality, "0")
+        HPX_TEST_EQ(p.instance, "pool#default")
+        HPX_TEST_EQ(p.counter, "count/cumulative")
+        HPX_TEST_EQ(p.format(), n)
+
+    def test_parse_wildcard_locality(self):
+        p = pc.parse_counter_name("/x{locality#*/total}/y")
+        HPX_TEST_EQ(p.locality, "*")
+
+    def test_malformed_raises(self):
+        for bad in ["threads/count", "/t{locality0/i}/c", "/t{}/c", ""]:
+            with pytest.raises(hpx.HpxError):
+                pc.parse_counter_name(bad)
+
+    def test_counter_name_helper(self):
+        HPX_TEST_EQ(pc.counter_name("parcels", "count/sent", locality=3),
+                    "/parcels{locality#3/total}/count/sent")
+
+
+class TestCounterKinds:
+    def test_gauge(self):
+        c = pc.GaugeCounter()
+        c.add(5); c.add(2.5)
+        HPX_TEST_EQ(c.get_value().value, 7.5)
+        HPX_TEST_EQ(c.get_value(reset=True).value, 7.5)
+        HPX_TEST_EQ(c.get_value().value, 0.0)
+
+    def test_callback_with_software_reset(self):
+        box = [10.0]
+        c = pc.CallbackCounter(lambda: box[0])
+        HPX_TEST_EQ(c.get_value(reset=True).value, 10.0)
+        box[0] = 25.0
+        HPX_TEST_EQ(c.get_value().value, 15.0)  # delta since reset
+
+    def test_elapsed(self):
+        c = pc.ElapsedTimeCounter()
+        time.sleep(0.02)
+        HPX_TEST(c.get_value().value >= 0.02)
+        HPX_TEST(c.get_value(reset=True).value >= 0.02)
+        HPX_TEST(c.get_value().value < 0.02)
+
+    def test_average(self):
+        c = pc.AverageCounter()
+        for v in (1.0, 2.0, 3.0):
+            c.sample(v)
+        cv = c.get_value()
+        HPX_TEST_EQ(cv.value, 2.0)
+        HPX_TEST_EQ(cv.count, 3)
+
+
+class TestRegistry:
+    def test_register_discover_query(self):
+        name = "/myobj{locality#0/total}/widgets"
+        g = pc.register_counter(name, pc.GaugeCounter())
+        try:
+            g.add(42)
+            HPX_TEST(name in pc.discover_counters("/myobj{*"))
+            HPX_TEST_EQ(pc.query_counter(name).value, 42.0)
+        finally:
+            pc.unregister_counter(name)
+        with pytest.raises(hpx.HpxError):
+            pc.query_counter(name)
+
+    def test_builtin_counters_exist(self):
+        names = pc.discover_counters()
+        for want in ("/threads{locality#0/pool#default}/count/cumulative",
+                     "/threads{locality#0/pool#default}/count/stolen",
+                     "/runtime{locality#0/total}/uptime",
+                     "/tpu{locality#0/executor}/count/dispatches",
+                     "/tpu{locality#0/executor}/count/compilations"):
+            HPX_TEST(want in names, want)
+
+    def test_thread_counter_advances_with_work(self):
+        name = "/threads{locality#0/pool#default}/count/cumulative"
+        before = pc.query_counter(name).value
+        hpx.wait_all([hpx.async_(lambda: None) for _ in range(20)])
+        HPX_TEST(pc.query_counter(name).value >= before + 20)
+
+    def test_dispatch_counter_advances(self):
+        import jax.numpy as jnp
+        name = "/tpu{locality#0/executor}/count/dispatches"
+        before = pc.query_counter(name).value
+        hpx.TpuExecutor().async_execute(lambda x: x + 1, jnp.float32(1)).get()
+        HPX_TEST(pc.query_counter(name).value >= before + 1)
+
+    def test_uptime_monotonic(self):
+        name = "/runtime{locality#0/total}/uptime"
+        a = pc.query_counter(name).value
+        time.sleep(0.01)
+        HPX_TEST(pc.query_counter(name).value > a)
+
+
+class TestPrinting:
+    def test_print_counters_format(self):
+        buf = io.StringIO()
+        pc.print_counters("/runtime{*", file=buf)
+        line = buf.getvalue().strip()
+        HPX_TEST(line.startswith("/runtime{locality#0/total}/uptime,"))
+        HPX_TEST_EQ(len(line.split(",")), 4)
+
+    def test_interval_printer_stops(self):
+        buf = io.StringIO()
+        stop = pc.start_counter_printing(0.02, "/runtime{*", file=buf)
+        time.sleep(0.08)
+        stop()
+        n = buf.getvalue().count("\n")
+        HPX_TEST(n >= 2, n)
+        time.sleep(0.05)
+        HPX_TEST_EQ(buf.getvalue().count("\n"), n)  # really stopped
+
+
+def test_multiprocess_remote_query():
+    from hpx_tpu.run import launch
+    rc = launch(os.path.join(REPO, "tests", "mp_scripts",
+                             "perf_counters_smoke.py"),
+                [], localities=2, timeout=120.0)
+    assert rc == 0

@@ -1,0 +1,254 @@
+"""M9 tests: checkpoint/restore, resiliency, logging, iostreams,
+profiler bridge (SURVEY.md §2.5, §5.1, §5.3, §5.4)."""
+
+import io
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+# -- checkpoint ---------------------------------------------------------------
+
+class TestCheckpoint:
+    def test_roundtrip_basic_values(self):
+        cp = hpx.save_checkpoint(1, "two", [3.0, {"four": 4}]).get()
+        HPX_TEST_EQ(hpx.restore_checkpoint(cp), (1, "two", [3.0, {"four": 4}]))
+
+    def test_futures_store_their_values(self):
+        f = hpx.async_(lambda: 41 + 1)
+        cp = hpx.save_checkpoint(f, "tag").get()
+        HPX_TEST_EQ(hpx.restore_checkpoint(cp), (42, "tag"))
+
+    def test_jax_arrays_roundtrip(self):
+        u = jnp.arange(100, dtype=jnp.float32) * 1.5
+        (v, n) = hpx.restore_checkpoint(hpx.save_checkpoint(u, 7).get())
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(u))
+        HPX_TEST_EQ(n, 7)
+
+    def test_partitioned_vector_roundtrip(self, mesh1d):
+        layout = hpx.container_layout(8, mesh=mesh1d)
+        pv = hpx.PartitionedVector.from_array(
+            np.arange(64, dtype=np.float32), layout)
+        (pv2,) = hpx.restore_checkpoint(hpx.save_checkpoint(pv).get())
+        HPX_TEST(isinstance(pv2, hpx.PartitionedVector))
+        HPX_TEST_EQ(pv2.num_partitions, 8)
+        np.testing.assert_array_equal(pv2.to_numpy(), pv.to_numpy())
+
+    def test_file_roundtrip(self, tmp_path):
+        path = tmp_path / "state.ckpt"
+        hpx.save_checkpoint_to_file(path, {"step": 10},
+                                    jnp.ones(8)).get()
+        state, arr = hpx.restore_checkpoint_from_file(path)
+        HPX_TEST_EQ(state["step"], 10)
+        np.testing.assert_array_equal(np.asarray(arr), np.ones(8))
+
+    def test_stream_roundtrip(self):
+        cp = hpx.save_checkpoint("x").get()
+        buf = io.BytesIO()
+        cp.write(buf)
+        buf.seek(0)
+        HPX_TEST_EQ(hpx.Checkpoint.read(buf), cp)
+
+    def test_bad_stream_raises(self):
+        with pytest.raises(ValueError):
+            hpx.Checkpoint.read(io.BytesIO(b"not a checkpoint"))
+
+    def test_stencil_checkpoint_resume(self):
+        # the reference's 1d_stencil checkpoint variant, in miniature:
+        # run T steps, checkpoint, run T more, vs 2T straight
+        from hpx_tpu.models.stencil1d import StencilParams, stencil_fused
+        p1 = StencilParams(nx=64, np_=4, nt=10)
+        u_mid = stencil_fused(p1)
+        (r,) = hpx.restore_checkpoint(hpx.save_checkpoint(u_mid).get())
+        u_res = stencil_fused(p1, u0=r)
+        u_straight = stencil_fused(StencilParams(nx=64, np_=4, nt=20))
+        np.testing.assert_allclose(np.asarray(u_res),
+                                   np.asarray(u_straight), rtol=1e-5)
+
+
+# -- resiliency ---------------------------------------------------------------
+
+class _Flaky:
+    """Fails the first k calls, then succeeds."""
+
+    def __init__(self, k: int, value=123):
+        self.k = k
+        self.value = value
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            self.calls += 1
+            if self.calls <= self.k:
+                raise RuntimeError(f"transient #{self.calls}")
+        return self.value
+
+
+class TestReplay:
+    def test_succeeds_after_transient_failures(self):
+        f = _Flaky(2)
+        HPX_TEST_EQ(hpx.async_replay(4, f).get(), 123)
+        HPX_TEST_EQ(f.calls, 3)
+
+    def test_exhausted_raises_last_error(self):
+        with pytest.raises(RuntimeError, match="transient #3"):
+            hpx.async_replay(3, _Flaky(99)).get()
+
+    def test_validate(self):
+        box = [0]
+
+        def step():
+            box[0] += 1
+            return box[0]
+
+        HPX_TEST_EQ(
+            hpx.async_replay_validate(5, lambda v: v >= 3, step).get(), 3)
+
+    def test_validate_exhausted(self):
+        with pytest.raises(hpx.ReplayValidationError):
+            hpx.async_replay_validate(2, lambda v: False, lambda: 1).get()
+
+    def test_abort_stops_replays(self):
+        calls = [0]
+
+        def f():
+            calls[0] += 1
+            raise hpx.AbortReplayException("fatal")
+
+        with pytest.raises(hpx.AbortReplayException):
+            hpx.async_replay(10, f).get()
+        HPX_TEST_EQ(calls[0], 1)
+
+
+class TestReplicate:
+    def test_first_good_wins(self):
+        HPX_TEST_EQ(hpx.async_replicate(3, lambda: 7).get(), 7)
+
+    def test_tolerates_minority_failures(self):
+        state = {"n": 0}
+        lock = threading.Lock()
+
+        def f():
+            with lock:
+                state["n"] += 1
+                me = state["n"]
+            if me == 1:
+                raise RuntimeError("one bad replica")
+            return 5
+
+        HPX_TEST_EQ(hpx.async_replicate(3, f).get(), 5)
+
+    def test_all_fail_raises(self):
+        def boom():
+            raise RuntimeError("dead")
+        with pytest.raises(RuntimeError):
+            hpx.async_replicate(3, boom).get()
+
+    def test_vote_majority(self):
+        state = {"n": 0}
+        lock = threading.Lock()
+
+        def f():
+            with lock:
+                state["n"] += 1
+                me = state["n"]
+            return 1 if me == 1 else 2   # minority says 1, majority 2
+
+        HPX_TEST_EQ(
+            hpx.async_replicate_vote(3, hpx.majority_vote, f).get(), 2)
+
+    def test_vote_arrays(self):
+        HPX_TEST_EQ(int(hpx.async_replicate_vote(
+            3, hpx.majority_vote, lambda: jnp.float32(4)).get()), 4)
+
+    def test_validate_filters(self):
+        state = {"n": 0}
+        lock = threading.Lock()
+
+        def f():
+            with lock:
+                state["n"] += 1
+                return state["n"]
+
+        v = hpx.async_replicate_validate(4, lambda x: x % 2 == 0, f).get()
+        HPX_TEST(v % 2 == 0)
+
+
+class TestResiliencyExecutors:
+    def test_replay_executor(self):
+        f = _Flaky(1, "ok")
+        ex = hpx.ReplayExecutor(3)
+        HPX_TEST_EQ(ex.async_execute(f).get(), "ok")
+
+    def test_replicate_executor_on_tpu_exec(self):
+        ex = hpx.ReplicateExecutor(3, executor=hpx.TpuExecutor())
+        out = ex.async_execute(lambda x: x * 2, jnp.float32(21)).get()
+        HPX_TEST_EQ(float(out), 42.0)
+
+
+# -- logging / iostreams / profiling -----------------------------------------
+
+class TestLogging:
+    def test_get_logger_and_level(self):
+        log = hpx.get_logger("test")
+        hpx.set_log_level("debug")
+        HPX_TEST(log.isEnabledFor(10))
+        hpx.set_log_level("warning")
+        HPX_TEST(not log.isEnabledFor(10))
+        with pytest.raises(ValueError):
+            hpx.set_log_level("nope")
+
+
+class TestIostreams:
+    def test_local_cout_writes_stdout(self, capsys):
+        hpx.cout.println("hello from locality 0")
+        hpx.cout.flush().get()
+        assert "hello from locality 0" in capsys.readouterr().out
+
+    def test_lshift_spelling(self, capsys):
+        (hpx.cout << "a=" << 1 << "\n").flush().get()
+        assert "a=1" in capsys.readouterr().out
+
+
+class TestProfiling:
+    def test_task_timing_collects(self):
+        def named_work():
+            return sum(range(100))
+
+        with hpx.profiling.task_timing() as t:
+            hpx.wait_all([hpx.async_(named_work) for _ in range(8)])
+        rows = t.top()
+        HPX_TEST(any("named_work" in name for name, _c, _t in rows), rows)
+        name, count, total = [r for r in rows if "named_work" in r[0]][0]
+        HPX_TEST(count >= 8)
+        HPX_TEST(total >= 0.0)
+
+    def test_observer_removed_after_scope(self):
+        from hpx_tpu.runtime import threadpool
+        with hpx.profiling.task_timing():
+            pass
+        HPX_TEST(threadpool._task_observer is None)
+
+    def test_annotate_runs(self):
+        with hpx.profiling.annotate("test-region"):
+            pass
+
+    def test_device_memory_stats_dict(self):
+        HPX_TEST(isinstance(hpx.profiling.device_memory_stats(), dict))
+
+
+def test_multiprocess_services():
+    from hpx_tpu.run import launch
+    rc = launch(os.path.join(REPO, "tests", "mp_scripts",
+                             "services_smoke.py"),
+                [], localities=2, timeout=120.0)
+    assert rc == 0
