@@ -97,7 +97,10 @@ class Checkpoint:
         magic = stream.read(len(_MAGIC))
         if magic != _MAGIC:
             raise ValueError("not a hpx_tpu checkpoint stream")
-        (n,) = (int.from_bytes(stream.read(8), "little"),)
+        raw = stream.read(8)
+        if len(raw) != 8:
+            raise ValueError("truncated checkpoint stream (length header)")
+        n = int.from_bytes(raw, "little")
         data = stream.read(n)
         if len(data) != n:
             raise ValueError("truncated checkpoint stream")

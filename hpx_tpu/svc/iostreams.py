@@ -37,6 +37,7 @@ class _DistStream:
     def __init__(self, stream: str) -> None:
         self._stream = stream
         self._buf: List[str] = []
+        self._pending: List[Future] = []
         self._lock = threading.Lock()
 
     def write(self, text: Any) -> "_DistStream":
@@ -54,19 +55,38 @@ class _DistStream:
     __lshift__ = write
 
     def flush(self) -> Future:
+        """Returns a future that completes once everything written so far
+        (including writes shipped by earlier newline-triggered flushes
+        still in flight) has been printed on the console locality; remote
+        write failures propagate through .get()."""
+        from ..futures.combinators import when_all
+        from ..futures.future import make_ready_future
+
         with self._lock:
             text = "".join(self._buf)
             self._buf.clear()
-        if not text:
-            from ..futures.future import make_ready_future
+            pending = list(self._pending)
+        if text:
+            root = find_root_locality()
+            if find_here() == root:
+                _console_write.fn(self._stream, text)
+            else:
+                f = async_action(_console_write, root, self._stream, text)
+                with self._lock:
+                    self._pending.append(f)
+                pending.append(f)
+        if not pending:
             return make_ready_future(True)
-        root = find_root_locality()
-        if find_here() == root:
-            _console_write.fn(self._stream, text)
-            from ..futures.future import make_ready_future
-            return make_ready_future(True)
-        # async ship to console; returned future completes when printed
-        return async_action(_console_write, root, self._stream, text)
+
+        def settle(ready: Future) -> bool:
+            with self._lock:
+                self._pending = [p for p in self._pending
+                                 if p not in pending]
+            for f in ready.get():
+                f.get()          # propagate any remote-write exception
+            return True
+
+        return when_all(pending).then(settle)
 
 
 cout = _DistStream("cout")

@@ -122,16 +122,23 @@ class CallbackCounter(Counter):
         return CounterValue(v, time.time())
 
 
+_MODULE_T0 = time.monotonic()  # process-lifetime anchor for uptime
+
+
 class ElapsedTimeCounter(Counter):
-    def __init__(self) -> None:
-        self._t0 = time.time()
+    """Registration can be lazy (first remote query), so anchor to module
+    import time by default — otherwise a register-then-read in the same
+    clock quantum reports uptime == 0."""
+
+    def __init__(self, t0: Optional[float] = None) -> None:
+        self._t0 = _MODULE_T0 if t0 is None else t0
 
     def get_value(self, reset: bool = False) -> CounterValue:
-        now = time.time()
+        now = time.monotonic()
         v = now - self._t0
         if reset:
             self._t0 = now
-        return CounterValue(v, now)
+        return CounterValue(v, time.time())
 
 
 class AverageCounter(Counter):
@@ -316,18 +323,25 @@ def _register_builtins() -> None:
     put("tpu", "memory/bytes_in_use", CallbackCounter(hbm_in_use),
         "device#0")
 
-    # parcel layer (only once the distributed runtime is up)
+    # parcel layer (only once the distributed runtime is up). Read the
+    # CURRENT runtime at query time: closing over the runtime object
+    # alive at first registration would report frozen values (and pin a
+    # dead Runtime) after a finalize()+init() cycle.
     from ..dist import runtime as rt
     if rt._runtime is not None:
-        r = rt._runtime
+        def _rt_attr(attr: str) -> Callable[[], float]:
+            def read() -> float:
+                r = rt._runtime
+                return float(getattr(r, attr)) if r is not None else 0.0
+            return read
         put("parcels", "count/sent",
-            CallbackCounter(lambda: r.parcels_sent))
+            CallbackCounter(_rt_attr("parcels_sent")))
         put("parcels", "count/received",
-            CallbackCounter(lambda: r.parcels_received))
+            CallbackCounter(_rt_attr("parcels_received")))
         put("data", "count/sent",
-            CallbackCounter(lambda: r.bytes_sent))
+            CallbackCounter(_rt_attr("bytes_sent")))
         put("data", "count/received",
-            CallbackCounter(lambda: r.bytes_received))
+            CallbackCounter(_rt_attr("bytes_received")))
 
 
 register_refresh_hook(_register_builtins)

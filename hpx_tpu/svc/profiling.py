@@ -30,18 +30,20 @@ _hooks: List[Any] = []      # objects with optional on_submit/on_start/on_stop
 
 def register_external_timer(hook: Any) -> None:
     """hook may define on_submit(fn), on_start(fn), on_stop(fn, seconds)."""
+    # toggle under the same lock as the list mutation: otherwise a
+    # concurrent register/last-unregister pair can interleave so the
+    # observer ends disabled while _hooks is non-empty
     with _hooks_lock:
         if hook not in _hooks:
             _hooks.append(hook)
-    _set_pool_instrumentation(True)
+        _set_pool_instrumentation(bool(_hooks))
 
 
 def unregister_external_timer(hook: Any) -> None:
     with _hooks_lock:
         if hook in _hooks:
             _hooks.remove(hook)
-        if not _hooks:
-            _set_pool_instrumentation(False)
+        _set_pool_instrumentation(bool(_hooks))
 
 
 def _emit(event: str, *args: Any) -> None:

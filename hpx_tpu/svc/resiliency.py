@@ -178,18 +178,39 @@ class ReplayExecutor:
         self.validate = validate
         self.executor = executor or ParallelExecutor()
 
+    def _attempts(self, fn: Callable[..., Any], args: tuple,
+                  kwargs: dict) -> Any:
+        """Host-side replay loop; each ATTEMPT goes through the wrapped
+        executor (so a TpuExecutor compiles fn, not the loop — passing
+        the loop itself into a compiling executor would trace Python
+        callables as jit arguments and always fail)."""
+        last_exc: Optional[BaseException] = None
+        for _attempt in range(self.n):
+            try:
+                result = self.executor.async_execute(
+                    fn, *args, **kwargs).get()
+            except AbortReplayException:
+                raise
+            except BaseException as e:  # noqa: BLE001
+                last_exc = e
+                continue
+            if self.validate is None or self.validate(result):
+                return result
+            last_exc = None
+        if last_exc is not None:
+            raise last_exc
+        raise ReplayValidationError(self.n)
+
     def async_execute(self, fn: Callable[..., Any], *args: Any,
                       **kwargs: Any) -> Future:
-        return self.executor.async_execute(
-            _replay_loop, self.n, self.validate, fn, args, kwargs)
+        return async_(self._attempts, fn, args, kwargs)
 
     def sync_execute(self, fn: Callable[..., Any], *args: Any,
                      **kwargs: Any) -> Any:
-        return _replay_loop(self.n, self.validate, fn, args, kwargs)
+        return self._attempts(fn, args, kwargs)
 
     def post(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
-        self.executor.post(_replay_loop, self.n, self.validate, fn, args,
-                           kwargs)
+        async_(self._attempts, fn, args, kwargs)
 
 
 class ReplicateExecutor:

@@ -20,6 +20,9 @@ def main() -> int:
 
     # generate some local pool work everywhere
     hpx.wait_all([hpx.async_(lambda: None) for _ in range(10)])
+    # barrier: locality 0 must not query locality 1's thread counter
+    # until locality 1 has actually executed its tasks
+    hpx.get_runtime().barrier("pc-work-done")
 
     if here == 0:
         other = 1

@@ -123,7 +123,15 @@ def test_native_lib_builds_and_pools_work():
                 break
             deadline.wait(0.01)
         assert sorted(out) == list(range(50))
+        # the executed counter is incremented AFTER the task body returns,
+        # so poll: side effects (out/ev) can be visible before the final
+        # fetch_add lands
         st = p.stats()
+        for _ in range(100):
+            if st["executed"] >= 51:
+                break
+            deadline.wait(0.01)
+            st = p.stats()
         assert st["executed"] >= 51 and st["threads"] == 2
     finally:
         p.shutdown()

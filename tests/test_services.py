@@ -61,6 +61,15 @@ class TestCheckpoint:
         with pytest.raises(ValueError):
             hpx.Checkpoint.read(io.BytesIO(b"not a checkpoint"))
 
+    def test_truncated_after_magic_raises(self):
+        cp = hpx.save_checkpoint("x").get()
+        buf = io.BytesIO()
+        cp.write(buf)
+        whole = buf.getvalue()
+        for cut in (12, 15, 25):  # after magic, mid-header, mid-payload
+            with pytest.raises(ValueError):
+                hpx.Checkpoint.read(io.BytesIO(whole[:cut]))
+
     def test_stencil_checkpoint_resume(self):
         # the reference's 1d_stencil checkpoint variant, in miniature:
         # run T steps, checkpoint, run T more, vs 2T straight
@@ -193,6 +202,15 @@ class TestResiliencyExecutors:
         ex = hpx.ReplicateExecutor(3, executor=hpx.TpuExecutor())
         out = ex.async_execute(lambda x: x * 2, jnp.float32(21)).get()
         HPX_TEST_EQ(float(out), 42.0)
+
+    def test_replay_executor_on_tpu_exec(self):
+        # regression: the replay LOOP must stay host-side; only the
+        # attempt payload goes through the (compiling) wrapped executor
+        ex = hpx.ReplayExecutor(3, executor=hpx.TpuExecutor())
+        out = ex.async_execute(lambda x: x + 1, jnp.float32(41)).get()
+        HPX_TEST_EQ(float(out), 42.0)
+        HPX_TEST_EQ(float(ex.sync_execute(lambda x: x + 2,
+                                          jnp.float32(40))), 42.0)
 
 
 # -- logging / iostreams / profiling -----------------------------------------
