@@ -72,6 +72,13 @@ from .dist import (  # noqa: F401
 )
 from .dist import agas  # noqa: F401
 
+# -- components: distributed objects (hpx::components) -----------------------
+from .dist.components import (  # noqa: F401
+    Client, Component, IdType,
+    new_, new_sync, migrate, async_colocated,
+    register_component_type, register_with_basename, find_from_basename,
+)
+
 # -- partitioned data + segmented algorithms (M6) ----------------------------
 from .containers import (  # noqa: F401
     PartitionedVector, PartitionedVectorView, Segment,

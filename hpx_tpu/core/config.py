@@ -219,9 +219,14 @@ class Configuration:
             return "\n".join(f"{k} = {v}" for k, v in sorted(self._data.items()))
 
     def os_threads(self) -> int:
+        """Host pool width. Unlike the reference (one OS thread per core
+        running compute), our pool threads ORCHESTRATE — they block on
+        futures/actions/device fences while XLA does the compute — so
+        'auto' floors at 4: on a 1-core sandbox a single thread would
+        let any blocking task starve the whole control plane."""
         v = self.get("hpx.os_threads", "auto")
         if v == "auto":
-            return max(1, os.cpu_count() or 1)
+            return max(4, os.cpu_count() or 1)
         return max(1, int(v))
 
 

@@ -93,6 +93,22 @@ class HpxError(RuntimeError):
     def get_error(self) -> Error:
         return self.code
 
+    def __reduce__(self):
+        # exceptions travel inside parcels: default exception pickling
+        # would re-call __init__ with the FORMATTED message as the code
+        # argument, which breaks on the receiving side. __dict__ rides
+        # along wholesale so subclass attributes (e.g.
+        # ReplayValidationError.attempts) survive the wire.
+        return (_restore_hpx_error,
+                (type(self), self.args[0] if self.args else ""),
+                dict(self.__dict__))
+
+
+def _restore_hpx_error(cls, text: str):
+    e = cls.__new__(cls)
+    RuntimeError.__init__(e, text)
+    return e
+
 
 class FutureError(HpxError):
     """std::future_error analog for future/promise protocol violations."""

@@ -6,6 +6,18 @@ from .actions import (  # noqa: F401
     plain_action,
     post_action,
 )
+from .components import (  # noqa: F401
+    Client,
+    Component,
+    IdType,
+    async_colocated,
+    find_from_basename,
+    migrate,
+    new_,
+    new_sync,
+    register_component_type,
+    register_with_basename,
+)
 from .runtime import (  # noqa: F401
     Runtime,
     finalize,

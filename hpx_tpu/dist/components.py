@@ -1,0 +1,512 @@
+"""Distributed components: remotely creatable, invocable, migratable objects.
+
+Reference analog: libs/full/components_base + components +
+runtime_components (`hpx::components::component_base`, `client_base`,
+`HPX_REGISTER_COMPONENT`, `hpx::new_<T>(locality)`, migration via AGAS
+pin/unpin — SURVEY.md §2.4) and libs/full/naming (`hpx::id_type`,
+`gid_type`).
+
+TPU-first shape:
+  - A gid is `(home_locality, type_name, lid)` — stable across
+    migrations; AGAS-style resolution maps gid → CURRENT locality
+    (local forwarding table first, console KV for migrated objects).
+    The reference's 128-bit gid + credit-splitting GC is replaced by
+    explicit lifetime (`free()` / `with` scope): a Python control plane
+    has no cross-process refcounting to piggyback on, so we make
+    destruction explicit instead of pretending.
+  - `Component` subclasses are ordinary Python classes registered by
+    name (`register_component_type`, the HPX_REGISTER_COMPONENT analog);
+    the same code imports on every locality, so the registry is
+    rendezvous-free.
+  - `new_(Cls, locality, *args)` returns a future<Client>; `Client`
+    proxies attribute calls to futures-returning remote invocations
+    (client_base's `async`/`sync` spelling both provided).
+  - Migration serializes the instance with the parcel serializer (so
+    jax.Arrays in component state travel as numpy and are restored on
+    the target's device), installs it under the same gid, and leaves a
+    forward. Invocations racing a migration chase the forward — the
+    parcel layer chains returned futures without blocking a pool thread.
+
+Heavy array state should live in sharded jax.Arrays; components carry
+control-plane state (the reference makes the same split between AGAS
+objects and the data plane).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+from ..core.errors import Error, HpxError
+from ..futures.future import Future, make_ready_future
+from .actions import async_action, plain_action, post_action
+from .runtime import find_here, get_num_localities
+
+# ---------------------------------------------------------------------------
+# gid / id_type
+# ---------------------------------------------------------------------------
+
+
+class IdType:
+    """hpx::id_type analog: names one component instance globally.
+
+    `home` is the creating locality (embedded in the gid like the
+    reference's locality bits); resolution to the current locality goes
+    through the forwarding layer when the object has migrated.
+    """
+
+    __slots__ = ("home", "type_name", "lid")
+
+    def __init__(self, home: int, type_name: str, lid: int) -> None:
+        self.home = home
+        self.type_name = type_name
+        self.lid = lid
+
+    def key(self) -> Tuple[int, str, int]:
+        return (self.home, self.type_name, self.lid)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, IdType) and self.key() == other.key()
+
+    def __hash__(self) -> int:
+        return hash(self.key())
+
+    def __repr__(self) -> str:
+        return f"IdType({self.type_name}@{self.home}#{self.lid})"
+
+    # pickle support (travels inside parcels / AGAS values)
+    def __getstate__(self):
+        return self.key()
+
+    def __setstate__(self, st):
+        self.home, self.type_name, self.lid = st
+
+
+# ---------------------------------------------------------------------------
+# type registry (HPX_REGISTER_COMPONENT)
+# ---------------------------------------------------------------------------
+
+_types: Dict[str, Type] = {}
+_types_lock = threading.Lock()
+
+
+def register_component_type(cls: Type, name: Optional[str] = None) -> Type:
+    """HPX_REGISTER_COMPONENT analog. Usable as a decorator:
+
+        @register_component_type
+        class Counter(Component): ...
+    """
+    n = name or f"{cls.__module__}.{cls.__qualname__}"
+    with _types_lock:
+        prev = _types.get(n)
+        if prev is not None and prev is not cls:
+            raise HpxError(Error.duplicate_component_id,
+                           f"component type already registered: {n}")
+        _types[n] = cls
+    cls._component_type_name = n
+    return cls
+
+
+def _resolve_type(name: str) -> Type:
+    with _types_lock:
+        cls = _types.get(name)
+    if cls is None:
+        raise HpxError(Error.bad_component_type,
+                       f"unknown component type: {name}")
+    return cls
+
+
+class Component:
+    """component_base analog. Subclass, register, instantiate with new_.
+
+    Instances get `.gid` after installation. Override __getstate__ /
+    __setstate__ for custom migration behavior; by default the instance
+    __dict__ travels (minus the gid, which is reassigned on install).
+    """
+
+    gid: Optional[IdType] = None
+
+    def on_migrated(self) -> None:
+        """Hook: called on the target locality after migration install."""
+
+
+# ---------------------------------------------------------------------------
+# per-locality instance table (the component heap)
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    __slots__ = ("inst", "pins", "cv", "migrating", "ever_migrated")
+
+    def __init__(self, inst: Any, ever_migrated: bool = False) -> None:
+        self.inst = inst
+        self.pins = 0
+        self.cv = threading.Condition()
+        self.migrating = False
+        # True iff this instance arrived via migration: its gid may have
+        # forwards/KV entries scattered on other localities that free()
+        # must retract
+        self.ever_migrated = ever_migrated
+
+
+_instances: Dict[Tuple[int, str, int], _Entry] = {}
+_forwards: Dict[Tuple[int, str, int], int] = {}   # gid key -> locality
+_inst_lock = threading.Lock()
+_next_lid = [0]
+
+
+def _install(gid: IdType, inst: Any, ever_migrated: bool = False) -> None:
+    inst.gid = gid
+    with _inst_lock:
+        _instances[gid.key()] = _Entry(inst, ever_migrated)
+        _forwards.pop(gid.key(), None)
+
+
+def _agas_gid_name(gid: IdType) -> str:
+    h, t, l = gid.key()
+    return f"/components/where/{h}/{t}/{l}"
+
+
+def _current_locality(gid: IdType) -> int:
+    """Resolve gid → current locality: local table, local forward,
+    console KV (set on migration), else home."""
+    key = gid.key()
+    with _inst_lock:
+        if key in _instances:
+            return find_here()
+        fwd = _forwards.get(key)
+    if fwd is not None:
+        return fwd
+    if get_num_localities() > 1:
+        from . import agas
+        loc = agas.atomic_read(_agas_gid_name(gid),
+                               default=None).get(timeout=30.0)
+        if loc is not None:
+            return int(loc)
+    return gid.home
+
+
+# ---------------------------------------------------------------------------
+# remote operations (actions)
+# ---------------------------------------------------------------------------
+
+@plain_action(name="components.create")
+def _create(type_name: str, args: tuple, kwargs: dict):
+    cls = _resolve_type(type_name)
+    inst = cls(*args, **kwargs)
+    with _inst_lock:
+        lid = _next_lid[0]
+        _next_lid[0] += 1
+    gid = IdType(find_here(), type_name, lid)
+    _install(gid, inst)
+    return gid
+
+
+def _pin(gid: IdType) -> Optional[_Entry]:
+    """Pin the local instance against migration, or None if it isn't
+    (or no longer is) here. Blocks while a migration is in flight —
+    the reference's AGAS likewise defers resolution mid-migration."""
+    key = gid.key()
+    while True:
+        with _inst_lock:
+            entry = _instances.get(key)
+        if entry is None:
+            return None
+        with entry.cv:
+            if not entry.migrating:
+                entry.pins += 1
+                return entry
+            entry.cv.wait(timeout=1.0)
+        # re-loop: migration finished (entry popped + forward recorded)
+        # or aborted (migrating cleared)
+
+
+def _unpin(entry: _Entry) -> None:
+    with entry.cv:
+        entry.pins -= 1
+        entry.cv.notify_all()
+
+
+_MAX_HOPS = 8   # forward-chase TTL: a freed/raced gid must error, not loop
+
+
+@plain_action(name="components.invoke")
+def _invoke(gid: IdType, method: str, args: tuple, kwargs: dict,
+            _hops: int = 0):
+    entry = _pin(gid)
+    if entry is None:
+        cur = _current_locality(gid)
+        if cur != find_here() and _hops < _MAX_HOPS:
+            # chase the forward; the parcel layer chains this future
+            return async_action(_invoke, cur, gid, method, args, kwargs,
+                                _hops=_hops + 1)
+        raise HpxError(Error.unknown_component_address,
+                       f"component unknown (freed, migrating, or never "
+                       f"created): {gid}")
+    try:
+        return getattr(entry.inst, method)(*args, **kwargs)
+    finally:
+        _unpin(entry)
+
+
+@plain_action(name="components.clear_forward")
+def _clear_forward(gid: IdType) -> bool:
+    with _inst_lock:
+        return _forwards.pop(gid.key(), None) is not None
+
+
+@plain_action(name="components.free")
+def _free(gid: IdType, _hops: int = 0) -> bool:
+    key = gid.key()
+    with _inst_lock:
+        entry = _instances.pop(key, None)
+        _forwards.pop(key, None)
+    if entry is None:
+        cur = _current_locality(gid)
+        if cur != find_here() and _hops < _MAX_HOPS:
+            return async_action(_free, cur, gid, _hops=_hops + 1)
+        return False
+    if get_num_localities() > 1 and entry.ever_migrated:
+        # a migrated gid: retract the published location BEFORE replying
+        # and clear stale forwards on ALL other localities — any stale
+        # forward chain would make later resolutions ping-pong (bounded
+        # by the hop TTL, but burning hops and masking the real error).
+        # `ever_migrated` (not home != here): an object migrated away
+        # and BACK home still has forwards/KV to retract.
+        from . import agas
+        try:
+            agas.unregister_name(_agas_gid_name(gid)).get(timeout=30.0)
+        except HpxError:
+            pass
+        here = find_here()
+        for loc in range(get_num_localities()):
+            if loc != here:
+                post_action(_clear_forward, loc, gid)
+    with entry.cv:
+        entry.cv.notify_all()   # wake any _pin waiters; they'll see gone
+    return True
+
+
+@plain_action(name="components.migrate")
+def _migrate(gid: IdType, to_loc: int, _hops: int = 0):
+    """Runs on the locality currently holding the object.
+
+    Protocol: mark migrating (new invocations block in _pin) → drain
+    pins → extract state → install on target + publish location (both
+    BEFORE the entry is popped, so blocked invocations released below
+    chase a forward that definitely resolves) → pop entry, record
+    forward, wake waiters.
+    """
+    key = gid.key()
+    with _inst_lock:
+        entry = _instances.get(key)
+    if entry is None:
+        cur = _current_locality(gid)
+        if cur != find_here() and _hops < _MAX_HOPS:
+            return async_action(_migrate, cur, gid, to_loc,
+                                _hops=_hops + 1)
+        raise HpxError(Error.unknown_component_address,
+                       f"cannot migrate, no such component here: {gid}")
+    if to_loc == find_here():
+        return gid
+    with entry.cv:
+        if entry.migrating:
+            raise HpxError(Error.invalid_status,
+                           f"concurrent migration in flight: {gid}")
+        entry.migrating = True
+        # drain pins (reference: AGAS pin count must reach zero)
+        if not entry.cv.wait_for(lambda: entry.pins == 0, timeout=30.0):
+            entry.migrating = False
+            entry.cv.notify_all()
+            raise HpxError(Error.invalid_status,
+                           f"component stayed pinned: {gid}")
+    try:
+        state = entry.inst.__getstate__() \
+            if hasattr(entry.inst, "__getstate__") \
+            else dict(entry.inst.__dict__)
+        if isinstance(state, dict):
+            state = {k: v for k, v in state.items() if k != "gid"}
+        # this action already runs on a pool thread; the remote install
+        # and the console publish are straight-line blocking calls
+        async_action(_install_migrated, to_loc, gid, gid.type_name,
+                     state).get(timeout=30.0)
+        if get_num_localities() > 1:
+            from . import agas
+            agas.register_name(_agas_gid_name(gid), to_loc,
+                               allow_replace=True).get(timeout=30.0)
+    except BaseException:
+        with entry.cv:
+            entry.migrating = False
+            entry.cv.notify_all()
+        raise
+    with _inst_lock:
+        _instances.pop(key, None)
+        _forwards[key] = to_loc
+    with entry.cv:
+        entry.cv.notify_all()
+    return gid
+
+
+@plain_action(name="components.install_migrated")
+def _install_migrated(gid: IdType, type_name: str, state: Any) -> bool:
+    cls = _resolve_type(type_name)
+    inst = cls.__new__(cls)
+    if hasattr(inst, "__setstate__"):
+        inst.__setstate__(state)
+    else:
+        inst.__dict__.update(state)
+    _install(gid, inst, ever_migrated=True)
+    inst.on_migrated()
+    return True
+
+
+@plain_action(name="components.where")
+def _where(gid: IdType) -> int:
+    return _current_locality(gid)
+
+
+# ---------------------------------------------------------------------------
+# client_base
+# ---------------------------------------------------------------------------
+
+class Client:
+    """client_base analog: a (serializable) handle to a component.
+
+    c.call('m', *a)  -> Future      (hpx::async(m_action, id, a...))
+    c.sync('m', *a)  -> value
+    c.post('m', *a)  -> None        (fire-and-forget)
+    c.m(*a)          -> Future      (attribute sugar)
+    """
+
+    __slots__ = ("gid",)
+
+    def __init__(self, gid: IdType) -> None:
+        self.gid = gid
+
+    def _target(self) -> int:
+        """Cheap placement guess — local knowledge only, NO console
+        roundtrip (that would serialize every invocation through the
+        console). Wrong guesses cost one forward-chase hop in _invoke,
+        which does the authoritative resolution; this is the AGAS-cache
+        fast path of the reference."""
+        key = self.gid.key()
+        with _inst_lock:
+            if key in _instances:
+                return find_here()
+            fwd = _forwards.get(key)
+        return fwd if fwd is not None else self.gid.home
+
+    # -- invocation ---------------------------------------------------------
+    def call(self, method: str, *args: Any, **kwargs: Any) -> Future:
+        return async_action(_invoke, self._target(), self.gid, method,
+                            args, kwargs)
+
+    def sync(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        return self.call(method, *args, **kwargs).get()
+
+    def post(self, method: str, *args: Any, **kwargs: Any) -> None:
+        post_action(_invoke, self._target(), self.gid, method, args, kwargs)
+
+    def __getattr__(self, name: str) -> Callable[..., Future]:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda *a, **kw: self.call(name, *a, **kw)
+
+    # -- lifetime / placement ----------------------------------------------
+    def where(self) -> Future:
+        """Current locality of the component (AGAS resolve analog)."""
+        return make_ready_future(_current_locality(self.gid))
+
+    def free(self) -> Future:
+        loc = _current_locality(self.gid)
+        return async_action(_free, loc, self.gid)
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        try:
+            self.free().get(timeout=30.0)
+        except HpxError:
+            pass
+
+    # -- misc ---------------------------------------------------------------
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Client) and self.gid == other.gid
+
+    def __hash__(self) -> int:
+        return hash(self.gid)
+
+    def __repr__(self) -> str:
+        return f"Client({self.gid!r})"
+
+    def __getstate__(self):
+        return self.gid
+
+    def __setstate__(self, gid):
+        self.gid = gid
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def new_(cls_or_name: Any, locality: Optional[int] = None,
+         *args: Any, **kwargs: Any) -> Future:
+    """hpx::new_<T>(locality, args...) analog → future<Client>."""
+    if isinstance(cls_or_name, str):
+        type_name = cls_or_name
+        _resolve_type(type_name)          # fail fast on unknown types
+    else:
+        # __dict__ lookup, not getattr: an unregistered SUBCLASS of a
+        # registered component would inherit the base's type name and
+        # silently instantiate the base class on the target
+        type_name = cls_or_name.__dict__.get("_component_type_name")
+        if type_name is None:
+            raise HpxError(Error.bad_component_type,
+                           f"not a registered component type: {cls_or_name} "
+                           "(register_component_type first)")
+    loc = find_here() if locality is None else int(locality)
+    return async_action(_create, loc, type_name, args, kwargs).then(
+        lambda f: Client(f.get()))
+
+
+def new_sync(cls_or_name: Any, locality: Optional[int] = None,
+             *args: Any, **kwargs: Any) -> Client:
+    return new_(cls_or_name, locality, *args, **kwargs).get()
+
+
+def migrate(client: Client, to_locality: int) -> Future:
+    """hpx::components::migrate analog → future<Client> (same gid, now
+    living on to_locality)."""
+    if to_locality < 0 or to_locality >= get_num_localities():
+        raise HpxError(Error.bad_parameter,
+                       f"no such locality: {to_locality}")
+    loc = _current_locality(client.gid)
+    # f.get() inside the continuation: a failed migration must fail the
+    # returned future, not silently hand back a Client
+    return async_action(_migrate, loc, client.gid, to_locality).then(
+        lambda f: (f.get(), Client(client.gid))[1])
+
+
+def async_colocated(action: Any, client: Client, *args: Any,
+                    **kwargs: Any) -> Future:
+    """hpx::async_colocated analog: run a plain action on whatever
+    locality currently hosts the component."""
+    return async_action(action, _current_locality(client.gid),
+                        *args, **kwargs)
+
+
+def register_with_basename(basename: str, client: Client,
+                           sequence_nr: int = 0) -> Future:
+    """hpx::register_with_basename analog (symbol-namespace publish)."""
+    from . import agas
+    return agas.register_name(f"/basename/{basename}/{sequence_nr}",
+                              client)
+
+
+def find_from_basename(basename: str, sequence_nr: int = 0) -> Future:
+    """hpx::find_from_basename analog → future<Client> (waits for the
+    publisher, like the reference's rendezvous)."""
+    from . import agas
+    return agas.resolve_name(f"/basename/{basename}/{sequence_nr}",
+                             wait=True)

@@ -1,0 +1,91 @@
+"""Multi-locality components smoke (3 localities).
+
+Exercises the cross-process component protocol end-to-end:
+remote hpx::new_, client shipping through AGAS basenames, remote
+invocation from a third locality, migration 1→2 with live invocations
+chasing the forward, and remote free.
+
+Reference analog: components/tests + examples/quickstart component
+demos (SURVEY.md §2.4, §2.6).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import hpx_tpu as hpx
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+
+@hpx.register_component_type
+class Accumulator(hpx.Component):
+    def __init__(self, start: int = 0) -> None:
+        self.value = int(start)
+        self.hosts = [hpx.find_here()]   # records where it has lived
+
+    def add(self, n: int) -> int:
+        self.value += n
+        return self.value
+
+    def where_am_i(self) -> int:
+        return hpx.find_here()
+
+    def history(self):
+        return list(self.hosts)
+
+    def on_migrated(self) -> None:
+        self.hosts.append(hpx.find_here())
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+
+    if here == 0:
+        # create on locality 1, publish for everyone
+        acc = hpx.new_(Accumulator, 1, 100).get()
+        HPX_TEST_EQ(acc.gid.home, 1)
+        HPX_TEST_EQ(acc.sync("where_am_i"), 1)
+        hpx.register_with_basename("smoke/acc", acc).get()
+
+        # everyone contributes (below); wait for them
+        hpx.get_runtime().barrier("contributed")
+        HPX_TEST_EQ(acc.sync("add", 0), 100 + 1 + 2)
+
+        # migrate 1 -> 2 while invoking concurrently
+        futs = [acc.call("add", 0) for _ in range(8)]
+        moved = hpx.migrate(acc, 2).get()
+        HPX_TEST_EQ(moved.sync("where_am_i"), 2)
+        for f in futs:
+            HPX_TEST_EQ(f.get(), 103)    # adds of 0: value unchanged
+        HPX_TEST_EQ(moved.sync("history"), [1, 2])
+        # stale client (pre-migration handle) still resolves via forward
+        HPX_TEST_EQ(acc.sync("where_am_i"), 2)
+        hpx.get_runtime().barrier("migrated")
+        hpx.get_runtime().barrier("checked")   # workers verified placement
+
+        # free remotely; later use fails
+        HPX_TEST(moved.free().get() is True)
+        try:
+            moved.sync("add", 1)
+            HPX_TEST(False, "invoke after free must raise")
+        except hpx.HpxError:
+            pass
+        hpx.get_runtime().barrier("done")
+    else:
+        acc = hpx.find_from_basename("smoke/acc").get()
+        acc.sync("add", here)            # 1 and 2 each contribute
+        hpx.get_runtime().barrier("contributed")
+        hpx.get_runtime().barrier("migrated")
+        # after migration every locality agrees on placement
+        HPX_TEST_EQ(acc.sync("where_am_i"), 2)
+        hpx.get_runtime().barrier("checked")
+        hpx.get_runtime().barrier("done")
+
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
