@@ -30,6 +30,7 @@ from .futures import (  # noqa: F401
     when_all, when_any, when_each, when_some,
     wait_all, wait_any, wait_each, wait_some, split_future,
 )
+from .futures.task_group import TaskGroup, task_group  # noqa: F401
 from . import lcos  # noqa: F401
 from .synchronization import (  # noqa: F401
     Barrier, ConditionVariable, CountingSemaphore, Event, Latch, Mutex,
@@ -50,6 +51,16 @@ from .exec import (  # noqa: F401
 # tpu_executor: the north-star spelling (BASELINE.json:
 # `hpx::execution::par.on(tpu_executor{})`)
 tpu_executor = TpuExecutor
+
+# P2300 senders/receivers (hpx::execution::experimental)
+from .exec import p2300  # noqa: F401
+# the reference exposes this under hpx::execution::experimental
+execution_experimental = p2300
+
+# SPMD blocks (host plane + device/shard_map plane)
+from .parallel.spmd import (  # noqa: F401
+    SpmdBlock, define_spmd_block, device_spmd_block,
+)
 
 # -- parallel algorithms (M3) ------------------------------------------------
 from .algo import (  # noqa: F401

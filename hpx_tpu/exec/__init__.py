@@ -28,3 +28,4 @@ from .policies import (  # noqa: F401
     unseq,
 )
 from .tpu import Target, TpuExecutor, default_target, get_future, get_targets  # noqa: F401
+from . import p2300  # noqa: F401

@@ -183,12 +183,21 @@ class NativePool:
     def shutdown(self, wait: bool = True) -> None:
         # wait is accepted for interface parity with WorkStealingPool;
         # the native pool always joins its workers before freeing.
-        if not self._shut:
-            self.stats()              # snapshot final counters
-            self._shut = True
-            # workers registered in _worker_of must not help a dead pool
-            self._lib.hpxrt_pool_shutdown(self._handle)
-            self._handle = None
+        if self._shut:
+            return
+        if self._handle is not None and self.in_worker():
+            # a pool cannot join itself: pthread_join(self) aborts the
+            # process. Hand the join to a fresh thread (continuations
+            # commonly fire on the last worker that completed a future).
+            import threading as _t
+            _t.Thread(target=self.shutdown, name="pool-reaper",
+                      daemon=True).start()
+            return
+        self.stats()              # snapshot final counters
+        self._shut = True
+        # workers registered in _worker_of must not help a dead pool
+        self._lib.hpxrt_pool_shutdown(self._handle)
+        self._handle = None
 
     def __del__(self) -> None:  # best-effort; explicit shutdown preferred
         try:

@@ -5,3 +5,4 @@ from .halo import (  # noqa: F401
     sharded_heat_step,
     sharded_multistep,
 )
+from .spmd import SpmdBlock, define_spmd_block, device_spmd_block  # noqa: F401

@@ -1,0 +1,214 @@
+"""task_group, P2300 senders/receivers, and spmd_block tests.
+
+Reference analogs: libs/core/task_group tests, the P2300 pieces of
+libs/core/execution tests (then/when_all/bulk/sync_wait/run_loop), and
+the quickstart spmd_block demos (SURVEY.md §2.2, §2.9).
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.exec import p2300 as ex
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+
+# -- task_group -------------------------------------------------------------
+
+class TestTaskGroup:
+    def test_basic(self):
+        out = []
+        with hpx.task_group() as tg:
+            for i in range(10):
+                tg.run(out.append, i)
+        HPX_TEST_EQ(sorted(out), list(range(10)))
+
+    def test_explicit_wait_and_reuse(self):
+        tg = hpx.TaskGroup()
+        acc = []
+        tg.run(acc.append, 1)
+        tg.wait()
+        HPX_TEST_EQ(acc, [1])
+        tg.run(acc.append, 2)      # reusable after wait (reference)
+        tg.wait()
+        HPX_TEST_EQ(acc, [1, 2])
+
+    def test_child_exception_rethrown(self):
+        def boom():
+            raise ValueError("child failed")
+        done = threading.Event()
+        with pytest.raises(ValueError):
+            with hpx.task_group() as tg:
+                tg.run(boom)
+                tg.run(done.set)
+        HPX_TEST(done.is_set())    # all children ran to completion
+
+    def test_children_spawn_children(self):
+        out = []
+        tg = hpx.TaskGroup()
+
+        def parent():
+            out.append("p")
+            tg.run(out.append, "c")
+
+        tg.run(parent)
+        tg.wait()
+        HPX_TEST_EQ(sorted(out), ["c", "p"])
+
+    def test_on_executor(self):
+        tg = hpx.task_group(hpx.SequencedExecutor())
+        out = []
+        tg.run(out.append, 1)
+        tg.run(out.append, 2)
+        tg.wait()
+        HPX_TEST_EQ(out, [1, 2])
+
+
+# -- P2300 ------------------------------------------------------------------
+
+class TestSenders:
+    def test_just_then_sync_wait(self):
+        s = ex.just(20) | ex.then(lambda v: v * 2) | ex.then(lambda v: v + 2)
+        HPX_TEST_EQ(ex.sync_wait(s), 42)
+
+    def test_just_multiple_values(self):
+        s = ex.just(3, 4) | ex.then(lambda a, b: a * b)
+        HPX_TEST_EQ(ex.sync_wait(s), 12)
+
+    def test_schedule_thread_pool(self):
+        ran_on = []
+        s = (ex.schedule(ex.thread_pool_scheduler())
+             | ex.then(lambda: ran_on.append(threading.get_ident()) or 7))
+        HPX_TEST_EQ(ex.sync_wait(s), 7)
+        HPX_TEST(ran_on and ran_on[0] != threading.get_ident())
+
+    def test_error_channel_and_recovery(self):
+        def boom():
+            raise RuntimeError("nope")
+        s = ex.just() | ex.then(boom)
+        with pytest.raises(RuntimeError):
+            ex.sync_wait(s)
+        s2 = (ex.just() | ex.then(boom)
+              | ex.upon_error(lambda e: f"recovered:{e}"))
+        HPX_TEST(str(ex.sync_wait(s2)).startswith("recovered"))
+
+    def test_just_error(self):
+        with pytest.raises(KeyError):
+            ex.sync_wait(ex.just_error(KeyError("k")))
+
+    def test_stopped(self):
+        HPX_TEST(ex.sync_wait(ex.just_stopped()) is None)
+
+    def test_let_value(self):
+        s = ex.just(5) | ex.let_value(lambda v: ex.just(v + 1))
+        HPX_TEST_EQ(ex.sync_wait(s), 6)
+
+    def test_when_all(self):
+        s = ex.when_all(ex.just(1), ex.just(2) | ex.then(lambda v: v * 10),
+                        ex.just(3))
+        HPX_TEST_EQ(ex.sync_wait(s), (1, 20, 3))
+
+    def test_when_all_error_wins(self):
+        s = ex.when_all(ex.just(1), ex.just_error(ValueError("x")))
+        with pytest.raises(ValueError):
+            ex.sync_wait(s)
+
+    def test_bulk(self):
+        hits = []
+        s = ex.just(10) | ex.bulk(4, lambda i, v: hits.append(i * v))
+        HPX_TEST_EQ(ex.sync_wait(s), 10)    # bulk forwards the value
+        HPX_TEST_EQ(sorted(hits), [0, 10, 20, 30])
+
+    def test_continues_on(self):
+        tids = []
+        s = (ex.just(1)
+             | ex.then(lambda v: (tids.append(threading.get_ident()), v)[1])
+             | ex.continues_on(ex.thread_pool_scheduler())
+             | ex.then(lambda v: (tids.append(threading.get_ident()),
+                                  v + 1)[1]))
+        HPX_TEST_EQ(ex.sync_wait(s), 2)
+        HPX_TEST_EQ(len(tids), 2)
+
+    def test_as_future_bridge(self):
+        f = ex.as_future(ex.just(5) | ex.then(lambda v: v * 3))
+        HPX_TEST(hpx.is_future(f))
+        HPX_TEST_EQ(f.get(), 15)
+
+    def test_start_detached(self):
+        done = threading.Event()
+        ex.start_detached(ex.schedule(ex.thread_pool_scheduler())
+                          | ex.then(done.set))
+        HPX_TEST(done.wait(5.0))
+
+    def test_run_loop(self):
+        loop = ex.run_loop()
+        out = []
+        ex.start_detached(ex.schedule(loop.get_scheduler())
+                          | ex.then(lambda: out.append(1)))
+        ex.start_detached(ex.schedule(loop.get_scheduler())
+                          | ex.then(lambda: out.append(2)))
+        loop.finish()
+        loop.run()
+        HPX_TEST_EQ(out, [1, 2])
+
+    def test_then_on_device(self):
+        s = (ex.just(jnp.arange(8, dtype=jnp.float32))
+             | ex.then_on_device(lambda x: x * 2.0)
+             | ex.then(lambda x: float(x.sum())))
+        HPX_TEST_EQ(ex.sync_wait(s), 2.0 * sum(range(8)))
+
+    def test_tpu_scheduler_pipeline(self):
+        s = (ex.schedule(ex.tpu_scheduler())
+             | ex.then(lambda: jnp.ones((4, 4), jnp.float32))
+             | ex.then_on_device(lambda m: m @ m)
+             | ex.then(lambda m: float(m[0, 0])))
+        HPX_TEST_EQ(ex.sync_wait(s), 4.0)
+
+
+# -- spmd_block -------------------------------------------------------------
+
+class TestSpmdBlock:
+    def test_host_images_and_barrier(self):
+        phases = []
+        lock = threading.Lock()
+
+        def image(block):
+            with lock:
+                phases.append(("a", block.this_image()))
+            block.sync_all()
+            with lock:
+                phases.append(("b", block.this_image()))
+            return block.this_image() * 10
+
+        res = hpx.define_spmd_block("t", 6, image).get()
+        HPX_TEST_EQ(sorted(res), [0, 10, 20, 30, 40, 50])
+        # every 'a' strictly before every 'b' (the barrier held)
+        a_idx = [i for i, p in enumerate(phases) if p[0] == "a"]
+        b_idx = [i for i, p in enumerate(phases) if p[0] == "b"]
+        HPX_TEST(max(a_idx) < min(b_idx))
+
+    def test_block_metadata(self):
+        def image(block, extra):
+            HPX_TEST_EQ(block.get_block_name(), "meta")
+            HPX_TEST_EQ(block.get_num_images(), 2)
+            return block.image_id() + extra
+
+        res = hpx.define_spmd_block("meta", 2, image, 100).get()
+        HPX_TEST_EQ(sorted(res), [100, 101])
+
+    def test_device_plane(self, mesh1d):
+        from jax.sharding import PartitionSpec as P
+
+        def body(block, x):
+            # rank-dependent update: image i adds i to its shard
+            return x + block.this_image().astype(x.dtype)
+
+        step = hpx.device_spmd_block(body, mesh1d, "x",
+                                     in_specs=(P("x"),), out_specs=P("x"))
+        x = jnp.zeros(16, jnp.float32)
+        out = np.asarray(step(x))
+        want = np.repeat(np.arange(8, dtype=np.float32), 2)
+        np.testing.assert_array_equal(out, want)
