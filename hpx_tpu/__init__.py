@@ -19,6 +19,15 @@ Public API façade mirroring HPX's umbrella headers (hpx/hpx.hpp):
 from .core.version import HPX_TPU_VERSION, full_version_as_string  # noqa: F401
 from .core.errors import Error, ErrorCode, HpxError  # noqa: F401
 from .core.config import Configuration  # noqa: F401
+from .core.timing import (  # noqa: F401
+    HighResolutionTimer, TimedExecutor, async_after, async_at,
+    high_resolution_clock_now, sleep_for, sleep_until,
+)
+from .core.topology import Topology, get_topology  # noqa: F401
+from .runtime.resource import (  # noqa: F401
+    Pool, ResourcePartitioner, get_partitioner,
+)
+from .runtime import batch_environments  # noqa: F401
 
 __version__ = full_version_as_string()
 

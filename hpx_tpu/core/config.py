@@ -151,6 +151,14 @@ class Configuration:
         self._lock = threading.Lock()
         self._data: Dict[str, str] = dict(DEFAULTS)
 
+        # batch scheduler layer (above compiled defaults, below ini/env/
+        # CLI): srun/mpirun/TPU-pod launches discover localities without
+        # flags, as the reference does (libs/core/batch_environments)
+        from ..runtime.batch_environments import detect as _batch_detect
+        batch = _batch_detect(env)
+        if batch.found():
+            self._data.update(batch.config_overrides())
+
         files = list(ini_files) if ini_files is not None else []
         if ini_files is None:
             if os.path.exists("hpx_tpu.ini"):

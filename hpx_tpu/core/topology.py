@@ -1,0 +1,105 @@
+"""Hardware topology — the hwloc-wrapper analog, TPU-first.
+
+Reference analog: libs/core/topology (`hpx::threads::topology`: sockets/
+cores/PUs, NUMA masks — SURVEY.md §2.1, §2.8's mapping table: "hwloc
+topology (C)" → "jax.devices(), mesh axes, device.coords/ICI topology").
+
+Host side reports what Python can see (cores); device side reports the
+accelerator fleet: device kind, platform, per-device coords (the ICI
+torus position on real TPU), memory stats, and process/slice layout for
+multi-host runs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Topology", "get_topology"]
+
+
+class Topology:
+    """Singleton snapshot (hpx::threads::get_topology())."""
+
+    # -- host ---------------------------------------------------------------
+    def number_of_cores(self) -> int:
+        return os.cpu_count() or 1
+
+    def number_of_pus(self) -> int:
+        # no hwloc: PUs == schedulable CPUs visible to this process
+        try:
+            return len(os.sched_getaffinity(0))
+        except AttributeError:       # non-Linux
+            return self.number_of_cores()
+
+    # -- devices ------------------------------------------------------------
+    def number_of_devices(self) -> int:
+        import jax
+        return len(jax.devices())
+
+    def number_of_local_devices(self) -> int:
+        import jax
+        return len(jax.local_devices())
+
+    def device_kind(self) -> str:
+        import jax
+        d = jax.devices()
+        return d[0].device_kind if d else "none"
+
+    def platform(self) -> str:
+        import jax
+        return jax.default_backend()
+
+    def device_coords(self, index: int = 0) -> Optional[Tuple[int, ...]]:
+        """ICI torus coordinates of a device (None on CPU/GPU meshes)."""
+        import jax
+        d = jax.devices()[index]
+        return tuple(d.coords) if hasattr(d, "coords") else None
+
+    def ici_shape(self) -> Optional[Tuple[int, ...]]:
+        """Bounding box of the device coords = the physical torus shape
+        (None when the platform exposes no coords)."""
+        import jax
+        coords = [d.coords for d in jax.devices() if hasattr(d, "coords")]
+        if not coords:
+            return None
+        dims = len(coords[0])
+        return tuple(max(c[i] for c in coords) + 1 for i in range(dims))
+
+    def device_memory_stats(self, index: int = 0) -> Dict[str, int]:
+        import jax
+        try:
+            return dict(jax.devices()[index].memory_stats() or {})
+        except Exception:  # noqa: BLE001 — not all backends report
+            return {}
+
+    # -- processes (multi-host) ---------------------------------------------
+    def number_of_processes(self) -> int:
+        import jax
+        return jax.process_count()
+
+    def process_index(self) -> int:
+        import jax
+        return jax.process_index()
+
+    def devices_by_process(self) -> Dict[int, List[Any]]:
+        import jax
+        out: Dict[int, List[Any]] = {}
+        for d in jax.devices():
+            out.setdefault(d.process_index, []).append(d)
+        return out
+
+    def __repr__(self) -> str:
+        return (f"Topology(cores={self.number_of_cores()}, "
+                f"devices={self.number_of_devices()} "
+                f"[{self.device_kind()}@{self.platform()}])")
+
+
+_topology: Optional[Topology] = None
+
+
+def get_topology() -> Topology:
+    global _topology
+    if _topology is None:
+        _topology = Topology()
+    return _topology

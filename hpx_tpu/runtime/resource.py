@@ -1,0 +1,158 @@
+"""Resource partitioner — named pools over host threads AND device sets.
+
+Reference analog: libs/core/resource_partitioner (`hpx::resource::
+partitioner`: carve the machine into named thread pools before runtime
+start; executors then target a pool — SURVEY.md §2.1).
+
+TPU-first: the machine has TWO resources to carve — host worker threads
+(orchestration) and mesh devices (compute). A named pool owns some of
+each; `pool.executor()` gives the host executor, `pool.mesh(...)` builds
+a jax Mesh over the pool's devices so whole subsystems can be pinned to
+a device subset (e.g. an IO pool with 0 devices, a halo pool on one ICI
+ring).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..core.errors import Error, HpxError
+
+__all__ = ["ResourcePartitioner", "Pool", "get_partitioner"]
+
+
+class Pool:
+    def __init__(self, name: str, num_threads: int,
+                 devices: Sequence[Any]) -> None:
+        self.name = name
+        self.num_threads = num_threads
+        self.devices = list(devices)
+        self._pool = None
+        self._lock = threading.Lock()
+
+    # -- host side ----------------------------------------------------------
+    def thread_pool(self):
+        with self._lock:
+            if self._pool is None:
+                from .threadpool import WorkStealingPool
+                self._pool = WorkStealingPool(self.num_threads, self.name)
+            return self._pool
+
+    def executor(self):
+        """A ParallelExecutor bound to this pool (the reference's
+        pool-per-executor pattern)."""
+        from ..exec.executors import ParallelExecutor
+        return ParallelExecutor(self.thread_pool())
+
+    # -- device side ---------------------------------------------------------
+    def mesh(self, shape: Optional[Sequence[int]] = None,
+             axis_names: Sequence[str] = ("x",)):
+        if not self.devices:
+            raise HpxError(Error.bad_parameter,
+                           f"pool '{self.name}' owns no devices")
+        from ..parallel.mesh import make_mesh
+        if shape is None:
+            shape = (len(self.devices),)
+        return make_mesh(shape, axis_names, self.devices)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._pool is not None:
+                self._pool.shutdown()
+                self._pool = None
+
+    def __repr__(self) -> str:
+        return (f"Pool({self.name!r}, threads={self.num_threads}, "
+                f"devices={len(self.devices)})")
+
+
+class ResourcePartitioner:
+    """Carve threads/devices into named pools. Remaining resources stay
+    in the 'default' pool (reference behavior)."""
+
+    def __init__(self) -> None:
+        from ..core.config import runtime_config
+        self._total_threads = runtime_config().os_threads()
+        self._lock = threading.Lock()
+        self._pools: Dict[str, Pool] = {}
+        self._assigned_threads = 0
+        self._assigned_devices: List[Any] = []
+        self._finalized = False
+
+    def create_pool(self, name: str, num_threads: int = 1,
+                    devices: Optional[Sequence[Any]] = None) -> None:
+        """add_resource analog: claim threads (and optionally devices)
+        for a named pool."""
+        with self._lock:
+            if self._finalized:
+                raise HpxError(Error.invalid_status,
+                               "partitioner already finalized")
+            if name in self._pools or name == "default":
+                raise HpxError(Error.bad_parameter,
+                               f"pool exists: {name}")
+            remaining = self._total_threads - self._assigned_threads
+            if num_threads > remaining:
+                raise HpxError(
+                    Error.bad_parameter,
+                    f"pool '{name}' wants {num_threads} threads, only "
+                    f"{remaining} of {self._total_threads} unassigned")
+            devs = list(devices) if devices else []
+            for d in devs:
+                if any(d is a for a in self._assigned_devices):
+                    raise HpxError(Error.bad_parameter,
+                                   f"device {d} already assigned")
+            self._pools[name] = Pool(name, num_threads, devs)
+            self._assigned_threads += num_threads
+            self._assigned_devices.extend(devs)
+
+    def _make_default(self) -> Pool:
+        import jax
+        leftover_threads = max(
+            1, self._total_threads - self._assigned_threads)
+        assigned = self._assigned_devices
+        devs = [d for d in jax.devices()
+                if not any(d is a for a in assigned)]
+        return Pool("default", leftover_threads, devs)
+
+    def get_pool(self, name: str = "default") -> Pool:
+        with self._lock:
+            self._finalized = True
+            if name == "default":
+                p = self._pools.get("default")
+                if p is None:
+                    p = self._pools["default"] = self._make_default()
+                return p
+            p = self._pools.get(name)
+        if p is None:
+            raise HpxError(Error.bad_parameter, f"no such pool: {name}")
+        return p
+
+    def pool_names(self) -> List[str]:
+        with self._lock:
+            names = list(self._pools)
+        if "default" not in names:
+            names.append("default")
+        return names
+
+    def shutdown(self) -> None:
+        with self._lock:
+            pools = list(self._pools.values())
+            self._pools.clear()
+            self._assigned_threads = 0
+            self._assigned_devices = []
+            self._finalized = False
+        for p in pools:
+            p.shutdown()
+
+
+_partitioner: Optional[ResourcePartitioner] = None
+_partitioner_lock = threading.Lock()
+
+
+def get_partitioner() -> ResourcePartitioner:
+    global _partitioner
+    with _partitioner_lock:
+        if _partitioner is None:
+            _partitioner = ResourcePartitioner()
+        return _partitioner

@@ -1,0 +1,195 @@
+"""Timing, topology, resource partitioner, batch environment tests.
+
+Reference analogs: libs/core/timing + timed_execution, libs/core/topology,
+libs/core/resource_partitioner, libs/core/batch_environments tests
+(SURVEY.md §2.1, §2.5).
+"""
+
+import time
+
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.runtime.batch_environments import (_expand_slurm_nodelist,
+                                                detect)
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+
+# -- timing ------------------------------------------------------------------
+
+class TestTiming:
+    def test_high_resolution_timer(self):
+        t = hpx.HighResolutionTimer()
+        time.sleep(0.01)
+        e = t.elapsed()
+        HPX_TEST(0.005 < e < 5.0, e)
+        HPX_TEST(t.elapsed_microseconds() >= 5000)
+        t.restart()
+        HPX_TEST(t.elapsed() < e)
+
+    def test_clock_now_monotone(self):
+        a = hpx.high_resolution_clock_now()
+        b = hpx.high_resolution_clock_now()
+        HPX_TEST(b >= a)
+
+    def test_async_after(self):
+        t0 = time.monotonic()
+        f = hpx.async_after(0.05, lambda: "late")
+        HPX_TEST_EQ(f.get(), "late")
+        HPX_TEST(time.monotonic() - t0 >= 0.045)
+
+    def test_async_after_ordering(self):
+        out = []
+        f1 = hpx.async_after(0.08, out.append, 2)
+        f2 = hpx.async_after(0.02, out.append, 1)
+        hpx.wait_all([f1, f2])
+        HPX_TEST_EQ(out, [1, 2])
+
+    def test_async_at(self):
+        f = hpx.async_at(time.monotonic() + 0.03, lambda: 5)
+        HPX_TEST_EQ(f.get(), 5)
+
+    def test_timer_exception(self):
+        def boom():
+            raise ValueError("late boom")
+        with pytest.raises(ValueError):
+            hpx.async_after(0.01, boom).get()
+
+    def test_timed_executor(self):
+        ex = hpx.TimedExecutor()
+        t0 = time.monotonic()
+        HPX_TEST_EQ(ex.async_execute_after(0.03, lambda v: v + 1, 1).get(), 2)
+        HPX_TEST(time.monotonic() - t0 >= 0.025)
+        with pytest.raises(ValueError):
+            ex.async_execute_after(
+                0.01, lambda: (_ for _ in ()).throw(ValueError())).get()
+
+
+# -- topology ----------------------------------------------------------------
+
+class TestTopology:
+    def test_host_counts(self):
+        topo = hpx.get_topology()
+        HPX_TEST(topo.number_of_cores() >= 1)
+        HPX_TEST(topo.number_of_pus() >= 1)
+
+    def test_device_counts(self, devices):
+        topo = hpx.get_topology()
+        HPX_TEST_EQ(topo.number_of_devices(), 8)
+        HPX_TEST_EQ(topo.number_of_local_devices(), 8)
+        HPX_TEST_EQ(topo.platform(), "cpu")
+        HPX_TEST(isinstance(topo.device_kind(), str))
+        HPX_TEST_EQ(topo.number_of_processes(), 1)
+        HPX_TEST_EQ(topo.process_index(), 0)
+        HPX_TEST_EQ(len(topo.devices_by_process()[0]), 8)
+        # CPU devices expose no ICI coords
+        HPX_TEST(topo.ici_shape() is None
+                 or isinstance(topo.ici_shape(), tuple))
+        HPX_TEST(isinstance(topo.device_memory_stats(), dict))
+
+
+# -- resource partitioner ----------------------------------------------------
+
+class TestResourcePartitioner:
+    def test_pools_and_executors(self, devices):
+        rp = hpx.ResourcePartitioner()
+        rp.create_pool("io", 1)
+        rp.create_pool("halo", 1, devices=devices[:2])
+        try:
+            io = rp.get_pool("io")
+            HPX_TEST_EQ(io.num_threads, 1)
+            HPX_TEST_EQ(io.executor().async_execute(lambda: 42).get(), 42)
+            halo = rp.get_pool("halo")
+            mesh = halo.mesh(axis_names=("ring",))
+            HPX_TEST_EQ(mesh.shape["ring"], 2)
+            default = rp.get_pool()
+            HPX_TEST(default.num_threads >= 1)
+            HPX_TEST_EQ(len(default.devices), 6)   # 8 - 2 assigned
+            HPX_TEST_EQ(sorted(rp.pool_names()),
+                        ["default", "halo", "io"])
+        finally:
+            rp.shutdown()
+
+    def test_overcommit_threads_raises(self):
+        rp = hpx.ResourcePartitioner()
+        with pytest.raises(hpx.HpxError):
+            rp.create_pool("huge", 10**6)
+
+    def test_create_after_finalize_raises(self):
+        rp = hpx.ResourcePartitioner()
+        rp.get_pool()       # finalizes
+        with pytest.raises(hpx.HpxError):
+            rp.create_pool("late", 1)
+        rp.shutdown()
+
+    def test_duplicate_pool_raises(self):
+        rp = hpx.ResourcePartitioner()
+        rp.create_pool("a", 1)
+        with pytest.raises(hpx.HpxError):
+            rp.create_pool("a", 1)
+        rp.shutdown()
+
+    def test_pool_without_devices_mesh_raises(self):
+        rp = hpx.ResourcePartitioner()
+        rp.create_pool("cpuonly", 1)
+        with pytest.raises(hpx.HpxError):
+            rp.get_pool("cpuonly").mesh()
+        rp.shutdown()
+
+
+# -- batch environments ------------------------------------------------------
+
+class TestBatchEnvironments:
+    def test_none(self):
+        be = detect({})
+        HPX_TEST(not be.found())
+        HPX_TEST_EQ(be.config_overrides(), {})
+
+    def test_slurm(self):
+        be = detect({
+            "SLURM_JOB_ID": "123", "SLURM_NTASKS": "4",
+            "SLURM_PROCID": "2",
+            "SLURM_JOB_NODELIST": "nid[001-003],login1",
+        })
+        HPX_TEST_EQ(be.name, "slurm")
+        HPX_TEST_EQ(be.num_localities, 4)
+        HPX_TEST_EQ(be.this_locality, 2)
+        HPX_TEST_EQ(be.node_list,
+                    ["nid001", "nid002", "nid003", "login1"])
+        ov = be.config_overrides()
+        HPX_TEST_EQ(ov["hpx.localities"], "4")
+        HPX_TEST_EQ(ov["hpx.locality"], "2")
+        HPX_TEST_EQ(ov["hpx.parcel.address"], "nid001")
+
+    def test_slurm_nodelist_forms(self):
+        HPX_TEST_EQ(_expand_slurm_nodelist("n1"), ["n1"])
+        HPX_TEST_EQ(_expand_slurm_nodelist("n[1,3]"), ["n1", "n3"])
+        HPX_TEST_EQ(_expand_slurm_nodelist("n[08-10]"),
+                    ["n08", "n09", "n10"])
+        HPX_TEST_EQ(_expand_slurm_nodelist("a1,b[2-3]"),
+                    ["a1", "b2", "b3"])
+
+    def test_openmpi(self):
+        be = detect({"OMPI_COMM_WORLD_SIZE": "8",
+                     "OMPI_COMM_WORLD_RANK": "5"})
+        HPX_TEST_EQ((be.name, be.num_localities, be.this_locality),
+                    ("openmpi", 8, 5))
+
+    def test_tpu_pod(self):
+        be = detect({"TPU_WORKER_ID": "1",
+                     "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3"})
+        HPX_TEST_EQ((be.name, be.num_localities, be.this_locality),
+                    ("tpu", 4, 1))
+
+    def test_config_integration(self):
+        cfg = hpx.Configuration(environ={
+            "SLURM_JOB_ID": "1", "SLURM_NTASKS": "2", "SLURM_PROCID": "1",
+        })
+        HPX_TEST_EQ(cfg.get_int("hpx.localities"), 2)
+        HPX_TEST_EQ(cfg.get_int("hpx.locality"), 1)
+
+    def test_cli_beats_batch(self):
+        cfg = hpx.Configuration(
+            argv=["--hpx:localities=7"],
+            environ={"SLURM_JOB_ID": "1", "SLURM_NTASKS": "2"})
+        HPX_TEST_EQ(cfg.get_int("hpx.localities"), 7)
