@@ -101,7 +101,7 @@ from .dist.components import (  # noqa: F401
 
 # -- partitioned data + segmented algorithms (M6) ----------------------------
 from .containers import (  # noqa: F401
-    PartitionedVector, PartitionedVectorView, Segment,
+    PartitionedVector, PartitionedVectorView, Segment, UnorderedMap,
 )
 from .dist.distribution_policies import (  # noqa: F401
     ContainerLayout, container_layout, default_layout, target_layout,

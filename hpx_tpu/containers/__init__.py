@@ -5,3 +5,4 @@ from .partitioned_vector import (  # noqa: F401
     PartitionedVectorView,
     Segment,
 )
+from .unordered_map import UnorderedMap, stable_hash  # noqa: F401

@@ -1,0 +1,251 @@
+"""Distributed unordered map.
+
+Reference analog: components/containers/unordered (`hpx::unordered_map`:
+a hash map whose buckets are partition COMPONENTS spread over
+localities; keys route by hash — SURVEY.md §2.4 inventory).
+
+Built directly on the components layer (dist/components.py): one
+partition component per participating locality; a stable cross-process
+key hash picks the partition; clients ship through AGAS basenames so
+every locality can connect to the same named map. Values travel through
+the parcel serializer, so jax.Arrays are fine as values (they move as
+numpy and are restored on the reader's device) — but BULK array data
+belongs in a PartitionedVector; this container is the control-plane
+key/value store, as in the reference.
+
+Keys must hash identically in every process: supported key types are
+int, str, bytes, bool, None, and (nested) tuples thereof (Python's
+builtin hash() is salted per process, so we use a content hash).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.errors import Error, HpxError
+from ..dist.components import (Client, Component, find_from_basename, new_,
+                               register_component_type,
+                               register_with_basename)
+from ..futures.combinators import when_all
+from ..futures.future import Future, make_ready_future
+
+__all__ = ["UnorderedMap", "stable_hash"]
+
+
+def _hash_bytes(key: Any, h) -> None:
+    if key is None:
+        h.update(b"\x00N")
+    elif isinstance(key, bool):
+        h.update(b"\x00B" + (b"1" if key else b"0"))
+    elif isinstance(key, int):
+        h.update(b"\x00I" + str(key).encode())
+    elif isinstance(key, str):
+        b = key.encode("utf-8")
+        h.update(b"\x00S" + struct.pack("<Q", len(b)) + b)
+    elif isinstance(key, bytes):
+        h.update(b"\x00Y" + struct.pack("<Q", len(key)) + key)
+    elif isinstance(key, tuple):
+        h.update(b"\x00T" + struct.pack("<Q", len(key)))
+        for k in key:
+            _hash_bytes(k, h)
+    else:
+        raise HpxError(Error.bad_parameter,
+                       f"unhashable-across-processes key type: "
+                       f"{type(key).__name__} (use int/str/bytes/tuple)")
+
+
+def stable_hash(key: Any) -> int:
+    """Process-independent hash for supported key types."""
+    h = hashlib.blake2b(digest_size=8)
+    _hash_bytes(key, h)
+    return int.from_bytes(h.digest(), "little")
+
+
+@register_component_type
+class _MapPartition(Component):
+    """One bucket-set; lives on one locality (the partition server)."""
+
+    def __init__(self) -> None:
+        self.data: Dict[Any, Any] = {}
+
+    def get(self, key: Any) -> Any:
+        try:
+            return self.data[key]
+        except KeyError:
+            raise HpxError(Error.bad_parameter,
+                           f"key not found: {key!r}") from None
+
+    def get_or(self, key: Any, default: Any) -> Any:
+        return self.data.get(key, default)
+
+    def set(self, key: Any, value: Any) -> None:
+        self.data[key] = value
+
+    def update(self, kvs: List[Tuple[Any, Any]]) -> None:
+        self.data.update(kvs)
+
+    def erase(self, key: Any) -> bool:
+        return self.data.pop(key, _MISSING) is not _MISSING
+
+    def contains(self, key: Any) -> bool:
+        return key in self.data
+
+    def size(self) -> int:
+        return len(self.data)
+
+    def items(self) -> List[Tuple[Any, Any]]:
+        return list(self.data.items())
+
+    def clear(self) -> int:
+        n = len(self.data)
+        self.data.clear()
+        return n
+
+
+class _Missing:
+    __slots__ = ()
+
+
+_MISSING = _Missing()
+
+
+class UnorderedMap:
+    """hpx::unordered_map analog.
+
+    Create on ONE locality (partitions are placed round-robin over the
+    given localities), publish with register_as, connect elsewhere with
+    connect_to. All value-returning calls have future (`*_async`) and
+    blocking spellings, like the reference's client API.
+    """
+
+    def __init__(self, localities: Optional[Sequence[int]] = None,
+                 _parts: Optional[List[Client]] = None) -> None:
+        if _parts is not None:
+            self._parts = _parts
+            return
+        if localities is None:
+            from ..dist.runtime import find_all_localities
+            localities = find_all_localities()
+        if not localities:
+            raise HpxError(Error.bad_parameter, "no localities given")
+        self._parts = [new_(_MapPartition, loc).get(timeout=30.0)
+                       for loc in localities]
+
+    # -- routing ------------------------------------------------------------
+    def _part(self, key: Any) -> Client:
+        return self._parts[stable_hash(key) % len(self._parts)]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    # -- element access ------------------------------------------------------
+    def set_async(self, key: Any, value: Any) -> Future:
+        return self._part(key).call("set", key, value)
+
+    def set(self, key: Any, value: Any) -> None:
+        self.set_async(key, value).get()
+
+    def get_async(self, key: Any) -> Future:
+        return self._part(key).call("get", key)
+
+    def get(self, key: Any, default: Any = _MISSING) -> Any:
+        if default is _MISSING:
+            return self.get_async(key).get()
+        return self._part(key).call("get_or", key, default).get()
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self.set(key, value)
+
+    def __getitem__(self, key: Any) -> Any:
+        try:
+            return self.get_async(key).get()
+        except HpxError as e:
+            raise KeyError(key) from e
+
+    def contains_async(self, key: Any) -> Future:
+        return self._part(key).call("contains", key)
+
+    def __contains__(self, key: Any) -> bool:
+        return bool(self.contains_async(key).get())
+
+    def erase_async(self, key: Any) -> Future:
+        return self._part(key).call("erase", key)
+
+    def erase(self, key: Any) -> bool:
+        return bool(self.erase_async(key).get())
+
+    def __delitem__(self, key: Any) -> None:
+        if not self.erase(key):
+            raise KeyError(key)
+
+    # -- bulk ----------------------------------------------------------------
+    def update(self, mapping: Any) -> Future:
+        """Batched multi-set: one parcel per touched partition."""
+        items = mapping.items() if hasattr(mapping, "items") else mapping
+        per: Dict[int, List[Tuple[Any, Any]]] = {}
+        for k, v in items:
+            per.setdefault(stable_hash(k) % len(self._parts),
+                           []).append((k, v))
+        futs = [self._parts[i].call("update", kvs)
+                for i, kvs in per.items()]
+        if not futs:
+            return make_ready_future(None)
+        return when_all(futs).then(
+            lambda f: [x.get() for x in f.get()] and None)
+
+    def size_async(self) -> Future:
+        futs = [p.call("size") for p in self._parts]
+        return when_all(futs).then(
+            lambda f: sum(x.get() for x in f.get()))
+
+    def size(self) -> int:
+        return self.size_async().get()
+
+    def __len__(self) -> int:
+        return self.size()
+
+    def items(self) -> List[Tuple[Any, Any]]:
+        futs = [p.call("items") for p in self._parts]
+        out: List[Tuple[Any, Any]] = []
+        for f in when_all(futs).get():
+            out.extend(f.get())
+        return out
+
+    def keys(self) -> List[Any]:
+        return [k for k, _v in self.items()]
+
+    def values(self) -> List[Any]:
+        return [v for _k, v in self.items()]
+
+    def clear(self) -> int:
+        futs = [p.call("clear") for p in self._parts]
+        return sum(f.get() for f in when_all(futs).get())
+
+    # -- lifetime / naming ---------------------------------------------------
+    def register_as(self, name: str) -> Future:
+        """Publish partition clients under a basename (reference:
+        HPX_REGISTER_UNORDERED_MAP + register_with_basename)."""
+        futs = [register_with_basename(f"unordered/{name}", p, i)
+                for i, p in enumerate(self._parts)]
+        futs.append(register_with_basename(
+            f"unordered/{name}/nparts", len(self._parts)))
+        return when_all(futs).then(
+            lambda f: [x.get() for x in f.get()] and None)
+
+    @classmethod
+    def connect_to(cls, name: str) -> "UnorderedMap":
+        n = find_from_basename(f"unordered/{name}/nparts").get(timeout=30.0)
+        parts = [find_from_basename(f"unordered/{name}", i).get(timeout=30.0)
+                 for i in range(int(n))]
+        return cls(_parts=parts)
+
+    def free(self) -> Future:
+        futs = [p.free() for p in self._parts]
+        return when_all(futs).then(
+            lambda f: [x.get() for x in f.get()] and None)
+
+    def __repr__(self) -> str:
+        return f"UnorderedMap(partitions={len(self._parts)})"

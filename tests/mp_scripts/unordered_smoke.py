@@ -1,0 +1,54 @@
+"""Multi-locality unordered_map smoke (3 localities).
+
+Partitions land one per locality; every locality connects by name,
+writes its own keys, and reads everyone else's. Reference analog:
+components/containers/unordered distributed tests.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import hpx_tpu as hpx
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+    nloc = hpx.get_num_localities()
+
+    if here == 0:
+        m = hpx.UnorderedMap()          # one partition per locality
+        HPX_TEST_EQ(m.num_partitions, nloc)
+        m.register_as("smoke-map").get()
+        hpx.get_runtime().barrier("map-ready")
+    else:
+        hpx.get_runtime().barrier("map-ready")
+        m = hpx.UnorderedMap.connect_to("smoke-map")
+
+    # each locality writes 10 keys
+    m.update({(here, i): here * 100 + i for i in range(10)}).get()
+    hpx.get_runtime().barrier("written")
+
+    # ... and reads every other locality's keys
+    for loc in range(nloc):
+        for i in range(10):
+            HPX_TEST_EQ(m[(loc, i)], loc * 100 + i)
+    HPX_TEST_EQ(len(m), nloc * 10)
+
+    # partitions really are spread: each partition component lives on a
+    # distinct locality
+    wheres = sorted(p.where().get() for p in m._parts)
+    HPX_TEST_EQ(wheres, list(range(nloc)))
+
+    hpx.get_runtime().barrier("read")
+    if here == 0:
+        m.free().get()
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""Distributed unordered_map tests.
+
+Reference analog: components/containers/unordered tests (SURVEY.md
+§2.4). Single-locality partition routing + semantics here; the
+cross-process path is tests/mp_scripts/unordered_smoke.py.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.containers.unordered_map import stable_hash
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+class TestStableHash:
+    def test_deterministic_across_processes(self):
+        # run a child with a different hash seed; digests must agree
+        code = ("import sys; sys.path.insert(0, %r); "
+                "from hpx_tpu.containers.unordered_map import stable_hash; "
+                "print(stable_hash('k1'), stable_hash((1, 'a', b'b', None, "
+                "True)))" % REPO)
+        env = dict(os.environ, PYTHONHASHSEED="12345")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, check=True)
+        a, b = out.stdout.split()
+        HPX_TEST_EQ(int(a), stable_hash("k1"))
+        HPX_TEST_EQ(int(b), stable_hash((1, "a", b"b", None, True)))
+
+    def test_distinct(self):
+        keys = ["a", "b", "ab", b"a", 1, (1,), ("a",), None, True, 0]
+        digests = {stable_hash(k) for k in keys}
+        HPX_TEST_EQ(len(digests), len(keys))
+
+    def test_unsupported_key_raises(self):
+        with pytest.raises(hpx.HpxError):
+            stable_hash([1, 2])
+        with pytest.raises(hpx.HpxError):
+            stable_hash(1.5)
+
+
+class TestUnorderedMap:
+    def test_basic_set_get(self):
+        m = hpx.UnorderedMap()
+        m["x"] = 1
+        m[("compound", 2)] = {"nested": True}
+        HPX_TEST_EQ(m["x"], 1)
+        HPX_TEST_EQ(m[("compound", 2)], {"nested": True})
+        HPX_TEST_EQ(len(m), 2)
+        HPX_TEST("x" in m and "y" not in m)
+        m.free().get()
+
+    def test_missing_key(self):
+        m = hpx.UnorderedMap()
+        with pytest.raises(KeyError):
+            m["missing"]
+        HPX_TEST_EQ(m.get("missing", 42), 42)
+        with pytest.raises(KeyError):
+            del m["missing"]
+        m.free().get()
+
+    def test_erase(self):
+        m = hpx.UnorderedMap()
+        m["k"] = "v"
+        HPX_TEST(m.erase("k") is True)
+        HPX_TEST(m.erase("k") is False)
+        HPX_TEST_EQ(len(m), 0)
+        m.free().get()
+
+    def test_bulk_update_items(self):
+        m = hpx.UnorderedMap()
+        m.update({f"k{i}": i for i in range(50)}).get()
+        HPX_TEST_EQ(len(m), 50)
+        HPX_TEST_EQ(sorted(v for _k, v in m.items()), list(range(50)))
+        HPX_TEST_EQ(sorted(m.keys())[0], "k0")
+        HPX_TEST_EQ(m.clear(), 50)
+        HPX_TEST_EQ(len(m), 0)
+        m.free().get()
+
+    def test_async_spellings(self):
+        m = hpx.UnorderedMap()
+        hpx.wait_all([m.set_async(i, i * i) for i in range(10)])
+        futs = [m.get_async(i) for i in range(10)]
+        HPX_TEST_EQ([f.get() for f in futs], [i * i for i in range(10)])
+        HPX_TEST_EQ(m.size_async().get(), 10)
+        m.free().get()
+
+    def test_jax_array_values(self):
+        m = hpx.UnorderedMap()
+        m["weights"] = jnp.arange(8, dtype=jnp.float32)
+        got = m["weights"]
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.arange(8, dtype=np.float32))
+        m.free().get()
+
+    def test_register_connect_roundtrip(self):
+        m = hpx.UnorderedMap()
+        m["shared"] = 7
+        m.register_as("unit-map").get()
+        m2 = hpx.UnorderedMap.connect_to("unit-map")
+        HPX_TEST_EQ(m2["shared"], 7)
+        m2["from-peer"] = 8
+        HPX_TEST_EQ(m["from-peer"], 8)
+        m.free().get()
+
+
+def test_multiprocess_unordered_map():
+    from hpx_tpu.run import launch
+    rc = launch(os.path.join(REPO, "tests", "mp_scripts",
+                             "unordered_smoke.py"),
+                [], localities=3, timeout=180.0)
+    assert rc == 0
