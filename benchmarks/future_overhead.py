@@ -1,0 +1,79 @@
+"""future_overhead — task spawn/schedule throughput microbenchmark.
+
+Reference analog: tests/performance/local/future_overhead.cpp (the
+canonical HPX scheduler benchmark: spawn N null tasks, measure
+tasks/second; literature magnitude O(10^6)/s/core — BASELINE.md).
+
+Measures, per scheduler backend available:
+  create_thread_hierarchical: async_ fan-out, wait_all
+  post (fire-and-forget) with a latch
+  sync-execute baseline (function call floor)
+
+Prints one perftests-style JSON line per case (hpx::util::
+perftests_report analog).
+
+Usage: python benchmarks/future_overhead.py [num_tasks]
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import hpx_tpu as hpx  # noqa: E402
+
+
+def null_fn() -> None:
+    pass
+
+
+def bench(name: str, n: int, fn) -> dict:
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    row = {
+        "name": name,
+        "executor": "default-pool",
+        "tasks": n,
+        "seconds": round(dt, 6),
+        "tasks_per_s": round(n / dt, 1),
+        "us_per_task": round(dt / n * 1e6, 3),
+    }
+    print(json.dumps(row))
+    return row
+
+
+def case_async_wait_all(n: int) -> None:
+    hpx.wait_all([hpx.async_(null_fn) for _ in range(n)])
+
+
+def case_post_latch(n: int) -> None:
+    latch = hpx.Latch(n + 1)
+
+    def hit() -> None:
+        latch.count_down(1)
+
+    for _ in range(n):
+        hpx.post(hit)
+    latch.arrive_and_wait()
+
+
+def case_sync_floor(n: int) -> None:
+    for _ in range(n):
+        null_fn()
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    # warm the pool
+    hpx.wait_all([hpx.async_(null_fn) for _ in range(100)])
+
+    bench("async+wait_all", n, case_async_wait_all)
+    bench("post+latch", n, case_post_latch)
+    bench("call floor (no tasks)", n, case_sync_floor)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+"""1d_stencil — the heat-equation workload family (config #2).
+
+Reference analog: examples/1d_stencil/1d_stencil_{1,4}.cpp. Three
+variants, same physics:
+  serial    — whole-array jit step loop (1d_stencil_1)
+  dataflow  — per-partition futures DAG via hpx.dataflow (1d_stencil_4)
+  fused     — T steps fused per dispatch, pallas in-VMEM where it fits
+              (the TPU-first production configuration)
+
+Usage: python examples/1d_stencil.py [nx] [np] [nt]
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+argv = setup_platform()
+
+import numpy as np  # noqa: E402
+
+import hpx_tpu as hpx  # noqa: E402
+from hpx_tpu.models.stencil1d import (  # noqa: E402
+    StencilParams, gather_dataflow_result, init_domain, print_time_results,
+    stencil_dataflow, stencil_fused, stencil_serial)
+
+
+def main() -> int:
+    nx = int(argv[0]) if argv else 1 << 14
+    np_ = int(argv[1]) if len(argv) > 1 else 8
+    nt = int(argv[2]) if len(argv) > 2 else 64
+    p = StencilParams(nx=nx, np_=np_, nt=nt)
+    u0 = init_domain(p)
+
+    t = hpx.HighResolutionTimer()
+    ref = np.asarray(stencil_serial(p, u0))
+    print_time_results("serial", t.elapsed(), p)
+
+    t.restart()
+    out = gather_dataflow_result(stencil_dataflow(p, u0=u0))
+    print_time_results("dataflow", t.elapsed(), p)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    t.restart()
+    fused = stencil_fused(p, u0)
+    print_time_results("fused", t.elapsed(), p)
+    np.testing.assert_allclose(np.asarray(fused), ref, rtol=1e-4,
+                               atol=1e-5)
+    print("all variants agree")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""Shared example plumbing: platform selection before jax import.
+
+Examples run on the real TPU by default; pass --cpu-mesh N (or set
+HPX_TPU_EXAMPLE_CPU=N) to run on an N-device virtual CPU mesh — the
+same environment the test suite uses, so every example is runnable
+anywhere. Must be imported BEFORE jax.
+"""
+
+import os
+import sys
+
+
+def setup_platform(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    n = os.environ.get("HPX_TPU_EXAMPLE_CPU")
+    if "--cpu-mesh" in argv:
+        i = argv.index("--cpu-mesh")
+        n = argv[i + 1] if i + 1 < len(argv) else "8"
+        del argv[i:i + 2]
+    if n:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return argv

@@ -1,0 +1,69 @@
+"""Accumulator component — the classic HPX components tutorial.
+
+Reference analog: examples/accumulators/ (a server component with
+add/query actions, a client_base wrapper, creation on a chosen
+locality, access from anywhere by symbolic name).
+
+Single process:  python examples/accumulator.py
+Multi-locality:  python -m hpx_tpu.run examples/accumulator.py -l 2
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+setup_platform()
+
+import hpx_tpu as hpx  # noqa: E402
+from hpx_tpu.svc.iostreams import cout  # noqa: E402
+
+
+@hpx.register_component_type
+class Accumulator(hpx.Component):
+    def __init__(self) -> None:
+        self.value = 0
+
+    def reset(self) -> None:
+        self.value = 0
+
+    def add(self, n: int) -> None:
+        self.value += n
+
+    def query(self) -> int:
+        return self.value
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+    n = hpx.get_num_localities()
+
+    if here == 0:
+        # create on the LAST locality (remote when n > 1)
+        acc = hpx.new_(Accumulator, n - 1).get()
+        hpx.register_with_basename("example/accumulator", acc).get()
+        for i in range(1, 11):
+            acc.add(i).get()
+        cout.println(f"accumulator lives on locality "
+                     f"{acc.where().get()}; sum(1..10) = "
+                     f"{acc.sync('query')}")
+    if n > 1:
+        hpx.get_runtime().barrier("acc-created")
+        if here != 0:
+            acc = hpx.find_from_basename("example/accumulator").get()
+            acc.add(1000 * here).get()
+        hpx.get_runtime().barrier("acc-added")
+        if here == 0:
+            total = acc.sync("query")
+            expect = 55 + sum(1000 * i for i in range(1, n))
+            cout.println(f"after remote adds: {total} (expect {expect})")
+            assert total == expect
+        hpx.get_runtime().barrier("acc-done")
+    cout.flush().get()
+    hpx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Channels: local futures-based channels + distributed ping-pong.
+
+Reference analog: examples/quickstart/channel.cpp and
+local_channel_docs — `hpx::lcos::local::channel` generator-style
+consumption, and `hpx::distributed::channel` for cross-locality
+handoff (1d_stencil_8's halo pattern).
+
+Single process:  python examples/channel_demo.py
+Multi-locality:  python -m hpx_tpu.run examples/channel_demo.py -l 2
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+setup_platform()
+
+import hpx_tpu as hpx  # noqa: E402
+from hpx_tpu.lcos import Channel  # noqa: E402
+from hpx_tpu.svc.iostreams import cout  # noqa: E402
+
+
+def local_demo() -> None:
+    ch = Channel()
+
+    def producer() -> None:
+        for i in range(5):
+            ch.set(i * i)
+        ch.close()
+
+    hpx.post(producer)
+    got = list(ch)
+    cout.println(f"local channel drained: {got}")
+    assert got == [0, 1, 4, 9, 16]
+
+
+def distributed_demo() -> None:
+    here = hpx.find_here()
+    comm = hpx.create_channel_communicator("pingpong", 2)
+    if here == 0:
+        comm.set(1, "ping")
+        reply = comm.get(1).get()
+        cout.println(f"locality 0 got: {reply}")
+        assert reply == "pong"
+    else:
+        msg = comm.get(0).get()
+        comm.set(0, "pong" if msg == "ping" else "???")
+    hpx.get_runtime().barrier("pingpong-done")
+
+
+def main() -> int:
+    hpx.init()
+    if hpx.find_here() == 0:
+        local_demo()
+    if hpx.get_num_localities() >= 2:
+        distributed_demo()
+    cout.flush().get()
+    hpx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

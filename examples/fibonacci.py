@@ -1,0 +1,57 @@
+"""Futurized fibonacci — the canonical HPX quickstart demo.
+
+Reference analog: examples/quickstart/fibonacci.cpp (naive recursive
+fib where each level is an hpx::async; demonstrates task spawning and
+future composition, and why task granularity matters).
+
+Usage: python examples/fibonacci.py [n] [threshold]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+argv = setup_platform()
+
+import hpx_tpu as hpx  # noqa: E402
+
+
+def fib_plain(n: int) -> int:
+    return n if n < 2 else fib_plain(n - 1) + fib_plain(n - 2)
+
+
+def fib_futurized(n: int, threshold: int) -> int:
+    """Spawn a task per node above the threshold; below it, run serial
+    (HPX's fibonacci_futures 'cutoff' — granularity control)."""
+    if n < threshold:
+        return fib_plain(n)
+    lhs = hpx.async_(fib_futurized, n - 1, threshold)
+    rhs = fib_futurized(n - 2, threshold)
+    return lhs.get() + rhs
+
+
+def main() -> int:
+    n = int(argv[0]) if argv else 20
+    threshold = int(argv[1]) if len(argv) > 1 else 12
+
+    t = hpx.HighResolutionTimer()
+    serial = fib_plain(n)
+    t_serial = t.elapsed()
+
+    t.restart()
+    futurized = fib_futurized(n, threshold)
+    t_fut = t.elapsed()
+
+    assert serial == futurized
+    print(f"fib({n}) = {futurized}")
+    print(f"serial:    {t_serial * 1e3:8.2f} ms")
+    print(f"futurized: {t_fut * 1e3:8.2f} ms "
+          f"(threshold {threshold}, tasks on "
+          f"{hpx.get_topology().number_of_cores()} core(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""Hello world from every locality — the first HPX distributed demo.
+
+Reference analog: examples/quickstart/hello_world_distributed.cpp
+(hello from every locality, marshalled through hpx::cout so the console
+prints one coherent stream).
+
+Single process:  python examples/hello_world_distributed.py
+Multi-locality:  python -m hpx_tpu.run examples/hello_world_distributed.py -l 3
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+setup_platform()
+
+import hpx_tpu as hpx  # noqa: E402
+from hpx_tpu.svc.iostreams import cout  # noqa: E402
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+    n = hpx.get_num_localities()
+    topo = hpx.get_topology()
+    cout.println(f"hello world from locality {here} of {n} "
+                 f"({topo.number_of_cores()} cores, "
+                 f"platform {topo.platform()})")
+    cout.flush().get()
+    hpx.get_runtime().barrier("hello-done")
+    hpx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

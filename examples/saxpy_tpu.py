@@ -1,0 +1,58 @@
+"""SAXPY + dot via transform_reduce on the TPU executor — config #1.
+
+Reference analog: hpx::transform_reduce with execution::par
+(libs/core/algorithms), the north-star spelling:
+`par.on(tpu_executor())` reroutes the whole algorithm to one fused XLA
+program (SURVEY.md §3.3 TPU note).
+
+Usage: python examples/saxpy_tpu.py [log2_n]
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+argv = setup_platform()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import hpx_tpu as hpx  # noqa: E402
+
+
+def main() -> int:
+    log2n = int(argv[0]) if argv else 22
+    n = 1 << log2n
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random(n, np.float32))
+    y = jnp.asarray(rng.random(n, np.float32))
+    a = jnp.float32(2.5)
+
+    policy = hpx.par.on(hpx.tpu_executor())
+
+    # z = a*x + y (transform), then dot(z, x) (transform_reduce) — the
+    # composed saxpy+dot of BASELINE config #1
+    z = hpx.transform(policy, x, lambda xi: a * xi)     # scale
+    z = hpx.transform(policy, z, jnp.add, rng2=y)       # + y
+    dot = hpx.transform_reduce(policy, z, jnp.float32(0.0), jnp.add,
+                               jnp.multiply, rng2=x)
+
+    t = hpx.HighResolutionTimer()
+    reps = 10
+    for _ in range(reps):
+        z = hpx.transform(policy, z, jnp.add, rng2=y)
+    _ = float(z[0])
+    per = t.elapsed() / reps
+    gbs = 3 * n * 4 / per / 1e9
+
+    want = float(np.dot(np.asarray(z) - reps * np.asarray(y),
+                        np.asarray(x)))
+    print(f"n = {n}: dot(saxpy) = {float(dot):.2f} "
+          f"(check offset vs final z: {want:.2f})")
+    print(f"streaming add: {gbs:.1f} GB/s effective")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,18 @@ Public API façade mirroring HPX's umbrella headers (hpx/hpx.hpp):
     hpx.transform_reduce(hpx.par.on(hpx.tpu_executor()), ...)
 """
 
+# Platform override hook (set by hpx_tpu.run for child localities):
+# sandboxes can force an accelerator platform via sitecustomize
+# (jax.config.update at interpreter start), which wins over the
+# JAX_PLATFORMS env var — counter it before any device query.
+import os as _os  # noqa: E402
+
+if _os.environ.get("HPX_TPU_FORCE_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms",
+                       _os.environ["HPX_TPU_FORCE_PLATFORM"])
+
 from .core.version import HPX_TPU_VERSION, full_version_as_string  # noqa: F401
 from .core.errors import Error, ErrorCode, HpxError  # noqa: F401
 from .core.config import Configuration  # noqa: F401

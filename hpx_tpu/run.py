@@ -44,6 +44,12 @@ def launch(script: str, script_args: List[str], localities: int,
             env["HPX_TPU_OS_THREADS"] = str(threads)
         if jax_platform:
             env["JAX_PLATFORMS"] = jax_platform
+            # the env var alone is not enough on sandboxes whose
+            # sitecustomize force-registers an accelerator plugin and
+            # calls jax.config.update("jax_platforms", ...) at interpreter
+            # start; hpx_tpu honors this at import and re-updates the
+            # config (tests/conftest.py does the same for pytest)
+            env["HPX_TPU_FORCE_PLATFORM"] = jax_platform
         procs.append(subprocess.Popen(
             [sys.executable, script, *script_args], env=env))
     rc = 0
@@ -71,9 +77,10 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("script")
-    ap.add_argument("script_args", nargs=argparse.REMAINDER)
-    ns = ap.parse_args()
-    sys.exit(launch(ns.script, ns.script_args, ns.localities, ns.threads,
+    # parse_known_args (not REMAINDER): launcher flags work before OR
+    # after the script path; everything unrecognized passes through
+    ns, script_args = ap.parse_known_args()
+    sys.exit(launch(ns.script, script_args, ns.localities, ns.threads,
                     ns.platform, ns.timeout))
 
 

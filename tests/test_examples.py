@@ -1,0 +1,64 @@
+"""Examples run as smoke tests — the reference registers its examples/
+binaries as CTest smoke tests (SURVEY.md §4); same idea: every example
+must exit 0 on the CPU mesh, single-process and (where it applies)
+multi-locality.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_example(name, *args, timeout=240):
+    return subprocess.run(
+        [sys.executable, os.path.join("examples", name),
+         *args, "--cpu-mesh", "8"],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def run_distributed(name, localities, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "hpx_tpu.run", "-l", str(localities),
+         "--timeout", str(timeout - 20),
+         os.path.join("examples", name)],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.parametrize("name,args", [
+    ("fibonacci.py", ["15", "10"]),
+    ("saxpy_tpu.py", ["16"]),
+    ("1d_stencil.py", ["2048", "4", "8"]),
+    ("transpose.py", ["128"]),
+    ("hello_world_distributed.py", []),
+    ("channel_demo.py", []),
+    ("accumulator.py", []),
+])
+def test_example_single(name, args):
+    r = run_example(name, *args)
+    assert r.returncode == 0, f"{name}: {r.stdout}\n{r.stderr}"
+
+
+@pytest.mark.parametrize("name,localities", [
+    ("hello_world_distributed.py", 2),
+    ("channel_demo.py", 2),
+    ("accumulator.py", 2),
+])
+def test_example_distributed(name, localities):
+    r = run_distributed(name, localities)
+    assert r.returncode == 0, f"{name}: {r.stdout}\n{r.stderr}"
+
+
+def test_future_overhead_benchmark():
+    r = subprocess.run(
+        [sys.executable, os.path.join("benchmarks", "future_overhead.py"),
+         "2000"],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    import json
+    rows = [json.loads(line) for line in r.stdout.splitlines() if line]
+    assert len(rows) == 3
+    assert all(row["tasks_per_s"] > 0 for row in rows)
