@@ -280,9 +280,11 @@ class Runtime:
         if self.num_localities == 1:
             return
         from .actions import async_action
+        # generous default: on a loaded single-core host, N fresh
+        # localities importing jax can legitimately stagger by minutes
         async_action("hpx.barrier_arrive", 0, tag,
                      self.num_localities).get(
-            self.cfg.get_float("hpx.route_timeout", 30.0) * 2)
+            self.cfg.get_float("hpx.barrier_timeout", 180.0))
 
     def finalize(self) -> None:
         """Orderly shutdown: barrier first so no locality closes its

@@ -1,0 +1,211 @@
+"""Decoder-only transformer with a fully sharded training step.
+
+The reference (HPX) ships no ML models; this is the model family the
+driver mandates for the TPU rebuild, built on the framework's own
+substrate: ring attention (ops/attention.py — the halo-exchange ring of
+SURVEY.md §5.7) for sequence parallelism, XLA collectives over ICI for
+tensor/data parallelism.
+
+Parallelism layout over a Mesh(("dp","sp","tp")):
+  dp — batch sharded; grads psum over dp (+sp for the sequence split)
+  sp — sequence sharded; attention walks the ring (ring_attention_
+       sharded), everything else is token-local
+  tp — Megatron-style: attention heads and MLP hidden dim sharded;
+       wo/w2 contractions end in a psum over tp
+
+Everything (forward, loss, backward through the ring, optimizer) runs
+inside ONE shard_map-jitted program — the whole training step is a
+single XLA executable per device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import ring_attention_sharded
+
+__all__ = ["TransformerConfig", "init_params", "make_train_step",
+           "make_mesh_3d", "shard_params", "shard_batch", "sample_batch"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    head_dim: int = 16
+    n_layers: int = 2
+    d_ff: int = 128
+    dtype: Any = jnp.float32
+    lr: float = 1e-2
+
+
+def make_mesh_3d(n_devices: int, devices=None):
+    """Factor n into (dp, sp, tp) — prefer sp and tp first (they
+    exercise the interesting collectives), then dp."""
+    import numpy as np
+    import jax as _j
+    devs = list(devices) if devices is not None else _j.devices()
+    devs = devs[:n_devices]
+
+    def take(n, want):
+        f = math.gcd(n, want)
+        while f < want and n % (f * 2) == 0 and f * 2 <= want:
+            f *= 2
+        return (f if n % f == 0 else 1)
+
+    tp = 2 if n_devices % 2 == 0 else 1
+    rest = n_devices // tp
+    sp = 2 if rest % 2 == 0 else 1
+    dp = rest // sp
+    from jax.sharding import Mesh
+    return Mesh(np.array(devs).reshape(dp, sp, tp), ("dp", "sp", "tp"))
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    """Weight pytree. tp-sharded leaves carry their FULL logical shape
+    here; shard_params() places them."""
+    d, nh, hd, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    s = 1.0 / math.sqrt(d)
+
+    def layer(k):
+        k1, k2, k3, k4 = jax.random.split(k, 4)
+        return {
+            "ln1": jnp.ones((d,), cfg.dtype),
+            "wqkv": (jax.random.normal(k1, (3, d, nh, hd)) * s
+                     ).astype(cfg.dtype),
+            "wo": (jax.random.normal(k2, (nh, hd, d)) * s
+                   ).astype(cfg.dtype),
+            "ln2": jnp.ones((d,), cfg.dtype),
+            "w1": (jax.random.normal(k3, (d, f)) * s).astype(cfg.dtype),
+            "b1": jnp.zeros((f,), cfg.dtype),
+            "w2": (jax.random.normal(k4, (f, d)) / math.sqrt(f)
+                   ).astype(cfg.dtype),
+        }
+
+    return {
+        "emb": (jax.random.normal(keys[0], (cfg.vocab, d)) * s
+                ).astype(cfg.dtype),
+        "ln_f": jnp.ones((d,), cfg.dtype),
+        "layers": [layer(keys[2 + i]) for i in range(cfg.n_layers)],
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs: heads/ffn over tp, everything else replicated."""
+    layer = {
+        "ln1": P(), "wqkv": P(None, None, "tp", None),
+        "wo": P("tp", None, None), "ln2": P(),
+        "w1": P(None, "tp"), "b1": P("tp"), "w2": P("tp", None),
+    }
+    return {"emb": P(), "ln_f": P(),
+            "layers": [dict(layer) for _ in range(cfg.n_layers)]}
+
+
+def shard_params(params, cfg: TransformerConfig, mesh):
+    from jax.sharding import NamedSharding
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_batch(tokens, targets, mesh):
+    from jax.sharding import NamedSharding
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    return jax.device_put(tokens, sh), jax.device_put(targets, sh)
+
+
+def sample_batch(cfg: TransformerConfig, batch: int, seq: int,
+                 key: jax.Array):
+    toks = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab)
+    return toks[:, :-1], toks[:, 1:]
+
+
+# ---------------------------------------------------------------------------
+# per-shard forward / loss
+# ---------------------------------------------------------------------------
+
+def _ln(x, scale):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
+
+
+def _block(x, lp, sp_size: int):
+    """One decoder block on a [B/dp, S/sp, D] shard; heads already
+    tp-local. The Megatron f/g conjugate pair is implicit: with vma
+    tracking on, jax transposes the closing psums and reduces the
+    mixed replicated/partial cotangents itself."""
+    h = _ln(x, lp["ln1"])
+    q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+    att = ring_attention_sharded(q, k, v, "sp", sp_size, causal=True)
+    o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
+    o = jax.lax.psum(o, "tp")              # Megatron row-parallel close
+    x = x + o
+    h = _ln(x, lp["ln2"])
+    h = jax.nn.gelu(h @ lp["w1"] + lp["b1"])
+    h = h @ lp["w2"]
+    h = jax.lax.psum(h, "tp")
+    return x + h
+
+
+def _local_loss(params, tokens, targets, cfg: TransformerConfig,
+                sp_size: int):
+    """Shard-local token loss SUM and count (psum'd by the caller)."""
+    x = params["emb"][tokens]              # [B/dp, S/sp, D]
+    for lp in params["layers"]:
+        x = _block(x, lp, sp_size)
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tok_ll = jnp.take_along_axis(logp, targets[..., None],
+                                 axis=-1)[..., 0]
+    return -tok_ll.sum(), tok_ll.size
+
+
+# ---------------------------------------------------------------------------
+# the training step (one sharded XLA program)
+# ---------------------------------------------------------------------------
+
+def make_train_step(cfg: TransformerConfig, mesh):
+    """Returns jitted train_step(params, tokens, targets) ->
+    (params, loss). SGD built in (optimizer state = params only) to keep
+    the step self-contained; swap in optax by carrying its state the
+    same way."""
+    sp_size = mesh.shape["sp"]
+    pspecs = param_specs(cfg)
+    data_spec = P("dp", "sp")
+
+    def step(params, tokens, targets):
+        def loss_fn(p):
+            s, n = _local_loss(p, tokens, targets, cfg, sp_size)
+            total = jax.lax.psum(s, ("dp", "sp"))
+            count = jax.lax.psum(jnp.float32(n), ("dp", "sp"))
+            return total / count
+
+        # vma (varying-manual-axes) tracking is ON: jax's AD knows each
+        # param enters invariant (replicated) over the axes its spec
+        # omits, and automatically psums cotangents over exactly the
+        # axes they vary on — dp/sp data partials AND the Megatron tp
+        # mixed-replication case (residual replicated, attention/MLP
+        # partial) come out correctly reduced with no manual psums.
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params = jax.tree.map(
+            lambda p, g: p - cfg.lr * g.astype(p.dtype), params, grads)
+        return new_params, loss
+
+    return jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, data_spec, data_spec),
+        out_specs=(pspecs, P())))

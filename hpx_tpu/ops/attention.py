@@ -1,0 +1,264 @@
+"""Attention ops: flash-style blockwise attention, ring attention
+(sequence parallel over the ICI ring), and Ulysses (all_to_all head
+parallel).
+
+The reference (HPX) contains no attention — SURVEY.md §5.7 documents
+that the nearest structural analogs it DOES have are the halo-exchange
+ring (`lax.ppermute`, parallel/halo.py) and the `all_to_all` collective.
+These ops are the long-context capability built ON that substrate, as
+the driver mandates: ring attention is the stencil halo pattern with an
+online-softmax accumulator; Ulysses is the segmented-algorithm pattern
+with an all_to_all re-shard.
+
+Shapes follow jax convention: [batch, seq, heads, head_dim] ("BSNH").
+All math accumulates in float32 regardless of input dtype (bfloat16
+inputs stay bf16 on the wire/MXU, f32 in the softmax accumulator).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "reference_attention", "blockwise_attention", "ring_attention",
+    "ring_attention_sharded", "ulysses_attention",
+]
+
+
+def _scale(q: jax.Array) -> jax.Array:
+    return q * (1.0 / math.sqrt(q.shape[-1]))
+
+
+def _pvary(x: jax.Array, axis) -> jax.Array:
+    """Mark a constant as device-varying over shard_map axis/axes (newer
+    jax tracks varying manual axes; older versions don't need it)."""
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# reference (materializes the full score matrix — test oracle only)
+# ---------------------------------------------------------------------------
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False) -> jax.Array:
+    """O(S^2) memory oracle. [B,S,N,H] -> [B,S,N,H]."""
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    s = jnp.einsum("bqnh,bknh->bnqk", _scale(qf), kf)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bnqk,bknh->bqnh", p, vf)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# blockwise (flash) attention — single device
+# ---------------------------------------------------------------------------
+
+def _online_block(q: jax.Array, k: jax.Array, v: jax.Array,
+                  acc: jax.Array, m: jax.Array, l: jax.Array,
+                  bias: Optional[jax.Array] = None):
+    """One K/V block of online softmax.
+
+    q:[B,Sq,N,H] k,v:[B,Sk,N,H]; acc:[B,Sq,N,H] f32; m,l:[B,Sq,N] f32.
+    bias (optional): [Sq,Sk] additive mask (-inf for masked).
+    Returns updated (acc, m, l).
+    """
+    s = jnp.einsum("bqnh,bknh->bqnk", _scale(q.astype(jnp.float32)),
+                   k.astype(jnp.float32))
+    if bias is not None:
+        s = s + bias[None, :, None, :]
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # renormalize the old accumulator; -inf rows (nothing seen yet and
+    # fully masked block) must contribute exp(0)=... guard NaNs:
+    corr = jnp.exp(m - m_new)
+    corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(jnp.isfinite(p), p, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bqnk,bknh->bqnh", p, v.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
+def _finish(acc: jax.Array, l: jax.Array, dtype) -> jax.Array:
+    den = jnp.where(l > 0, l, 1.0)[..., None]
+    return (acc / den).astype(dtype)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        block_k: int = 512) -> jax.Array:
+    """Flash-style attention: K/V consumed in blocks with an online
+    softmax — O(S) memory. The inner loop is a lax.scan, so XLA sees a
+    static program whatever the sequence length."""
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    nblk = -(-sk // block_k)
+    pad = nblk * block_k - sk
+    if pad:
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    else:
+        kp, vp = k, v
+    kb = kp.reshape(b, nblk, block_k, n, h).transpose(1, 0, 2, 3, 4)
+    vb = vp.reshape(b, nblk, block_k, n, h).transpose(1, 0, 2, 3, 4)
+
+    q_pos = jnp.arange(sq)
+    # accumulators derive from q (not fresh constants) so that when this
+    # runs INSIDE a shard_map (ulysses_attention) the scan carry has the
+    # same varying-manual-axes type as its updated value; XLA folds the
+    # multiply-by-zero
+    zero_q = q.astype(jnp.float32) * 0.0
+    acc0 = zero_q
+    m0 = zero_q[..., 0] - jnp.inf
+    l0 = zero_q[..., 0]
+
+    def step(carry, inputs):
+        acc, m, l = carry
+        kblk, vblk, blk_idx = inputs
+        k_pos = blk_idx * block_k + jnp.arange(block_k)
+        bias = jnp.where(k_pos[None, :] < sk, 0.0, -jnp.inf)
+        if causal:
+            bias = bias + jnp.where(
+                k_pos[None, :] <= q_pos[:, None] + (sk - sq), 0.0,
+                -jnp.inf)
+        else:
+            bias = jnp.broadcast_to(bias, (sq, block_k))
+        return _online_block(q, kblk, vblk, acc, m, l, bias), None
+
+    (acc, _m, l), _ = jax.lax.scan(
+        step, (acc0, m0, l0), (kb, vb, jnp.arange(nblk)))
+    return _finish(acc, l, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ring attention — sequence parallel over a mesh axis
+# ---------------------------------------------------------------------------
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Any,
+                   axis: str = "sp", causal: bool = False) -> jax.Array:
+    """Sequence-parallel attention: q/k/v sharded on `axis` along seq.
+
+    Each device keeps its Q chunk resident and walks the WHOLE sequence
+    by rotating K/V chunks around the ICI ring (`lax.ppermute` — the
+    1d_stencil halo pattern, SURVEY.md §5.7), folding each arriving
+    chunk into an online-softmax accumulator. Peak memory per chip is
+    O(S/P); bandwidth is the ring's, which is exactly what the halos
+    already ride.
+
+    Causal masking is positional: chunk ownership gives each device its
+    global offset, so masking stays correct whatever step the chunk
+    arrives on (full-chunk skips still compute — uniform work per step
+    keeps the ring in lockstep, the standard TPU tradeoff).
+    """
+    nshards = mesh.shape[axis]
+    spec = P(None, axis, None, None)
+
+    def body(qc, kc, vc):
+        return ring_attention_sharded(qc, kc, vc, axis, nshards, causal)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec))(q, k, v)
+
+
+def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
+                           axis: str, nshards: int,
+                           causal: bool = False) -> jax.Array:
+    """The per-shard ring body, callable from INSIDE an enclosing
+    shard_map (e.g. a sharded transformer step). The ring loop is a
+    lax.scan, so reverse-mode AD works (scan transposes; the ppermute
+    transpose is the inverse rotation) — training steps can
+    differentiate straight through the ring.
+    """
+    b, sq, n, h = qc.shape
+    idx = jax.lax.axis_index(axis)
+    q_pos = idx * sq + jnp.arange(sq)              # global positions
+
+    # accumulators derive from qc (already device-varying), so the scan
+    # carry's varying manual axes match the updated values whatever
+    # enclosing mesh axes exist
+    zero_q = qc.astype(jnp.float32) * 0.0
+    acc = zero_q
+    m = zero_q[..., 0] - jnp.inf
+    l = zero_q[..., 0]
+
+    perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+
+    def step(carry, t):
+        acc, m, l, kc, vc = carry
+        # chunk arriving at step t started at ring position idx-t
+        src = (idx - t) % nshards
+        k_pos = src * sq + jnp.arange(sq)
+        if causal:
+            bias = jnp.where(k_pos[None, :] <= q_pos[:, None],
+                             0.0, -jnp.inf)
+        else:
+            bias = jnp.zeros((sq, sq), jnp.float32)
+        acc, m, l = _online_block(qc, kc, vc, acc, m, l, bias)
+        # rotate AFTER folding; ppermute rides the ICI ring
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (acc, m, l, kc, vc), None
+
+    (acc, m, l, _kc, _vc), _ = jax.lax.scan(
+        step, (acc, m, l, kc, vc), jnp.arange(nshards))
+    return _finish(acc, l, qc.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses — all_to_all head parallelism
+# ---------------------------------------------------------------------------
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Any,
+                      axis: str = "sp", causal: bool = False) -> jax.Array:
+    """DeepSpeed-Ulysses style sequence parallelism: inputs sharded on
+    seq; one all_to_all re-shards to (full seq × heads/P), attention
+    runs locally per head group, a second all_to_all restores the seq
+    sharding. Requires num_heads % axis_size == 0.
+
+    This is the `all_to_all` collective of the reference's collectives
+    module (SURVEY.md §5.7) applied to the attention layout swap; on
+    TPU both all_to_alls are single fused ICI ops.
+    """
+    nshards = mesh.shape[axis]
+    n = q.shape[2]
+    if n % nshards:
+        raise ValueError(f"heads ({n}) not divisible by mesh axis "
+                         f"({nshards}) — use ring_attention")
+    spec = P(None, axis, None, None)
+
+    def body(qc, kc, vc):
+        def seq_to_heads(x):
+            # [B, S/P, N, H] -> [B, S, N/P, H] (tiled all_to_all splits
+            # the head axis across the ring and concatenates sequence)
+            return jax.lax.all_to_all(x, axis, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        def heads_to_seq(x):
+            # [B, S, N/P, H] -> [B, S/P, N, H]
+            return jax.lax.all_to_all(x, axis, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq_to_heads(qc), seq_to_heads(kc), seq_to_heads(vc)
+        out = blockwise_attention(qh, kh, vh, causal=causal)
+        return heads_to_seq(out)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec))(q, k, v)

@@ -1,0 +1,134 @@
+"""Attention ops tests: blockwise == reference; ring and Ulysses
+sequence-parallel forms == reference on the 8-device mesh.
+
+The reference (HPX) has no attention; these validate the long-context
+capability built on the halo/all_to_all substrate (SURVEY.md §5.7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpx_tpu.ops.attention import (blockwise_attention, reference_attention,
+                                   ring_attention, ulysses_attention)
+from hpx_tpu.parallel import make_mesh
+
+B, S, N, H = 2, 64, 4, 16
+
+
+def _qkv(seed=0, dtype=jnp.float32, s=S):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, s, N, H), np.float32), dtype)
+    return mk(), mk(), mk()
+
+
+def _close(a, b, dtype):
+    tol = 2e-2 if dtype == jnp.bfloat16 else 2e-5
+    np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32),
+        rtol=tol, atol=tol)
+
+
+class TestBlockwise:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("block_k", [16, 23, 64, 512])
+    def test_matches_reference(self, causal, block_k):
+        q, k, v = _qkv()
+        want = reference_attention(q, k, v, causal)
+        got = blockwise_attention(q, k, v, causal, block_k=block_k)
+        _close(got, want, jnp.float32)
+
+    def test_bfloat16(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16)
+        want = reference_attention(q, k, v, True)
+        got = blockwise_attention(q, k, v, True, block_k=32)
+        assert got.dtype == jnp.bfloat16
+        _close(got, want, jnp.bfloat16)
+
+    def test_long_seq_memory_shape(self):
+        q, k, v = _qkv(s=256)
+        out = blockwise_attention(q, k, v, block_k=64)
+        assert out.shape == (B, 256, N, H)
+
+
+class TestRing:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal, mesh1d):
+        mesh = make_mesh((8,), ("sp",))
+        q, k, v = _qkv(seed=1)
+        want = reference_attention(q, k, v, causal)
+        got = ring_attention(q, k, v, mesh, "sp", causal)
+        _close(got, want, jnp.float32)
+
+    def test_output_stays_sharded(self):
+        mesh = make_mesh((8,), ("sp",))
+        q, k, v = _qkv(seed=2)
+        out = ring_attention(q, k, v, mesh, "sp")
+        assert len(out.sharding.device_set) == 8
+
+    def test_2d_mesh_dp_x_sp(self):
+        # batch over dp, sequence over sp — the combined layout a
+        # training step uses
+        mesh = make_mesh((2, 4), ("dp", "sp"))
+        q, k, v = _qkv(seed=3)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P("dp", "sp", None, None))
+        q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+        want = reference_attention(q, k, v, True)
+
+        from jax import shard_map
+        from hpx_tpu.ops import attention as att
+
+        def body(qc, kc, vc):
+            # inside dp shard: ring over sp
+            nshards = 4
+            idx = jax.lax.axis_index("sp")
+            b, sq, n, h = qc.shape
+            q_pos = idx * sq + jnp.arange(sq)
+            axes = ("dp", "sp")
+            acc = att._pvary(jnp.zeros((b, sq, n, h), jnp.float32), axes)
+            m = att._pvary(jnp.full((b, sq, n), -jnp.inf, jnp.float32),
+                           axes)
+            l = att._pvary(jnp.zeros((b, sq, n), jnp.float32), axes)
+
+            def step(t, carry):
+                acc, m, l, kc, vc = carry
+                src = (idx - t) % nshards
+                k_pos = src * sq + jnp.arange(sq)
+                bias = jnp.where(k_pos[None, :] <= q_pos[:, None],
+                                 0.0, -jnp.inf)
+                acc, m, l = att._online_block(qc, kc, vc, acc, m, l, bias)
+                perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+                kc = jax.lax.ppermute(kc, "sp", perm)
+                vc = jax.lax.ppermute(vc, "sp", perm)
+                return acc, m, l, kc, vc
+
+            acc, m, l, _, _ = jax.lax.fori_loop(0, nshards, step,
+                                                (acc, m, l, kc, vc))
+            return att._finish(acc, l, qc.dtype)
+
+        got = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P("dp", "sp"), P("dp", "sp"), P("dp", "sp")),
+            out_specs=P("dp", "sp")))(q, k, v)
+        _close(got, want, jnp.float32)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        import jax as _j
+        mesh = make_mesh((4,), ("sp",), _j.devices()[:4])
+        q, k, v = _qkv(seed=4)
+        want = reference_attention(q, k, v, causal)
+        got = ulysses_attention(q, k, v, mesh, "sp", causal)
+        _close(got, want, jnp.float32)
+
+    def test_indivisible_heads_raises(self):
+        import jax as _j
+        mesh = make_mesh((8,), ("sp",), _j.devices())
+        q, k, v = _qkv()          # N=4 heads < 8 shards
+        with pytest.raises(ValueError):
+            ulysses_attention(q, k, v, mesh, "sp")

@@ -1,0 +1,81 @@
+"""Transformer model family tests: the dp×sp×tp-sharded training step
+compiles, runs, agrees with a single-device replica, and learns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpx_tpu.models import transformer as tfm
+
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=2, d_ff=64, lr=0.05)
+
+
+@pytest.fixture(scope="module")
+def mesh3d():
+    return tfm.make_mesh_3d(8)
+
+
+def test_mesh_factoring():
+    m = tfm.make_mesh_3d(8)
+    assert dict(m.shape) == {"dp": 2, "sp": 2, "tp": 2}
+    m4 = tfm.make_mesh_3d(4)
+    assert m4.shape["sp"] * m4.shape["tp"] * m4.shape["dp"] == 4
+
+
+def test_train_step_runs_and_learns(mesh3d):
+    key = jax.random.PRNGKey(0)
+    params = tfm.init_params(CFG, key)
+    params = tfm.shard_params(params, CFG, mesh3d)
+    step = tfm.make_train_step(CFG, mesh3d)
+
+    # one fixed tiny batch -> loss must drop when memorizing it
+    toks, tgts = tfm.sample_batch(CFG, batch=4, seq=32,
+                                  key=jax.random.PRNGKey(1))
+    toks, tgts = tfm.shard_batch(toks, tgts, mesh3d)
+
+    losses = []
+    for _ in range(10):
+        params, loss = step(params, toks, tgts)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
+    assert np.isfinite(losses).all()
+
+
+def test_matches_single_device(mesh3d):
+    """The sharded step must compute the SAME loss and updates as an
+    unsharded replica of the math."""
+    key = jax.random.PRNGKey(2)
+    params = tfm.init_params(CFG, key)
+    toks, tgts = tfm.sample_batch(CFG, batch=4, seq=16,
+                                  key=jax.random.PRNGKey(3))
+
+    # single-device oracle: same math, mesh of 1x1x1
+    mesh1 = tfm.make_mesh_3d(1)
+    p1 = tfm.shard_params(jax.tree.map(jnp.copy, params), CFG, mesh1)
+    step1 = tfm.make_train_step(CFG, mesh1)
+    t1, g1 = tfm.shard_batch(toks, tgts, mesh1)
+    p1, loss1 = step1(p1, t1, g1)
+
+    p8 = tfm.shard_params(jax.tree.map(jnp.copy, params), CFG, mesh3d)
+    step8 = tfm.make_train_step(CFG, mesh3d)
+    t8, g8 = tfm.shard_batch(toks, tgts, mesh3d)
+    p8, loss8 = step8(p8, t8, g8)
+
+    np.testing.assert_allclose(float(loss1), float(loss8), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p8)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_params_actually_sharded(mesh3d):
+    params = tfm.shard_params(tfm.init_params(CFG, jax.random.PRNGKey(0)),
+                              CFG, mesh3d)
+    w1 = params["layers"][0]["w1"]
+    # tp axis of the mesh has 2 shards; w1's column dim is split
+    assert len(w1.sharding.device_set) == 8
+    shard_shapes = {s.data.shape for s in w1.addressable_shards}
+    assert shard_shapes == {(CFG.d_model, CFG.d_ff // 2)}
