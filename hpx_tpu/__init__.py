@@ -83,6 +83,12 @@ from .parallel.spmd import (  # noqa: F401
     SpmdBlock, define_spmd_block, device_spmd_block,
 )
 
+# pipeline parallelism (GPipe-style microbatched stages)
+from .parallel.pipeline import Pipeline, PipelineStage  # noqa: F401
+
+# plugin system (binary filters, coalescing, open registry)
+from .dist import plugins  # noqa: F401
+
 # -- parallel algorithms (M3) ------------------------------------------------
 from .algo import (  # noqa: F401
     for_each, for_each_n, for_loop, transform, copy, copy_n, copy_if,

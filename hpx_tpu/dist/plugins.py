@@ -1,0 +1,241 @@
+"""Plugin system: binary filters (parcel compression) and message
+coalescing.
+
+Reference analog: libs/core/plugin + libs/full/plugin_factories +
+components/parcel_plugins (SURVEY.md §2.5): runtime-registered plugin
+factories; binary filters (snappy/zlib/bzip2) compressing parcel
+payloads; the message-coalescing plugin batching many small parcels to
+the same destination into one wire message.
+
+TPU-first: the parcel plane is the CONTROL plane (bulk data rides ICI),
+so filters/coalescing matter for metadata-heavy workloads — thousands
+of small actions (AGAS chatter, counter queries, component invokes).
+Filters use stdlib/zstd codecs; registration is open (`register_plugin`)
+so a deployment can plug its own.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.errors import Error, HpxError
+
+__all__ = [
+    "register_plugin", "get_plugin", "list_plugins",
+    "BinaryFilter", "get_filter", "Coalescer",
+]
+
+# ---------------------------------------------------------------------------
+# generic registry (plugin_registry analog)
+# ---------------------------------------------------------------------------
+
+_plugins: Dict[Tuple[str, str], Any] = {}
+_plugins_lock = threading.Lock()
+
+
+def register_plugin(kind: str, name: str, factory: Any,
+                    replace: bool = False) -> None:
+    with _plugins_lock:
+        key = (kind, name)
+        if key in _plugins and not replace:
+            raise HpxError(Error.bad_plugin_type,
+                           f"plugin exists: {kind}/{name}")
+        _plugins[key] = factory
+
+
+def get_plugin(kind: str, name: str) -> Any:
+    with _plugins_lock:
+        f = _plugins.get((kind, name))
+    if f is None:
+        raise HpxError(Error.bad_plugin_type,
+                       f"no such plugin: {kind}/{name}")
+    return f
+
+
+def list_plugins(kind: Optional[str] = None) -> List[Tuple[str, str]]:
+    with _plugins_lock:
+        keys = list(_plugins)
+    return [k for k in keys if kind is None or k[0] == kind]
+
+
+# ---------------------------------------------------------------------------
+# binary filters (compression)
+# ---------------------------------------------------------------------------
+
+class BinaryFilter:
+    """A named (compress, decompress) pair. `wire_id` is the single
+    byte identifying the filter on the wire; ids must be stable across
+    all localities of a run (they share the registration code)."""
+
+    def __init__(self, name: str, wire_id: int,
+                 compress: Callable[[bytes], bytes],
+                 decompress: Callable[[bytes], bytes]) -> None:
+        if not (1 <= wire_id <= 255):
+            raise HpxError(Error.bad_parameter, "wire_id must be 1..255")
+        self.name = name
+        self.wire_id = wire_id
+        self.compress = compress
+        self.decompress = decompress
+
+
+_filters_by_id: Dict[int, BinaryFilter] = {}
+
+
+def _register_filter(f: BinaryFilter) -> None:
+    register_plugin("binary_filter", f.name, f)
+    _filters_by_id[f.wire_id] = f
+
+
+def get_filter(name_or_id) -> BinaryFilter:
+    if isinstance(name_or_id, int):
+        f = _filters_by_id.get(name_or_id)
+        if f is None:
+            raise HpxError(Error.bad_plugin_type,
+                           f"unknown filter wire id: {name_or_id}")
+        return f
+    return get_plugin("binary_filter", name_or_id)
+
+
+def _install_builtin_filters() -> None:
+    import bz2
+    import lzma
+    import zlib
+    _register_filter(BinaryFilter(
+        "zlib", 1, lambda b: zlib.compress(b, 6), zlib.decompress))
+    _register_filter(BinaryFilter(
+        "bzip2", 2, lambda b: bz2.compress(b, 6), bz2.decompress))
+    _register_filter(BinaryFilter(
+        "lzma", 3, lambda b: lzma.compress(b, preset=1), lzma.decompress))
+    try:
+        import zstandard
+        c = zstandard.ZstdCompressor(level=3)
+        d = zstandard.ZstdDecompressor()
+        _register_filter(BinaryFilter(
+            "zstd", 4, c.compress,
+            lambda b: d.decompress(b, max_output_size=1 << 31)))
+    except ImportError:       # pragma: no cover — zstd optional
+        pass
+
+
+_install_builtin_filters()
+
+
+# wire framing for the parcel layer: 1 header byte (0 = raw, else the
+# filter's wire_id), then the (possibly compressed) payload
+_RAW = b"\x00"
+
+
+def encode_payload(data: bytes, filt: Optional[BinaryFilter],
+                   min_size: int = 512) -> bytes:
+    """Compress when a filter is configured, the payload is big enough
+    to matter, and compression actually wins (the reference's filters
+    fall back to raw on incompressible data)."""
+    if filt is None or len(data) < min_size:
+        return _RAW + data
+    packed = filt.compress(data)
+    if len(packed) + 1 >= len(data):
+        return _RAW + data
+    return bytes((filt.wire_id,)) + packed
+
+
+def decode_payload(data: bytes) -> bytes:
+    wire_id = data[0]
+    if wire_id == 0:
+        return data[1:]
+    return get_filter(wire_id).decompress(data[1:])
+
+
+# ---------------------------------------------------------------------------
+# message coalescing
+# ---------------------------------------------------------------------------
+
+class Coalescer:
+    """Batch messages per destination; flush on count, byte budget,
+    interval, or explicitly (the parcel coalescing plugin's policy).
+
+    `send_batch(dest, [payload, ...])` is the downstream; payloads keep
+    FIFO order per destination.
+    """
+
+    def __init__(self, send_batch: Callable[[int, List[Any]], None],
+                 max_count: int = 64, max_bytes: int = 1 << 16,
+                 interval_s: float = 0.001) -> None:
+        self._send = send_batch
+        self.max_count = max_count
+        self.max_bytes = max_bytes
+        self.interval_s = interval_s
+        self._lock = threading.Lock()
+        self._queues: Dict[int, List[Any]] = {}
+        self._bytes: Dict[int, int] = {}
+        self._deadline: Dict[int, float] = {}
+        self._cv = threading.Condition(self._lock)
+        self._flusher: Optional[threading.Thread] = None
+        self._stop = False
+        self.flushes = 0          # perf-counter feeds
+        self.coalesced = 0
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None or not self._flusher.is_alive():
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="parcel-coalescer",
+                daemon=True)
+            self._flusher.start()
+
+    def put(self, dest: int, payload: Any, nbytes: int) -> None:
+        out = None
+        with self._lock:
+            q = self._queues.setdefault(dest, [])
+            q.append(payload)
+            self._bytes[dest] = self._bytes.get(dest, 0) + nbytes
+            self._deadline.setdefault(
+                dest, time.monotonic() + self.interval_s)
+            self.coalesced += 1
+            if (len(q) >= self.max_count
+                    or self._bytes[dest] >= self.max_bytes):
+                out = self._take_locked(dest)
+            else:
+                self._ensure_flusher()
+                self._cv.notify_all()
+        if out:
+            self._send(dest, out)
+
+    def _take_locked(self, dest: int) -> List[Any]:
+        q = self._queues.pop(dest, [])
+        self._bytes.pop(dest, None)
+        self._deadline.pop(dest, None)
+        if q:
+            self.flushes += 1
+        return q
+
+    def flush(self, dest: Optional[int] = None) -> None:
+        with self._lock:
+            dests = [dest] if dest is not None else list(self._queues)
+            batches = [(d, self._take_locked(d)) for d in dests]
+        for d, batch in batches:
+            if batch:
+                self._send(d, batch)
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                now = time.monotonic()
+                due = [d for d, t in self._deadline.items() if t <= now]
+                batches = [(d, self._take_locked(d)) for d in due]
+                if not self._deadline:
+                    self._cv.wait(0.05)
+                else:
+                    nxt = min(self._deadline.values())
+                    self._cv.wait(max(0.0, nxt - time.monotonic()))
+            for d, batch in batches:
+                if batch:
+                    self._send(d, batch)
+
+    def close(self) -> None:
+        self.flush()
+        with self._lock:
+            self._stop = True
+            self._cv.notify_all()

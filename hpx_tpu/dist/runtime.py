@@ -34,6 +34,7 @@ _TABLE = "table"      # (tag, {locality: (host, port)})
 _IDENT = "ident"      # (tag, locality)
 _PARCEL = "parcel"    # (tag, action_name, args, kwargs, req_id, src_loc)
 _RESULT = "result"    # (tag, req_id, ok, payload)
+_BATCH = "batch"      # (tag, [msg, ...])  — coalesced parcels
 
 
 class Runtime:
@@ -60,6 +61,22 @@ class Runtime:
         self.parcels_received = 0
         self.bytes_sent = 0
         self.bytes_received = 0
+
+        # plugins: binary filter (parcel compression) + coalescing
+        from .plugins import Coalescer, get_filter
+        fname = cfg.get("hpx.parcel.compression", "")
+        self._filter = get_filter(fname) if fname else None
+        self._filter_min = cfg.get_int("hpx.parcel.compression_min_bytes",
+                                       512)
+        self._coalescer = None
+        if cfg.get_bool("hpx.parcel.coalescing", False):
+            self._coalescer = Coalescer(
+                self._send_batch,
+                max_count=cfg.get_int("hpx.parcel.coalescing_count", 64),
+                max_bytes=cfg.get_int("hpx.parcel.coalescing_bytes",
+                                      1 << 16),
+                interval_s=cfg.get_float(
+                    "hpx.parcel.coalescing_interval", 0.001))
 
         if self.num_localities > 1:
             self._bootstrap()
@@ -110,7 +127,9 @@ class Runtime:
 
     # -- wire ---------------------------------------------------------------
     def _send_raw(self, peer_id: int, msg: Any) -> None:
-        data = serialize(msg)
+        from .plugins import encode_payload
+        data = encode_payload(serialize(msg), self._filter,
+                              self._filter_min)
         self.parcels_sent += 1          # counter feeds (svc/performance_
         self.bytes_sent += len(data)    # counters.py); GIL-atomic enough
         self._endpoint.send(peer_id, data)
@@ -140,11 +159,26 @@ class Runtime:
         self.parcels_received += 1
         self.bytes_received += len(data)
         try:
-            msg = deserialize(data)
+            from .plugins import decode_payload
+            msg = deserialize(decode_payload(data))
         except Exception:  # noqa: BLE001
             import traceback
             traceback.print_exc()
             return
+        tag = msg[0]
+        if tag == _BATCH:
+            # batch payloads are individually serialized blobs (one
+            # serialize per parcel at enqueue, not two)
+            for blob in msg[1]:
+                try:
+                    self._dispatch(peer_id, deserialize(blob))
+                except Exception:  # noqa: BLE001
+                    import traceback
+                    traceback.print_exc()
+            return
+        self._dispatch(peer_id, msg)
+
+    def _dispatch(self, peer_id: int, msg: Any) -> None:
         tag = msg[0]
         if tag == _PARCEL:
             self._handle_parcel(msg)
@@ -268,9 +302,16 @@ class Runtime:
                 self._next_req += 1
                 self._pending[req_id] = st
             fut = Future(st)
-        self._send_to_locality(
-            locality, (_PARCEL, name, args, kwargs, req_id, self.locality))
+        msg = (_PARCEL, name, args, kwargs, req_id, self.locality)
+        if self._coalescer is not None:
+            blob = serialize(msg)
+            self._coalescer.put(locality, blob, len(blob))
+        else:
+            self._send_to_locality(locality, msg)
         return fut
+
+    def _send_batch(self, loc: int, blobs: list) -> None:
+        self._send_to_locality(loc, (_BATCH, blobs))
 
     def barrier(self, tag: str = "default") -> None:
         """Release barrier: every locality's arrive-action on the console
@@ -292,6 +333,8 @@ class Runtime:
         ordering trap — SURVEY.md §7)."""
         if self._stopped:
             return
+        if self._coalescer is not None:
+            self._coalescer.flush()
         if self.num_localities > 1:
             try:
                 self.barrier("__finalize__")
@@ -304,12 +347,52 @@ class Runtime:
                     lambda: self._inflight == 0,
                     self.cfg.get_float("hpx.shutdown_timeout", 10.0))
         self._stopped = True
+        if self._coalescer is not None:
+            self._coalescer.close()
         if self._endpoint is not None:
             self._endpoint.close()
 
 
 _runtime: Optional[Runtime] = None
 _runtime_lock = threading.Lock()
+
+
+_counter_print_stop: Optional[Any] = None
+
+
+def _start_counter_printing(cfg: Configuration) -> None:
+    """--hpx:print-counter[-interval] wiring: periodic printing when an
+    interval is configured; otherwise a one-shot dump at finalize
+    (reference behavior — shutdown counter report)."""
+    global _counter_print_stop
+    patterns = cfg.get("hpx.counters.print", "")
+    interval = cfg.get_float("hpx.counters.print_interval", 0.0)
+    if patterns and interval > 0:
+        from ..svc.performance_counters import start_counter_printing
+        stops = [start_counter_printing(interval, p.strip())
+                 for p in patterns.split(",") if p.strip()]
+
+        def stop_all() -> None:
+            for s in stops:
+                s()
+        _counter_print_stop = stop_all
+
+
+def _finalize_counter_printing(cfg: Configuration) -> None:
+    global _counter_print_stop
+    if _counter_print_stop is not None:
+        _counter_print_stop()
+        _counter_print_stop = None
+    patterns = cfg.get("hpx.counters.print", "")
+    if patterns and cfg.get_float("hpx.counters.print_interval",
+                                  0.0) <= 0:
+        from ..svc.performance_counters import print_counters
+        for p in patterns.split(","):
+            if p.strip():
+                try:
+                    print_counters(p.strip())
+                except Exception:  # noqa: BLE001 — shutdown must proceed
+                    pass
 
 
 def init(argv: Optional[list] = None,
@@ -323,6 +406,7 @@ def init(argv: Optional[list] = None,
         cfg = Configuration(argv=argv, overrides=overrides)
         set_runtime_config(cfg)
         _runtime = Runtime(cfg)
+        _start_counter_printing(cfg)
         return _runtime
 
 
@@ -340,6 +424,7 @@ def finalize() -> None:
     global _runtime
     with _runtime_lock:
         if _runtime is not None:
+            _finalize_counter_printing(_runtime.cfg)
             _runtime.finalize()
             _runtime = None
             set_runtime_config(None)

@@ -6,3 +6,4 @@ from .halo import (  # noqa: F401
     sharded_multistep,
 )
 from .spmd import SpmdBlock, define_spmd_block, device_spmd_block  # noqa: F401
+from .pipeline import Pipeline, PipelineStage  # noqa: F401

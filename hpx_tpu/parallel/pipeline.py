@@ -1,0 +1,131 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+Reference analog: SURVEY.md §2.9 — HPX expresses pipelines as futures/
+dataflow chains with channel handoff between stages (1d_stencil_8
+pattern). TPU-first: each STAGE lives on its own device; microbatches
+flow through per-stage jitted programs; XLA's per-device async dispatch
+queues overlap stage s of microbatch m with stage s+1 of microbatch
+m-1 — the dataflow futures ARE the pipeline schedule, no bubbles
+beyond GPipe's fill/drain.
+
+Training: forward runs per-stage `jax.vjp`, residuals stay resident on
+the stage's device; backward walks stages in reverse per microbatch,
+accumulating stage-local param grads. Semantics verified equal to the
+unpipelined model (tests/test_pipeline.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PipelineStage", "Pipeline"]
+
+
+class PipelineStage:
+    """One stage: fn(params, x) -> y, pinned to a device."""
+
+    def __init__(self, fn: Callable[[Any, Any], Any], params: Any,
+                 device: Any = None) -> None:
+        self.fn = fn
+        self.device = device
+        self.params = (jax.device_put(params, device)
+                       if device is not None else params)
+        # computation follows its operands: params live on `device`, so
+        # the jitted stage runs there (no deprecated jit(device=...))
+        self._fwd = jax.jit(fn)
+        # vjp-producing forward (training): returns y and residuals
+        def fwd_vjp(params, x):
+            y, pullback = jax.vjp(fn, params, x)
+            return y, pullback
+        self._fwd_vjp = fwd_vjp
+
+    def to_device(self, x: Any) -> Any:
+        return jax.device_put(x, self.device) if self.device is not None \
+            else x
+
+
+class Pipeline:
+    """A chain of stages over distinct devices.
+
+        pipe = Pipeline([(fn0, p0), (fn1, p1)], devices=jax.devices()[:2])
+        ys = pipe.forward(microbatches)              # inference
+        loss, grads = pipe.train_step(mbs, tgts, loss_fn)
+
+    forward() dispatches every (stage, microbatch) cell eagerly; jax's
+    async dispatch pipelines them across devices (stage k of mb i runs
+    while stage k+1 of mb i-1 runs) — the GPipe schedule emerges from
+    the dataflow rather than being hand-scheduled.
+    """
+
+    def __init__(self, stage_defs: Sequence[Tuple[Callable, Any]],
+                 devices: Optional[Sequence[Any]] = None) -> None:
+        if devices is None:
+            devices = jax.devices()
+        n = len(stage_defs)
+        if len(devices) < n:
+            # fewer devices than stages: wrap around (still correct,
+            # just less parallel)
+            devices = [devices[i % len(devices)] for i in range(n)]
+        self.stages = [PipelineStage(fn, p, devices[i])
+                       for i, (fn, p) in enumerate(stage_defs)]
+
+    @property
+    def params(self) -> List[Any]:
+        return [s.params for s in self.stages]
+
+    # -- inference -----------------------------------------------------------
+    def forward(self, microbatches: Sequence[Any]) -> List[Any]:
+        outs = []
+        for mb in microbatches:
+            x = mb
+            for st in self.stages:
+                x = st._fwd(st.params, st.to_device(x))
+            outs.append(x)
+        return outs
+
+    # -- training ------------------------------------------------------------
+    def train_step(self, microbatches: Sequence[Any],
+                   targets: Sequence[Any],
+                   loss_fn: Callable[[Any, Any], Any],
+                   ) -> Tuple[Any, List[Any]]:
+        """GPipe: forward all microbatches (saving pullbacks), backward
+        all, accumulate grads per stage. Returns (mean loss, grads per
+        stage). Gradient == the unpipelined gradient of
+        mean_mb(loss_fn(model(x), t))."""
+        nmb = len(microbatches)
+        # forward: fill the pipeline
+        pullbacks: List[List[Any]] = [[] for _ in self.stages]
+        acts: List[Any] = []
+        for mb in microbatches:
+            x = mb
+            for si, st in enumerate(self.stages):
+                x, pb = st._fwd_vjp(st.params, st.to_device(x))
+                pullbacks[si].append(pb)
+            acts.append(x)
+
+        # loss + dLoss/dy per microbatch
+        loss_grad = jax.jit(jax.value_and_grad(
+            lambda y, t: loss_fn(y, t)))
+        losses = []
+        grads: List[Any] = [None] * len(self.stages)
+        for mi in range(nmb):
+            lval, gy = loss_grad(acts[mi], targets[mi])
+            losses.append(lval)
+            cot = jax.tree.map(lambda g: g / nmb, gy)
+            # backward: drain stages in reverse
+            for si in range(len(self.stages) - 1, -1, -1):
+                st = self.stages[si]
+                gparams, gx = pullbacks[si][mi](st.to_device(cot))
+                grads[si] = gparams if grads[si] is None else \
+                    jax.tree.map(jnp.add, grads[si], gparams)
+                cot = gx
+        mean_loss = sum(jnp.asarray(l) for l in losses) / nmb
+        return mean_loss, grads
+
+    def apply_grads(self, grads: List[Any], lr: float) -> None:
+        for st, g in zip(self.stages, grads):
+            st.params = jax.tree.map(lambda p, gg: p - lr * gg,
+                                     st.params, g)

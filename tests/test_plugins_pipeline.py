@@ -1,0 +1,244 @@
+"""Plugin system (filters/coalescing) and pipeline parallelism tests."""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.dist import plugins as plg
+from hpx_tpu.parallel.pipeline import Pipeline
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+# -- plugin registry ---------------------------------------------------------
+
+class TestRegistry:
+    def test_register_get_list(self):
+        plg.register_plugin("test_kind", "alpha", object(), replace=True)
+        HPX_TEST(plg.get_plugin("test_kind", "alpha") is not None)
+        HPX_TEST(("test_kind", "alpha") in plg.list_plugins("test_kind"))
+
+    def test_duplicate_raises(self):
+        plg.register_plugin("test_kind", "dup", 1, replace=True)
+        with pytest.raises(hpx.HpxError):
+            plg.register_plugin("test_kind", "dup", 2)
+
+    def test_unknown_raises(self):
+        with pytest.raises(hpx.HpxError):
+            plg.get_plugin("nope", "nothing")
+
+
+# -- binary filters ----------------------------------------------------------
+
+class TestFilters:
+    @pytest.mark.parametrize("name", ["zlib", "bzip2", "lzma", "zstd"])
+    def test_roundtrip(self, name):
+        try:
+            f = plg.get_filter(name)
+        except hpx.HpxError:
+            pytest.skip(f"{name} not available")
+        data = b"hello world " * 500
+        packed = f.compress(data)
+        HPX_TEST(len(packed) < len(data))
+        HPX_TEST_EQ(f.decompress(packed), data)
+        HPX_TEST(plg.get_filter(f.wire_id) is f)
+
+    def test_payload_framing(self):
+        f = plg.get_filter("zlib")
+        big = b"abc" * 1000
+        enc = plg.encode_payload(big, f)
+        HPX_TEST(enc[0] == f.wire_id and len(enc) < len(big))
+        HPX_TEST_EQ(plg.decode_payload(enc), big)
+
+    def test_small_payload_stays_raw(self):
+        f = plg.get_filter("zlib")
+        small = b"tiny"
+        enc = plg.encode_payload(small, f)
+        HPX_TEST(enc[0] == 0)
+        HPX_TEST_EQ(plg.decode_payload(enc), small)
+
+    def test_incompressible_falls_back_to_raw(self):
+        f = plg.get_filter("zlib")
+        rnd = np.random.default_rng(0).bytes(4096)
+        enc = plg.encode_payload(rnd, f)
+        HPX_TEST(enc[0] == 0)      # compression would not win
+        HPX_TEST_EQ(plg.decode_payload(enc), rnd)
+
+    def test_no_filter(self):
+        enc = plg.encode_payload(b"x" * 5000, None)
+        HPX_TEST(enc[0] == 0)
+        HPX_TEST_EQ(plg.decode_payload(enc), b"x" * 5000)
+
+
+# -- coalescer ---------------------------------------------------------------
+
+class TestCoalescer:
+    def test_count_flush(self):
+        sent = []
+        c = plg.Coalescer(lambda d, batch: sent.append((d, batch)),
+                          max_count=3, interval_s=10.0)
+        for i in range(7):
+            c.put(1, f"m{i}", 10)
+        HPX_TEST_EQ(len(sent), 2)                  # two full batches
+        HPX_TEST_EQ(sent[0], (1, ["m0", "m1", "m2"]))
+        c.flush()
+        HPX_TEST_EQ(len(sent), 3)
+        HPX_TEST_EQ(sent[2], (1, ["m6"]))          # FIFO preserved
+        c.close()
+
+    def test_byte_flush(self):
+        sent = []
+        c = plg.Coalescer(lambda d, b: sent.append(b), max_count=1000,
+                          max_bytes=100, interval_s=10.0)
+        c.put(0, "a", 60)
+        HPX_TEST_EQ(sent, [])
+        c.put(0, "b", 60)                          # 120 > 100
+        HPX_TEST_EQ(sent, [["a", "b"]])
+        c.close()
+
+    def test_interval_flush(self):
+        sent = []
+        ev = threading.Event()
+
+        def send(d, b):
+            sent.append(b)
+            ev.set()
+
+        c = plg.Coalescer(send, max_count=1000, interval_s=0.02)
+        c.put(0, "late", 5)
+        HPX_TEST(ev.wait(5.0))
+        HPX_TEST_EQ(sent, [["late"]])
+        c.close()
+
+    def test_per_destination_queues(self):
+        sent = []
+        c = plg.Coalescer(lambda d, b: sent.append((d, b)),
+                          max_count=2, interval_s=10.0)
+        c.put(1, "a", 1)
+        c.put(2, "x", 1)
+        c.put(1, "b", 1)
+        HPX_TEST_EQ(sent, [(1, ["a", "b"])])
+        c.flush(2)
+        HPX_TEST_EQ(sent[-1], (2, ["x"]))
+        c.close()
+
+
+def test_multiprocess_compressed_coalesced():
+    """The full parcel plane with zlib compression + coalescing on."""
+    from hpx_tpu.run import launch
+    env_extra = {
+        "HPX_TPU_PARCEL__COMPRESSION": "zlib",
+        "HPX_TPU_PARCEL__COALESCING": "1",
+    }
+    old = {k: os.environ.get(k) for k in env_extra}
+    os.environ.update(env_extra)
+    try:
+        rc = launch(os.path.join(REPO, "tests", "mp_scripts",
+                                 "dist_smoke.py"),
+                    [], localities=2, timeout=240.0)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rc == 0
+
+
+# -- counter printing wiring --------------------------------------------------
+
+def test_print_counter_at_finalize(capsys):
+    hpx.finalize()      # drop any runtime an earlier test left behind
+    hpx.init(overrides={"hpx.counters.print": "/runtime{*"})
+    hpx.finalize()
+    out = capsys.readouterr().out
+    assert "/runtime{locality#0/total}/uptime" in out
+
+
+# -- pipeline ----------------------------------------------------------------
+
+def _mlp_stage(w_key, din, dout):
+    w = jax.random.normal(jax.random.PRNGKey(w_key), (din, dout)) * 0.3
+
+    def fn(params, x):
+        return jnp.tanh(x @ params)
+    return fn, w
+
+
+class TestPipeline:
+    def test_forward_matches_sequential(self, devices):
+        s0, s1, s2 = (_mlp_stage(i, 8, 8) for i in range(3))
+        pipe = Pipeline([s0, s1, s2], devices=devices[:3])
+        mbs = [jnp.asarray(np.random.default_rng(i).random((4, 8),
+                                                           np.float32))
+               for i in range(5)]
+        got = pipe.forward(mbs)
+        for mb, y in zip(mbs, got):
+            want = mb
+            for fn, w in (s0, s1, s2):
+                want = fn(w, want)
+            np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                                       rtol=1e-5)
+
+    def test_stages_on_distinct_devices(self, devices):
+        pipe = Pipeline([_mlp_stage(0, 4, 4), _mlp_stage(1, 4, 4)],
+                        devices=devices[:2])
+        d0 = list(pipe.stages[0].params.devices())[0]
+        d1 = list(pipe.stages[1].params.devices())[0]
+        HPX_TEST(d0 != d1)
+
+    def test_train_step_matches_unpipelined(self, devices):
+        stages = [_mlp_stage(i, 6, 6) for i in range(2)]
+        pipe = Pipeline(stages, devices=devices[:2])
+        rng = np.random.default_rng(7)
+        mbs = [jnp.asarray(rng.random((3, 6), np.float32))
+               for _ in range(4)]
+        tgts = [jnp.asarray(rng.random((3, 6), np.float32))
+                for _ in range(4)]
+
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+
+        loss, grads = pipe.train_step(mbs, tgts, loss_fn)
+
+        # unpipelined oracle
+        def model(ws, x):
+            for (fn, _w), w in zip(stages, ws):
+                x = fn(w, x)
+            return x
+
+        def full_loss(ws):
+            return sum(loss_fn(model(ws, mb), t)
+                       for mb, t in zip(mbs, tgts)) / len(mbs)
+
+        ws = [w for _fn, w in stages]
+        want_loss, want_grads = jax.value_and_grad(full_loss)(ws)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=1e-5)
+        for g, wg in zip(grads, want_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(wg),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_apply_grads_learns(self, devices):
+        pipe = Pipeline([_mlp_stage(3, 4, 4), _mlp_stage(4, 4, 4)],
+                        devices=devices[:2])
+        rng = np.random.default_rng(0)
+        mbs = [jnp.asarray(rng.random((4, 4), np.float32))]
+        tgts = [jnp.asarray(rng.random((4, 4), np.float32))]
+
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+
+        l0, g = pipe.train_step(mbs, tgts, loss_fn)
+        for _ in range(20):
+            _l, g = pipe.train_step(mbs, tgts, loss_fn)
+            pipe.apply_grads(g, lr=0.5)
+        l1, _ = pipe.train_step(mbs, tgts, loss_fn)
+        HPX_TEST(float(l1) < float(l0) * 0.5, (float(l0), float(l1)))
