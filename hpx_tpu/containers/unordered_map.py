@@ -163,7 +163,12 @@ class UnorderedMap:
         try:
             return self.get_async(key).get()
         except HpxError as e:
-            raise KeyError(key) from e
+            # only the partition's key-not-found maps to KeyError; a
+            # timeout/network failure must NOT masquerade as a missing
+            # key (callers treat KeyError as "compute the default")
+            if e.code == Error.bad_parameter:
+                raise KeyError(key) from e
+            raise
 
     def contains_async(self, key: Any) -> Future:
         return self._part(key).call("contains", key)

@@ -124,6 +124,8 @@ def _cli_overlay(argv: Iterable[str]) -> Tuple[Dict[str, str], List[str]]:
             overrides[k.strip()] = v.strip()
         elif key == "dump-config":
             overrides["hpx.diagnostics.dump_config"] = "1"
+        elif key == "ignore-batch-env":
+            overrides["hpx.ignore_batch_env"] = "1"   # handled at init
         elif key == "print-counter":
             prev = overrides.get("hpx.counters.print", "")
             overrides["hpx.counters.print"] = (prev + "," + value) if prev else value
@@ -148,16 +150,24 @@ class Configuration:
                  environ: Optional[Mapping[str, str]] = None,
                  ini_files: Optional[Iterable[str]] = None):
         env = os.environ if environ is None else environ
+        if argv is not None:
+            argv = list(argv)     # may be a generator; we scan it twice
         self._lock = threading.Lock()
         self._data: Dict[str, str] = dict(DEFAULTS)
 
         # batch scheduler layer (above compiled defaults, below ini/env/
         # CLI): srun/mpirun/TPU-pod launches discover localities without
-        # flags, as the reference does (libs/core/batch_environments)
-        from ..runtime.batch_environments import detect as _batch_detect
-        batch = _batch_detect(env)
-        if batch.found():
-            self._data.update(batch.config_overrides())
+        # flags, as the reference does (libs/core/batch_environments).
+        # Opt out with --hpx:ignore-batch-env / HPX_TPU_IGNORE_BATCH_ENV
+        # (the reference's --hpx:ignore-batch-env).
+        ignore_batch = env.get("HPX_TPU_IGNORE_BATCH_ENV", "") not in ("", "0")
+        if argv is not None and "--hpx:ignore-batch-env" in argv:
+            ignore_batch = True
+        if not ignore_batch:
+            from ..runtime.batch_environments import detect as _batch_detect
+            batch = _batch_detect(env)
+            if batch.found():
+                self._data.update(batch.config_overrides())
 
         files = list(ini_files) if ini_files is not None else []
         if ini_files is None:

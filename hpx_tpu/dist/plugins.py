@@ -225,11 +225,16 @@ class Coalescer:
                 now = time.monotonic()
                 due = [d for d, t in self._deadline.items() if t <= now]
                 batches = [(d, self._take_locked(d)) for d in due]
-                if not self._deadline:
-                    self._cv.wait(0.05)
-                else:
-                    nxt = min(self._deadline.values())
-                    self._cv.wait(max(0.0, nxt - time.monotonic()))
+                if not batches:
+                    # nothing due: sleep until the next deadline (or a
+                    # put() notifies). Never wait while holding an
+                    # un-sent batch — that would add the whole wait to
+                    # every interval-triggered flush.
+                    if not self._deadline:
+                        self._cv.wait(0.05)
+                    else:
+                        nxt = min(self._deadline.values())
+                        self._cv.wait(max(0.0, nxt - time.monotonic()))
             for d, batch in batches:
                 if batch:
                     self._send(d, batch)

@@ -234,14 +234,17 @@ def then_on_device(fn: Callable[..., Any], executor: Any = None):
     """sndr | then_on_device(jit_fn): the TPU-native `then` — the
     continuation is compiled once (executor jit cache) and dispatched to
     the device; the value channel carries the resulting jax.Array."""
+    # one executor per adaptor (not per delivery): a fresh executor per
+    # set_value would start from an empty jit cache every run
+    if executor is None:
+        from .tpu import TpuExecutor
+        executor = TpuExecutor()
+
     def adapt(up: Sender) -> Sender:
         class Rx(_Passthrough):
             def set_value(self, *vals: Any) -> None:
-                ex = executor
-                if ex is None:
-                    from .tpu import TpuExecutor
-                    ex = TpuExecutor()
-                _deliver(self._rx, lambda: (ex.sync_execute(fn, *vals),))
+                _deliver(self._rx,
+                         lambda: (executor.sync_execute(fn, *vals),))
         return _AdaptorSender(up, Rx)
     return adapt
 
@@ -324,6 +327,9 @@ class _WhenAllSender(Sender):
 
     def connect(self, receiver: Any):
         n = len(self._senders)
+        if n == 0:
+            # empty when_all completes immediately (P2300 semantics)
+            return _FnOp(receiver.set_value)
         state = {"left": n, "vals": [None] * n, "done": False}
         lock = threading.Lock()
 

@@ -102,6 +102,7 @@ class NativePool:
         self._tasks_lock = threading.Lock()
         self._next_id = 0
         self._shut = False
+        self._shutdown_lock = threading.Lock()
         self._last_stats = {"executed": 0, "stolen": 0, "pending": 0,
                             "threads": self._n}
 
@@ -193,11 +194,17 @@ class NativePool:
             _t.Thread(target=self.shutdown, name="pool-reaper",
                       daemon=True).start()
             return
-        self.stats()              # snapshot final counters
-        self._shut = True
-        # workers registered in _worker_of must not help a dead pool
-        self._lib.hpxrt_pool_shutdown(self._handle)
-        self._handle = None
+        # the reaper hand-off means concurrent shutdown callers are
+        # expected (reaper + atexit/__del__): serialize the
+        # check-then-free so the native shutdown runs exactly once
+        with self._shutdown_lock:
+            if self._shut:
+                return
+            self.stats()          # snapshot final counters
+            self._shut = True
+            # workers in _worker_of must not help a dead pool
+            self._lib.hpxrt_pool_shutdown(self._handle)
+            self._handle = None
 
     def __del__(self) -> None:  # best-effort; explicit shutdown preferred
         try:

@@ -8,10 +8,11 @@ queues overlap stage s of microbatch m with stage s+1 of microbatch
 m-1 — the dataflow futures ARE the pipeline schedule, no bubbles
 beyond GPipe's fill/drain.
 
-Training: forward runs per-stage `jax.vjp`, residuals stay resident on
-the stage's device; backward walks stages in reverse per microbatch,
-accumulating stage-local param grads. Semantics verified equal to the
-unpipelined model (tests/test_pipeline.py).
+Training: GPipe-with-remat — forward keeps each stage's INPUT resident
+on the stage's device; backward walks stages in reverse per microbatch,
+rematerializing the stage forward inside a jitted vjp and accumulating
+stage-local param grads. Semantics verified equal to the unpipelined
+model (tests/test_plugins_pipeline.py).
 """
 
 from __future__ import annotations
@@ -36,11 +37,14 @@ class PipelineStage:
         # computation follows its operands: params live on `device`, so
         # the jitted stage runs there (no deprecated jit(device=...))
         self._fwd = jax.jit(fn)
-        # vjp-producing forward (training): returns y and residuals
-        def fwd_vjp(params, x):
-            y, pullback = jax.vjp(fn, params, x)
-            return y, pullback
-        self._fwd_vjp = fwd_vjp
+        # training backward: rematerialize the stage forward inside the
+        # vjp (GPipe-with-remat — keeps both passes fully jitted; a
+        # jitted fn can't RETURN a pullback closure, and an unjitted
+        # vjp forward would run op-by-op)
+        def bwd(params, x, cot):
+            _y, pullback = jax.vjp(fn, params, x)
+            return pullback(cot)
+        self._bwd = jax.jit(bwd)
 
     def to_device(self, x: Any) -> Any:
         return jax.device_put(x, self.device) if self.device is not None \
@@ -71,6 +75,17 @@ class Pipeline:
             devices = [devices[i % len(devices)] for i in range(n)]
         self.stages = [PipelineStage(fn, p, devices[i])
                        for i, (fn, p) in enumerate(stage_defs)]
+        self._loss_grad_cache: dict = {}
+
+    def _loss_grad(self, loss_fn: Callable) -> Callable:
+        """Jit value_and_grad(loss_fn) once per loss function — a fresh
+        wrapper per train_step call would retrace the hot path every
+        training iteration."""
+        lg = self._loss_grad_cache.get(loss_fn)
+        if lg is None:
+            lg = jax.jit(jax.value_and_grad(loss_fn))
+            self._loss_grad_cache[loss_fn] = lg
+        return lg
 
     @property
     def params(self) -> List[Any]:
@@ -96,19 +111,19 @@ class Pipeline:
         stage). Gradient == the unpipelined gradient of
         mean_mb(loss_fn(model(x), t))."""
         nmb = len(microbatches)
-        # forward: fill the pipeline
-        pullbacks: List[List[Any]] = [[] for _ in self.stages]
+        # forward: fill the pipeline, saving each stage's INPUT (the
+        # backward rematerializes the stage forward — GPipe-with-remat)
+        stage_inputs: List[List[Any]] = [[] for _ in self.stages]
         acts: List[Any] = []
         for mb in microbatches:
             x = mb
             for si, st in enumerate(self.stages):
-                x, pb = st._fwd_vjp(st.params, st.to_device(x))
-                pullbacks[si].append(pb)
+                x_in = st.to_device(x)
+                stage_inputs[si].append(x_in)
+                x = st._fwd(st.params, x_in)
             acts.append(x)
 
-        # loss + dLoss/dy per microbatch
-        loss_grad = jax.jit(jax.value_and_grad(
-            lambda y, t: loss_fn(y, t)))
+        loss_grad = self._loss_grad(loss_fn)
         losses = []
         grads: List[Any] = [None] * len(self.stages)
         for mi in range(nmb):
@@ -118,7 +133,8 @@ class Pipeline:
             # backward: drain stages in reverse
             for si in range(len(self.stages) - 1, -1, -1):
                 st = self.stages[si]
-                gparams, gx = pullbacks[si][mi](st.to_device(cot))
+                gparams, gx = st._bwd(st.params, stage_inputs[si][mi],
+                                      st.to_device(cot))
                 grads[si] = gparams if grads[si] is None else \
                     jax.tree.map(jnp.add, grads[si], gparams)
                 cot = gx

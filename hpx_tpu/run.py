@@ -70,17 +70,34 @@ def launch(script: str, script_args: List[str], localities: int,
     return rc
 
 
+def _split_argv(argv: List[str]):
+    """Launcher flags BEFORE the script path; everything from the
+    script on is the script's own (so a script's --timeout is never
+    swallowed — hpxrun convention)."""
+    takes_value = {"-l", "--localities", "-t", "--threads", "--timeout",
+                   "--platform"}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in takes_value:
+            i += 2
+        elif a.startswith("-") and "=" in a and \
+                a.split("=", 1)[0] in takes_value:
+            i += 1
+        else:
+            return argv[:i], argv[i], argv[i + 1:]
+    raise SystemExit("hpx_tpu.run: no script given")
+
+
 def main() -> None:
-    ap = argparse.ArgumentParser(prog="hpx_tpu.run")
+    ap = argparse.ArgumentParser(prog="hpx_tpu.run", allow_abbrev=False)
     ap.add_argument("-l", "--localities", type=int, default=2)
     ap.add_argument("-t", "--threads", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--platform", default="cpu")
-    ap.add_argument("script")
-    # parse_known_args (not REMAINDER): launcher flags work before OR
-    # after the script path; everything unrecognized passes through
-    ns, script_args = ap.parse_known_args()
-    sys.exit(launch(ns.script, script_args, ns.localities, ns.threads,
+    launcher_args, script, script_args = _split_argv(sys.argv[1:])
+    ns = ap.parse_args(launcher_args)
+    sys.exit(launch(script, script_args, ns.localities, ns.threads,
                     ns.platform, ns.timeout))
 
 

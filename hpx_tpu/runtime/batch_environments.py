@@ -30,12 +30,16 @@ class BatchEnvironment:
 
     def config_overrides(self) -> Dict[str, str]:
         out: Dict[str, str] = {}
-        if self.num_localities is not None:
+        # only configure a multi-locality launch when the scheduler told
+        # us BOTH the world size and OUR rank: inside a bare allocation
+        # (salloc without srun) ntasks is set but no per-task rank — a
+        # plain `python script.py` there must stay single-locality, not
+        # hang waiting for peers that were never launched
+        if self.num_localities is not None and self.this_locality is not None:
             out["hpx.localities"] = str(self.num_localities)
-        if self.this_locality is not None:
             out["hpx.locality"] = str(self.this_locality)
-        if self.node_list:
-            out["hpx.parcel.address"] = self.node_list[0]
+            if self.node_list:
+                out["hpx.parcel.address"] = self.node_list[0]
         return out
 
 
