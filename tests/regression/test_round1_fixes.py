@@ -1,0 +1,155 @@
+"""Regression tests — one per fixed bug, reference-style (SURVEY.md §4:
+'regression tests pin past bugs, esp. scheduler/future races; each is a
+minimal repro').
+
+Round-1 bugs, each with the commit theme that fixed it.
+"""
+
+import io
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+
+def test_uptime_counter_never_zero_on_first_query():
+    """uptime registered lazily at first (possibly remote) query used to
+    read 0.0 when register+read landed in the same clock quantum."""
+    from hpx_tpu.svc.performance_counters import ElapsedTimeCounter
+    c = ElapsedTimeCounter()
+    HPX_TEST(c.get_value().value > 0)
+
+
+def test_replay_executor_does_not_compile_its_loop():
+    """ReplayExecutor over TpuExecutor used to pass the replay LOOP into
+    jax.jit (callables as traced args -> TypeError on every call)."""
+    ex = hpx.ReplayExecutor(2, executor=hpx.TpuExecutor())
+    HPX_TEST_EQ(float(ex.async_execute(lambda x: x * 3,
+                                       jnp.float32(14)).get()), 42.0)
+
+
+def test_hpx_error_pickle_roundtrip():
+    """HpxError used default exception pickling: re-calling __init__
+    with the formatted string as the `code` arg -> ValueError on the
+    receiving locality."""
+    import pickle
+    from hpx_tpu.svc.resiliency import ReplayValidationError
+    for e in [hpx.HpxError(hpx.Error.deadlock, "msg"),
+              ReplayValidationError(3)]:
+        e2 = pickle.loads(pickle.dumps(e))
+        assert type(e2) is type(e) and e2.code == e.code
+        assert str(e2) == str(e)
+    # subclass attrs survive (used to be dropped by a narrow __reduce__)
+    assert pickle.loads(pickle.dumps(ReplayValidationError(5))).attempts == 5
+
+
+def test_freed_component_errors_not_loops():
+    """Invoking a freed component used to forward-chase forever when a
+    stale forward pointed back at a locality that also had a forward;
+    the hop TTL plus forward retraction must produce a clean error."""
+    @hpx.register_component_type
+    class Tiny(hpx.Component):
+        def ping(self):
+            return "pong"
+
+    c = hpx.new_sync(Tiny)
+    c.free().get()
+    t0 = time.monotonic()
+    with pytest.raises(hpx.HpxError):
+        c.sync("ping")
+    assert time.monotonic() - t0 < 10.0    # error, not a chase loop
+
+
+def test_unregistered_subclass_does_not_instantiate_base():
+    """new_(DerivedUnregistered) used to inherit the base's
+    _component_type_name and silently create the BASE class."""
+    @hpx.register_component_type
+    class Base(hpx.Component):
+        pass
+
+    class Derived(Base):
+        pass
+
+    with pytest.raises(hpx.HpxError):
+        hpx.new_(Derived)
+
+
+def test_migrate_failure_fails_the_future():
+    """migrate() used to drop the migration error and hand back a
+    Client as if it succeeded."""
+    @hpx.register_component_type
+    class M(hpx.Component):
+        pass
+
+    c = hpx.new_sync(M)
+    with pytest.raises(hpx.HpxError):
+        hpx.migrate(c, 99)     # no such locality
+    c.free().get()
+
+
+def test_iostreams_flush_waits_for_newline_writes():
+    """Newline-triggered flushes used to drop their futures, so an
+    explicit flush().get() returned without waiting for them."""
+    from hpx_tpu.svc.iostreams import _DistStream
+    s = _DistStream("cout")
+    s.println("line")          # auto-flush path (console: sync write)
+    HPX_TEST(s.flush().get(timeout=10.0) is True)
+
+
+def test_checkpoint_truncated_header_raises():
+    """A stream cut right after the magic used to yield an empty
+    Checkpoint instead of an error."""
+    cp = hpx.save_checkpoint("payload").get()
+    buf = io.BytesIO()
+    cp.write(buf)
+    with pytest.raises(ValueError):
+        hpx.Checkpoint.read(io.BytesIO(buf.getvalue()[:12]))
+
+
+def test_empty_when_all_sender_completes():
+    """sync_wait(when_all()) used to block forever."""
+    from hpx_tpu.exec import p2300 as ex
+    assert ex.sync_wait(ex.when_all(), timeout=5.0) is None
+
+
+def test_native_pool_shutdown_from_worker_does_not_abort():
+    """shutdown() from a pool's own worker used to pthread_join(self)
+    and abort the process."""
+    from hpx_tpu.native.loader import NativePool, native_lib
+    if native_lib() is None:
+        pytest.skip("native lib unavailable")
+    p = NativePool(1)
+    done = threading.Event()
+
+    def self_shutdown():
+        p.shutdown()           # runs ON the worker
+        done.set()
+
+    p.submit(self_shutdown)
+    assert done.wait(10.0)
+    for _ in range(200):       # reaper finishes asynchronously
+        if p._shut:
+            break
+        time.sleep(0.01)
+    assert p._shut
+
+
+def test_batch_env_without_rank_stays_single_locality():
+    """SLURM_NTASKS without SLURM_PROCID (bare salloc shell) used to
+    configure 4 localities and hang bootstrap."""
+    cfg = hpx.Configuration(environ={"SLURM_JOB_ID": "1",
+                                     "SLURM_NTASKS": "4"})
+    HPX_TEST_EQ(cfg.get_int("hpx.localities"), 1)
+
+
+def test_ignore_batch_env_flag():
+    cfg = hpx.Configuration(
+        argv=["--hpx:ignore-batch-env"],
+        environ={"SLURM_JOB_ID": "1", "SLURM_NTASKS": "4",
+                 "SLURM_PROCID": "2"})
+    HPX_TEST_EQ(cfg.get_int("hpx.localities"), 1)
+    HPX_TEST_EQ(cfg.get_int("hpx.locality"), 0)

@@ -36,6 +36,8 @@ def run_distributed(name, localities, timeout=240):
     ("hello_world_distributed.py", []),
     ("channel_demo.py", []),
     ("accumulator.py", []),
+    ("jacobi2d.py", ["64", "4", "6"]),
+    ("ring_attention_demo.py", ["128"]),
 ])
 def test_example_single(name, args):
     r = run_example(name, *args)
