@@ -1,1 +1,10 @@
 from .stencil import heat_step, multistep, pallas_multistep, xla_multistep  # noqa: F401
+from .attention import (  # noqa: F401
+    auto_attention,
+    blockwise_attention,
+    reference_attention,
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+)
+from .attention_pallas import flash_attention  # noqa: F401

@@ -1,0 +1,148 @@
+"""Pallas flash-attention kernel (single chip).
+
+The MXU-resident inner loop for ops/attention.py: Q/K/V stream through
+VMEM in (block_q × block_k) tiles over a sequential TPU grid; the
+online-softmax state (acc, m, l) lives in VMEM scratch and carries
+across the K dimension of the grid (TPU grids execute in order, so the
+innermost axis is the flash loop). Causal blocks below the diagonal are
+skipped entirely (`pl.when`), not just masked — ~2× fewer tiles.
+
+Layout: [B, S, N, H] public shape; kernel works on [B*N, S, H] with the
+(S, H) tiles as MXU operands (H = 64/128 hits the 128-lane layout).
+
+`flash_attention` falls back to interpret mode off-TPU so the same
+kernel is testable on the CPU mesh (pallas interpret semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30     # large-negative instead of -inf: exp() stays exact,
+                     # and (m_prev - m_new) never produces inf - inf
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  block_q: int, block_k: int, nk: int, causal: bool,
+                  scale: float, seq_k: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: the whole tile is masked iff its smallest k position
+    # exceeds the largest q position
+    if causal:
+        live = ik * block_k <= iq * block_q + block_q - 1
+    else:
+        live = True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # (block_q, H)
+        k = k_ref[0].astype(jnp.float32)           # (block_k, H)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (block_q, block_k)
+
+        # in-tile masks: sequence padding tail + causal diagonal
+        kpos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = kpos < seq_k
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, kpos <= qpos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)                # masked lanes: exact 0
+        l_ref[:] = jnp.broadcast_to(corr * l_prev + p.sum(
+            axis=1, keepdims=True), l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        den = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / den).astype(o_ref.dtype)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """[B, S, N, H] flash attention as one pallas_call per device.
+
+    S is padded to the block size internally; H should be a multiple of
+    the 128-lane layout's tile for best MXU utilization (64/128).
+    """
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    block_q = min(block_q, max(sq, 8))
+    block_k = min(block_k, max(sk, 8))
+    pq = -sq % block_q
+    pk = -sk % block_k
+
+    qt = jnp.moveaxis(q, 2, 1).reshape(b * n, sq, h)
+    kt = jnp.moveaxis(k, 2, 1).reshape(b * n, sk, h)
+    vt = jnp.moveaxis(v, 2, 1).reshape(b * n, sk, h)
+    if pq:
+        qt = jnp.pad(qt, ((0, 0), (0, pq), (0, 0)))
+    if pk:
+        kt = jnp.pad(kt, ((0, 0), (0, pk), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, pk), (0, 0)))
+    nq = qt.shape[1] // block_q
+    nk = kt.shape[1] // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, nk=nk,
+        causal=causal, scale=1.0 / math.sqrt(h), seq_k=sk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * n, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h), lambda bn, iq, ik: (bn, iq, 0)),
+            pl.BlockSpec((1, block_k, h), lambda bn, iq, ik: (bn, ik, 0)),
+            pl.BlockSpec((1, block_k, h), lambda bn, iq, ik: (bn, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, h),
+                               lambda bn, iq, ik: (bn, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n, nq * block_q, h), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, h), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    out = out[:, :sq].reshape(b, n, sq, h)
+    return jnp.moveaxis(out, 1, 2)
