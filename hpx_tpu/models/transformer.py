@@ -33,7 +33,8 @@ from jax.sharding import PartitionSpec as P
 from ..ops.attention import ring_attention_sharded
 
 __all__ = ["TransformerConfig", "init_params", "make_train_step",
-           "make_mesh_3d", "shard_params", "shard_batch", "sample_batch"]
+           "make_mesh_3d", "shard_params", "shard_batch", "sample_batch",
+           "make_opt_state", "generate"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -178,34 +179,156 @@ def _local_loss(params, tokens, targets, cfg: TransformerConfig,
 # the training step (one sharded XLA program)
 # ---------------------------------------------------------------------------
 
-def make_train_step(cfg: TransformerConfig, mesh):
-    """Returns jitted train_step(params, tokens, targets) ->
-    (params, loss). SGD built in (optimizer state = params only) to keep
-    the step self-contained; swap in optax by carrying its state the
-    same way."""
+def make_train_step(cfg: TransformerConfig, mesh, optimizer: Any = None):
+    """Returns a jitted train step over the (dp, sp, tp) mesh.
+
+    optimizer=None: plain SGD — step(params, tokens, targets) ->
+    (params, loss).
+
+    optimizer=<optax GradientTransformation>: step(params, opt_state,
+    tokens, targets) -> (params, opt_state, loss); the opt state is
+    sharded LIKE the params (tp-sharded moments for tp-sharded weights),
+    initialize it with `optimizer.init` on the sharded params OUTSIDE
+    the step (its sharding follows the params') — see
+    make_opt_state().
+    """
     sp_size = mesh.shape["sp"]
     pspecs = param_specs(cfg)
     data_spec = P("dp", "sp")
 
-    def step(params, tokens, targets):
-        def loss_fn(p):
-            s, n = _local_loss(p, tokens, targets, cfg, sp_size)
-            total = jax.lax.psum(s, ("dp", "sp"))
-            count = jax.lax.psum(jnp.float32(n), ("dp", "sp"))
-            return total / count
+    def loss_of(params, tokens, targets):
+        s, n = _local_loss(params, tokens, targets, cfg, sp_size)
+        total = jax.lax.psum(s, ("dp", "sp"))
+        count = jax.lax.psum(jnp.float32(n), ("dp", "sp"))
+        return total / count
 
-        # vma (varying-manual-axes) tracking is ON: jax's AD knows each
-        # param enters invariant (replicated) over the axes its spec
-        # omits, and automatically psums cotangents over exactly the
-        # axes they vary on — dp/sp data partials AND the Megatron tp
-        # mixed-replication case (residual replicated, attention/MLP
-        # partial) come out correctly reduced with no manual psums.
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+    # vma (varying-manual-axes) tracking is ON: jax's AD knows each
+    # param enters invariant (replicated) over the axes its spec omits,
+    # and automatically psums cotangents over exactly the axes they
+    # vary on — dp/sp data partials AND the Megatron tp mixed-
+    # replication case (residual replicated, attention/MLP partial)
+    # come out correctly reduced with no manual psums.
+    if optimizer is None:
+        def step(params, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_of)(
+                params, tokens, targets)
+            new_params = jax.tree.map(
+                lambda p, g: p - cfg.lr * g.astype(p.dtype),
+                params, grads)
+            return new_params, loss
+
+        return jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(pspecs, data_spec, data_spec),
+            out_specs=(pspecs, P())))
+
+    ospecs = _opt_state_specs(cfg, optimizer)
+
+    def step_opt(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_of)(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
         new_params = jax.tree.map(
-            lambda p, g: p - cfg.lr * g.astype(p.dtype), params, grads)
-        return new_params, loss
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        return new_params, opt_state, loss
 
     return jax.jit(shard_map(
-        step, mesh=mesh,
-        in_specs=(pspecs, data_spec, data_spec),
-        out_specs=(pspecs, P())))
+        step_opt, mesh=mesh,
+        in_specs=(pspecs, ospecs, data_spec, data_spec),
+        out_specs=(pspecs, ospecs, P())))
+
+
+def _opt_state_specs(cfg: TransformerConfig, optimizer: Any):
+    """PartitionSpecs for an optax state: param-shaped subtrees
+    (momentum/second moment) take the param's spec; scalar bookkeeping
+    (step counts) is replicated. optax.tree_map_params knows which
+    state leaves are param-like — shape matching would be ambiguous
+    (e.g. w1/w2 share a shape when d_model == d_ff but have transposed
+    tp specs)."""
+    import optax
+    params = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    state_shape = jax.eval_shape(lambda p: optimizer.init(p), params)
+    pspecs = param_specs(cfg)
+    return optax.tree_map_params(
+        optimizer, lambda _leaf, spec: spec, state_shape, pspecs,
+        transform_non_params=lambda _leaf: P())
+
+
+def _block_decode(x, lp, kv, write_at):
+    """One decoder block for a single new token position with a KV
+    cache. x: [B, 1, D]; kv: (k_cache, v_cache) each [B, Smax, N, H];
+    write_at: scalar index. Heads unsharded (single-device decode)."""
+    kc, vc = kv
+    h = _ln(x, lp["ln1"])
+    q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+    kc = jax.lax.dynamic_update_slice_in_dim(kc, k, write_at, axis=1)
+    vc = jax.lax.dynamic_update_slice_in_dim(vc, v, write_at, axis=1)
+    s = jnp.einsum("bqnh,bknh->bnqk", q, kc) / math.sqrt(q.shape[-1])
+    pos = jnp.arange(kc.shape[1])
+    s = jnp.where(pos[None, None, None, :] <= write_at, s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+    att = jnp.einsum("bnqk,bknh->bqnh", p, vc)
+    x = x + jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
+    h = _ln(x, lp["ln2"])
+    x = x + jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"]
+    return x, (kc, vc)
+
+
+def generate(params, cfg: TransformerConfig, prompt: jax.Array,
+             max_new: int = 32) -> jax.Array:
+    """Greedy decode (single device): prefill the prompt token-by-token
+    into KV caches, then emit max_new argmax tokens. Static shapes
+    throughout (lax.scan over cache positions) — one compile per
+    (prompt_len, max_new)."""
+    b, plen = prompt.shape
+    smax = plen + max_new
+    nh, hd, d = cfg.n_heads, cfg.head_dim, cfg.d_model
+
+    def fresh_cache():
+        return [(jnp.zeros((b, smax, nh, hd), cfg.dtype),
+                 jnp.zeros((b, smax, nh, hd), cfg.dtype))
+                for _ in range(cfg.n_layers)]
+
+    def step_token(carry, inp):
+        caches, _prev = carry
+        tok, pos = inp
+        x = params["emb"][tok][:, None, :]            # [B, 1, D]
+        new_caches = []
+        for lp, kv in zip(params["layers"], caches):
+            x, kv = _block_decode(x, lp, kv, pos)
+            new_caches.append(kv)
+        x = _ln(x, params["ln_f"])
+        logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1)
+        return (new_caches, nxt), nxt
+
+    @jax.jit
+    def run(prompt):
+        caches = fresh_cache()
+        carry = (caches, prompt[:, 0])
+        # prefill: feed prompt tokens at positions 0..plen-1
+        carry, _ = jax.lax.scan(
+            step_token, carry,
+            (prompt.T, jnp.arange(plen)))
+        # decode: feed back the argmax token
+        def gen(carry, pos):
+            caches, tok = carry
+            (caches, nxt), _ = step_token((caches, tok), (tok, pos))
+            return (caches, nxt), nxt
+
+        _carry, toks = jax.lax.scan(
+            gen, carry, jnp.arange(plen, smax))
+        return toks.T                                  # [B, max_new]
+
+    return run(prompt)
+
+
+def make_opt_state(params, cfg: TransformerConfig, mesh, optimizer: Any):
+    """optimizer.init under jit with sharded outputs matching
+    _opt_state_specs (so moments are tp-sharded like their weights)."""
+    from jax.sharding import NamedSharding
+    ospecs = _opt_state_specs(cfg, optimizer)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), ospecs,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(optimizer.init, out_shardings=shardings)(params)
