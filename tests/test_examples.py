@@ -48,6 +48,7 @@ def test_example_single(name, args):
     ("hello_world_distributed.py", 2),
     ("channel_demo.py", 2),
     ("accumulator.py", 2),
+    ("1d_stencil_distributed.py", 3),
 ])
 def test_example_distributed(name, localities):
     r = run_distributed(name, localities)
