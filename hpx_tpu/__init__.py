@@ -99,6 +99,7 @@ from .algo import (  # noqa: F401
     inclusive_scan, exclusive_scan, transform_inclusive_scan,
     transform_exclusive_scan, adjacent_difference, adjacent_find,
     sort, stable_sort, is_sorted, merge, reverse, rotate, unique, partition,
+    induction, reduction,
 )
 
 # -- distributed runtime: localities, actions, AGAS (M5) ---------------------

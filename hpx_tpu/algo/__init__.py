@@ -63,7 +63,14 @@ rotate = _seg(_so.rotate, preserves_shape=True)
 unique = _seg(_so.unique)
 partition = _seg(_so.partition)
 
+# for_loop clause objects (hpx::experimental::induction/reduction)
+induction = _ew.induction
+reduction = _ew.reduction
+Induction = _ew.Induction
+Reduction = _ew.Reduction
+
 __all__ = [
+    "induction", "reduction", "Induction", "Reduction",
     "for_each", "for_each_n", "for_loop", "transform", "copy", "copy_n",
     "copy_if", "fill", "fill_n", "generate", "generate_n",
     "reduce", "transform_reduce", "count", "count_if",

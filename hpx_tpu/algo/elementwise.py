@@ -202,13 +202,110 @@ def generate_n(policy: ExecutionPolicy, rng: Any, n: int, gen: Callable) -> Any:
     return generate(policy, rng[:n], gen)
 
 
+class Induction:
+    """hpx::experimental::induction(x0, stride): the body receives the
+    induction value x0 + stride*(i - first) alongside i."""
+
+    __slots__ = ("x0", "stride")
+
+    def __init__(self, x0: Any, stride: Any = 1) -> None:
+        self.x0 = x0
+        self.stride = stride
+
+
+class Reduction:
+    """hpx::experimental::reduction(identity, op) — functional twist:
+    instead of mutating a reduction variable, the body RETURNS its
+    per-iteration contribution (a tuple when several reductions are
+    declared); for_loop returns the combined value(s). op must be
+    associative (it runs as a tree reduction on the device path)."""
+
+    __slots__ = ("identity", "op")
+
+    def __init__(self, identity: Any, op: Callable[[Any, Any], Any]) -> None:
+        self.identity = identity
+        self.op = op
+
+
+def induction(x0: Any, stride: Any = 1) -> Induction:
+    return Induction(x0, stride)
+
+
+def reduction(identity: Any, op: Callable[[Any, Any], Any]) -> Reduction:
+    return Reduction(identity, op)
+
+
+def _for_loop_clauses(policy: ExecutionPolicy, first: int, last: int,
+                      body: Callable, inds, reds) -> Any:
+    """for_loop with induction/reduction clauses.
+
+    body(i, *induction_values) -> reduction contribution(s).
+    """
+    count = max(0, last - first)
+    if count == 0:
+        vals = tuple(r.identity for r in reds)
+        return vals[0] if len(vals) == 1 else vals
+
+    if is_device_policy(policy):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        idx = jnp.arange(first, last)
+
+        def kernel(ix):
+            ind_vals = [i.x0 + i.stride * (ix - first) for i in inds]
+            return jax.vmap(lambda j, *iv: body(j, *iv))(ix, *[
+                jnp.asarray(v) for v in ind_vals])
+
+        def run(ix):
+            out = kernel(ix)
+            if not reds:
+                return out
+            parts = out if isinstance(out, (tuple, list)) else (out,)
+            combined = []
+            for r, part in zip(reds, parts):
+                acc = jnp.asarray(r.identity)
+                combined.append(jax.lax.reduce(
+                    part, acc, lambda a, b: r.op(a, b), (0,)))
+            return combined[0] if len(combined) == 1 else tuple(combined)
+
+        fut = ex.async_execute(run, idx)
+        return fut if policy.is_task else fut.get()
+
+    accs = [r.identity for r in reds]
+    for i in range(first, last):
+        ind_vals = [c.x0 + c.stride * (i - first) for c in inds]
+        out = body(i, *ind_vals)
+        if reds:
+            parts = out if isinstance(out, (tuple, list)) else (out,)
+            for j, r in enumerate(reds):
+                accs[j] = r.op(accs[j], parts[j])
+    if not reds:
+        return None
+    return accs[0] if len(accs) == 1 else tuple(accs)
+
+
 def for_loop(policy: ExecutionPolicy, first: int, last: int,
-             body: Callable[[int], Any]) -> Any:
-    """hpx::experimental::for_loop(policy, first, last, body) — an indexed
-    loop. Contract on BOTH paths: returns the array/list of body(i)
-    results (the device path is pure, so results are its only output; the
-    host path collects for parity — returns None only if every body call
-    returned None, i.e. a pure side-effect loop)."""
+             body: Callable[[int], Any], *clauses: Any) -> Any:
+    """hpx::experimental::for_loop(policy, first, last, body[, clauses]).
+
+    Without clauses: an indexed loop; returns the array/list of body(i)
+    results (the device path is pure, so results are its only output;
+    the host path collects for parity — returns None only if every body
+    call returned None, i.e. a pure side-effect loop).
+
+    With induction/reduction clauses (see those classes): body receives
+    induction values and returns reduction contributions.
+    """
+    if clauses:
+        inds = [c for c in clauses if isinstance(c, Induction)]
+        reds = [c for c in clauses if isinstance(c, Reduction)]
+        bad = [c for c in clauses
+               if not isinstance(c, (Induction, Reduction))]
+        if bad:
+            from ..core.errors import BadParameter
+            raise BadParameter(f"unknown for_loop clause: {bad[0]!r}")
+        return _for_loop_clauses(policy, first, last, body, inds, reds)
     count = max(0, last - first)
     if is_device_policy(policy):
         import jax

@@ -321,3 +321,55 @@ def test_host_scan_widens_dtype():
 def test_exclusive_scan_empty_device():
     out = hpx.exclusive_scan(device_policy(), jnp.array([], dtype=jnp.float32))
     assert asnp(out).shape == (0,)
+
+
+# -- for_loop induction/reduction clauses -------------------------------------
+
+def test_for_loop_reduction_host():
+    import operator
+    total = hpx.for_loop(hpx.par, 0, 100, lambda i: i,
+                         hpx.reduction(0, operator.add))
+    assert total == sum(range(100))
+
+
+def test_for_loop_reduction_device():
+    import operator
+    total = hpx.for_loop(device_policy(), 0, 100,
+                         lambda i: (i * i).astype(jnp.float32),
+                         hpx.reduction(jnp.float32(0), operator.add))
+    assert float(unwrap(total)) == sum(i * i for i in range(100))
+
+
+def test_for_loop_induction_both_paths():
+    import operator
+    # sum of (10 + 2*j) for j in 0..9, via the induction clause
+    want = sum(10 + 2 * j for j in range(10))
+    got_h = hpx.for_loop(hpx.par, 5, 15, lambda i, x: x,
+                         hpx.induction(10, 2),
+                         hpx.reduction(0, operator.add))
+    assert got_h == want
+    got_d = hpx.for_loop(device_policy(), 5, 15,
+                         lambda i, x: x.astype(jnp.float32),
+                         hpx.induction(10, 2),
+                         hpx.reduction(jnp.float32(0), operator.add))
+    assert float(unwrap(got_d)) == want
+
+
+def test_for_loop_multiple_reductions():
+    import operator
+    s, p = hpx.for_loop(hpx.par, 1, 6, lambda i: (i, i),
+                        hpx.reduction(0, operator.add),
+                        hpx.reduction(1, operator.mul))
+    assert (s, p) == (15, 120)
+
+
+def test_for_loop_empty_range_returns_identity():
+    import operator
+    assert hpx.for_loop(hpx.par, 3, 3, lambda i: i,
+                        hpx.reduction(7, operator.add)) == 7
+
+
+def test_for_loop_bad_clause_raises():
+    import pytest as _pt
+    with _pt.raises(hpx.HpxError):
+        hpx.for_loop(hpx.par, 0, 3, lambda i: i, "not-a-clause")
