@@ -1,0 +1,63 @@
+"""Guard the driver entry points (__graft_entry__.py).
+
+Round-1 regression: the driver ran ``dryrun_multichip(8)`` in an
+environment with ONE visible device and the entry point died instead of
+provisioning the virtual CPU mesh itself (MULTICHIP_r01.json ok:false).
+These tests run the entry points the way the driver does — a fresh
+subprocess whose environment does NOT pre-provision the mesh — so the
+self-provisioning re-exec path is exercised end to end.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _driver_like_env():
+    """Env resembling the driver's: no virtual-mesh XLA flag."""
+    sys.path.insert(0, REPO)
+    from __graft_entry__ import _strip_device_count_flag
+    env = dict(os.environ)
+    env.pop("_HPX_TPU_DRYRUN_CHILD", None)
+    flags = _strip_device_count_flag(env.get("XLA_FLAGS", ""))
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _run(code, timeout=900):
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=_driver_like_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=timeout)
+
+
+def test_entry_compiles_and_runs():
+    proc = _run(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from __graft_entry__ import entry\n"
+        "fn, args = entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "print('ENTRY_OK', out.shape)\n")
+    assert proc.returncode == 0, proc.stdout
+    assert "ENTRY_OK" in proc.stdout, proc.stdout
+
+
+@pytest.mark.parametrize("n", [2, 8])
+def test_dryrun_multichip_self_provisions(n):
+    # The child process sees 1 CPU device (no forced device count), so
+    # dryrun_multichip MUST re-exec itself with a provisioned mesh.
+    proc = _run(
+        "from __graft_entry__ import dryrun_multichip\n"
+        f"dryrun_multichip({n})\n")
+    assert proc.returncode == 0, proc.stdout
+    assert f"dryrun_multichip({n}): ok" in proc.stdout, proc.stdout
+    assert "transformer train step" in proc.stdout, proc.stdout
