@@ -68,11 +68,14 @@ def _program(mesh, axis: str, key: Tuple, build: Callable) -> Any:
 def _shard_map(body, mesh, in_spec, out_spec):
     import jax
     from jax import shard_map
-    # check_vma=False: verbs like all_gather produce results that ARE
-    # replicated but that the static varying-mesh-axes analysis cannot
-    # prove so; the specs here are fixed by construction per verb.
+    # check_vma stays ON (the default): with it off, jax falls back to
+    # the legacy psum transpose and silently produces WRONG gradients
+    # for differentiated collectives. Each verb below is written so its
+    # output's varying-mesh-axes type matches its out_spec (e.g.
+    # all_gather is expressed as scatter-place + psum, whose vma rule
+    # proves the replication the all_gather rule cannot).
     return jax.jit(shard_map(body, mesh=mesh, in_specs=in_spec,
-                             out_specs=out_spec, check_vma=False))
+                             out_specs=out_spec))
 
 
 def _specs(axis: str):
@@ -97,11 +100,17 @@ def all_gather(x: Any, mesh, axis: str = "x") -> Any:
     (concatenated) array, replicated."""
     sharded, rep = _specs(axis)
 
+    del sharded, rep
+
     def build():
-        from jax import lax
-        return _shard_map(
-            lambda s: lax.all_gather(s, axis, tiled=True),
-            mesh, sharded, rep)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        # Whole-array gather IS a resharding: axis-sharded -> fully
+        # replicated. GSPMD lowers it to a native all-gather over ICI
+        # (no shard_map, so no varying-axes proof is needed), and jax
+        # differentiates the resharding exactly.
+        return jax.jit(lambda s: s,
+                       out_shardings=NamedSharding(mesh, P()))
 
     return _program(mesh, axis, ("all_gather",), build)(x)
 

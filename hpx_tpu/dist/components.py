@@ -135,13 +135,15 @@ class Component:
 # ---------------------------------------------------------------------------
 
 class _Entry:
-    __slots__ = ("inst", "pins", "cv", "migrating", "ever_migrated")
+    __slots__ = ("inst", "pins", "cv", "migrating", "ever_migrated",
+                 "freed")
 
     def __init__(self, inst: Any, ever_migrated: bool = False) -> None:
         self.inst = inst
         self.pins = 0
         self.cv = threading.Condition()
         self.migrating = False
+        self.freed = False      # set by _free once the pop is ours
         # True iff this instance arrived via migration: its gid may have
         # forwards/KV entries scattered on other localities that free()
         # must retract
@@ -258,13 +260,41 @@ def _clear_forward(gid: IdType) -> bool:
 def _free(gid: IdType, _hops: int = 0) -> bool:
     key = gid.key()
     with _inst_lock:
-        entry = _instances.pop(key, None)
-        _forwards.pop(key, None)
+        entry = _instances.get(key)
     if entry is None:
         cur = _current_locality(gid)
         if cur != find_here() and _hops < _MAX_HOPS:
             return async_action(_free, cur, gid, _hops=_hops + 1)
+        with _inst_lock:
+            _forwards.pop(key, None)
         return False
+    # Mirror _migrate's protocol: an in-flight migration owns the entry
+    # (wait for it, then chase the forward it recorded), and pinned
+    # invocations must drain before the object dies under them.
+    with entry.cv:
+        if entry.migrating:
+            if not entry.cv.wait_for(lambda: not entry.migrating,
+                                     timeout=30.0):
+                raise HpxError(Error.invalid_status,
+                               f"free raced a stuck migration: {gid}")
+            if entry.freed:
+                return False    # a concurrent free won the pop
+        else:
+            entry.migrating = True      # block new pins while freeing
+            if not entry.cv.wait_for(lambda: entry.pins == 0,
+                                     timeout=30.0):
+                entry.migrating = False
+                entry.cv.notify_all()
+                raise HpxError(Error.invalid_status,
+                               f"component stayed pinned: {gid}")
+            with _inst_lock:
+                _instances.pop(key, None)
+                _forwards.pop(key, None)
+            entry.freed = True
+    if not entry.freed:
+        # a migration finished (entry popped + forward recorded) or
+        # aborted (instance still resident) — re-resolve from scratch
+        return _free(gid, _hops=_hops + 1)
     if get_num_localities() > 1 and entry.ever_migrated:
         # a migrated gid: retract the published location BEFORE replying
         # and clear stale forwards on ALL other localities — any stale
@@ -282,6 +312,7 @@ def _free(gid: IdType, _hops: int = 0) -> bool:
             if loc != here:
                 post_action(_clear_forward, loc, gid)
     with entry.cv:
+        entry.migrating = False
         entry.cv.notify_all()   # wake any _pin waiters; they'll see gone
     return True
 

@@ -29,7 +29,7 @@ from .actions import Action, resolve_action
 from .serialization import deserialize, serialize
 
 # message tags
-_HELLO = "hello"      # (tag, locality, listen_port)
+_HELLO = "hello"      # (tag, locality, reachable_host, listen_port)
 _TABLE = "table"      # (tag, {locality: (host, port)})
 _IDENT = "ident"      # (tag, locality)
 _PARCEL = "parcel"    # (tag, action_name, args, kwargs, req_id, src_loc)
@@ -82,20 +82,38 @@ class Runtime:
             self._bootstrap()
 
     # -- bootstrap ----------------------------------------------------------
+    def _reachable_host(self, root_host: str, root_port: int) -> str:
+        """The address peers can dial us on: the local interface used to
+        reach the console (UDP-connect trick; no packet is sent)."""
+        import socket
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((root_host, root_port or 1))
+                return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+
     def _bootstrap(self) -> None:
         from ..native.loader import NetEndpoint
 
         root_host = self.cfg.get("hpx.parcel.address", "127.0.0.1")
         root_port = self.cfg.get_int("hpx.parcel.port", 7910)
+        # Multi-node launches (console address not loopback) must accept
+        # connections from other hosts; single-node stays on loopback.
+        bind_any = self.cfg.get_bool(
+            "hpx.parcel.bind_any",
+            root_host not in ("127.0.0.1", "localhost"))
 
         if self.locality == 0:
-            self._endpoint = NetEndpoint(root_port, self._on_message)
+            self._endpoint = NetEndpoint(root_port, self._on_message,
+                                         bind_any=bind_any)
             with self._boot_lock:
                 self._hellos[0] = (root_host, self._endpoint.port)
             # workers may all have said hello before our own entry landed
             self._maybe_broadcast_table()
         else:
-            self._endpoint = NetEndpoint(0, self._on_message)
+            self._endpoint = NetEndpoint(0, self._on_message,
+                                         bind_any=bind_any)
             # dial the console; retry while it boots
             deadline = time.monotonic() + self.cfg.get_float(
                 "hpx.startup_timeout", 30.0)
@@ -109,7 +127,9 @@ class Runtime:
                             f"cannot reach console at {root_host}:{root_port}")
                     time.sleep(0.05)
             self._add_route(0, pid)
-            self._send_raw(pid, (_HELLO, self.locality,
+            my_host = (self._reachable_host(root_host, root_port)
+                       if bind_any else "127.0.0.1")
+            self._send_raw(pid, (_HELLO, self.locality, my_host,
                                  self._endpoint.port))
 
         if not self._table_ready.wait(self.cfg.get_float(
@@ -192,10 +212,10 @@ class Runtime:
                 else:
                     st.set_exception(payload)
         elif tag == _HELLO:
-            _tag, loc, port = msg
+            _tag, loc, host, port = msg
             self._add_route(loc, peer_id)
             with self._boot_lock:
-                self._hellos[loc] = ("127.0.0.1", port)
+                self._hellos[loc] = (host, port)
             self._maybe_broadcast_table()
         elif tag == _TABLE:
             self._table = msg[1]

@@ -310,11 +310,15 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         carry, _ = jax.lax.scan(
             step_token, carry,
             (prompt.T, jnp.arange(plen)))
-        # decode: feed back the argmax token
+        # decode: feed back the argmax token. After prefill the carry
+        # already holds t0 (the prediction following the last prompt
+        # token), so each step emits the token it FEEDS — emitting the
+        # step's own prediction instead would drop t0 and shift the
+        # whole output by one.
         def gen(carry, pos):
             caches, tok = carry
             (caches, nxt), _ = step_token((caches, tok), (tok, pos))
-            return (caches, nxt), nxt
+            return (caches, nxt), tok
 
         _carry, toks = jax.lax.scan(
             gen, carry, jnp.arange(plen, smax))

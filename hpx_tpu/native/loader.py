@@ -42,7 +42,10 @@ def native_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
-        if not os.path.exists(_SO) and not _build():
+        # Always invoke make (it is incremental): a stale prebuilt .so —
+        # the .so is gitignored, sources are not — would otherwise be
+        # loaded and fail symbol binding after a source update.
+        if not _build() and not os.path.exists(_SO):
             return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -224,6 +227,8 @@ def _bind_net(lib: ctypes.CDLL) -> None:
         return
     lib.hpxrt_net_create.restype = ctypes.c_void_p
     lib.hpxrt_net_create.argtypes = [ctypes.c_uint16]
+    lib.hpxrt_net_create2.restype = ctypes.c_void_p
+    lib.hpxrt_net_create2.argtypes = [ctypes.c_uint16, ctypes.c_int]
     lib.hpxrt_net_port.restype = ctypes.c_uint16
     lib.hpxrt_net_port.argtypes = [ctypes.c_void_p]
     lib.hpxrt_net_set_callback.argtypes = [ctypes.c_void_p, _NET_CB,
@@ -247,15 +252,17 @@ class NetEndpoint:
     """
 
     def __init__(self, port: int = 0,
-                 on_message: Optional[Callable[[int, bytes], None]] = None):
+                 on_message: Optional[Callable[[int, bytes], None]] = None,
+                 bind_any: bool = False):
         lib = native_lib()
         if lib is None:
             raise RuntimeError("native runtime library unavailable")
         _bind_net(lib)
         self._lib = lib
-        self._h = lib.hpxrt_net_create(port)
+        self._h = lib.hpxrt_net_create2(port, 1 if bind_any else 0)
         if not self._h:
-            raise OSError(f"cannot listen on 127.0.0.1:{port}")
+            host = "0.0.0.0" if bind_any else "127.0.0.1"
+            raise OSError(f"cannot listen on {host}:{port}")
         self.on_message = on_message
 
         def _cb(_user, peer_id, data, length):
@@ -278,6 +285,14 @@ class NetEndpoint:
     def connect(self, host: str, port: int) -> int:
         if self._closed:
             raise OSError("endpoint closed")
+        # the native path takes IPv4 literals only (inet_pton); resolve
+        # DNS names (multi-node: hpx.parcel.address=nodename) here
+        import socket
+        try:
+            socket.inet_pton(socket.AF_INET, host)
+        except OSError:
+            host = socket.getaddrinfo(
+                host, port, socket.AF_INET, socket.SOCK_STREAM)[0][4][0]
         pid = self._lib.hpxrt_net_connect(self._h, host.encode(), port)
         if pid < 0:
             raise OSError(f"connect to {host}:{port} failed")

@@ -160,14 +160,14 @@ struct Net {
   }
 };
 
-int make_listener(uint16_t* port) {
+int make_listener(uint16_t* port, int bind_any) {
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_addr.s_addr = htonl(bind_any ? INADDR_ANY : INADDR_LOOPBACK);
   addr.sin_port = htons(*port);
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       listen(fd, 64) < 0) {
@@ -184,12 +184,12 @@ int make_listener(uint16_t* port) {
 
 extern "C" {
 
-// Create endpoint listening on 127.0.0.1:port (0 = ephemeral). Returns
-// handle or null.
-void* hpxrt_net_create(uint16_t port) {
+// Create endpoint listening on port (0 = ephemeral); bind_any selects
+// 0.0.0.0 (multi-node) vs 127.0.0.1 (default). Returns handle or null.
+void* hpxrt_net_create2(uint16_t port, int bind_any) {
   auto* net = new Net();
   net->port = port;
-  net->listen_fd = make_listener(&net->port);
+  net->listen_fd = make_listener(&net->port, bind_any);
   if (net->listen_fd < 0) {
     delete net;
     return nullptr;
@@ -206,6 +206,8 @@ void* hpxrt_net_create(uint16_t port) {
   epoll_ctl(net->epoll_fd, EPOLL_CTL_ADD, net->wake_fd, &wev);
   return net;
 }
+
+void* hpxrt_net_create(uint16_t port) { return hpxrt_net_create2(port, 0); }
 
 uint16_t hpxrt_net_port(void* h) { return static_cast<Net*>(h)->port; }
 

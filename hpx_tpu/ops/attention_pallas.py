@@ -33,9 +33,14 @@ _NEG_INF = -1e30     # large-negative instead of -inf: exp() stays exact,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                   block_q: int, block_k: int, nk: int, causal: bool,
-                  scale: float, seq_k: int):
+                  scale: float, seq_q: int, seq_k: int):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
+
+    # bottom-right causal alignment (matches reference_attention /
+    # blockwise_attention): query qi attends keys kj <= qi + (sk - sq),
+    # so a cross-attention suffix lines up with the END of the keys.
+    off = seq_k - seq_q
 
     @pl.when(ik == 0)
     def _init():
@@ -44,9 +49,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     # causal: the whole tile is masked iff its smallest k position
-    # exceeds the largest q position
+    # exceeds the largest (offset-adjusted) q position
     if causal:
-        live = ik * block_k <= iq * block_q + block_q - 1
+        live = ik * block_k <= iq * block_q + block_q - 1 + off
     else:
         live = True
 
@@ -66,7 +71,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         if causal:
             qpos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            mask = jnp.logical_and(mask, kpos <= qpos)
+            mask = jnp.logical_and(mask, kpos <= qpos + off)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                      # (block_q, 1)
@@ -121,7 +126,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, nk=nk,
-        causal=causal, scale=1.0 / math.sqrt(h), seq_k=sk)
+        causal=causal, scale=1.0 / math.sqrt(h), seq_q=sq, seq_k=sk)
 
     out = pl.pallas_call(
         kernel,

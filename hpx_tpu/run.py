@@ -79,11 +79,20 @@ def _split_argv(argv: List[str]):
     i = 0
     while i < len(argv):
         a = argv[i]
+        if a in ("-h", "--help"):
+            return argv[: i + 1], None, []
         if a in takes_value:
             i += 2
         elif a.startswith("-") and "=" in a and \
                 a.split("=", 1)[0] in takes_value:
             i += 1
+        elif a.startswith("-"):
+            # an unknown flag is a launcher usage error, not a script:
+            # silently Popen-ing "--localites" would hang N children
+            raise SystemExit(
+                f"hpx_tpu.run: unknown launcher flag {a!r} "
+                "(launcher flags go before the script path; "
+                "see --help)")
         else:
             return argv[:i], argv[i], argv[i + 1:]
     raise SystemExit("hpx_tpu.run: no script given")
@@ -96,6 +105,9 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--platform", default="cpu")
     launcher_args, script, script_args = _split_argv(sys.argv[1:])
+    if script is None:          # -h/--help: print usage and exit
+        ap.parse_args(launcher_args)
+        return
     ns = ap.parse_args(launcher_args)
     sys.exit(launch(script, script_args, ns.localities, ns.threads,
                     ns.platform, ns.timeout))

@@ -77,6 +77,27 @@ class TestPallasFlash:
         got = flash_attention(q, k, v, True, block_q=16, block_k=16)
         _close(got, want, jnp.float32)
 
+    @pytest.mark.parametrize("sq,sk", [(16, 48), (48, 16), (37, 53)])
+    def test_causal_cross_lengths(self, sq, sk):
+        """causal with sq != sk must use bottom-right alignment
+        (kj <= qi + (sk - sq)), matching reference/blockwise — the
+        round-1 kernel used top-left and diverged. For sq > sk the
+        leading rows see no keys; flash and blockwise both define those
+        rows as 0 (reference's full softmax NaNs there), so that case
+        compares flash against blockwise."""
+        from hpx_tpu.ops.attention_pallas import flash_attention
+        rng = np.random.default_rng(11)
+        q = jnp.asarray(rng.standard_normal((B, sq, N, H), np.float32))
+        k = jnp.asarray(rng.standard_normal((B, sk, N, H), np.float32))
+        v = jnp.asarray(rng.standard_normal((B, sk, N, H), np.float32))
+        want = (reference_attention(q, k, v, True) if sq <= sk else
+                blockwise_attention(q, k, v, True, block_k=16))
+        got = flash_attention(q, k, v, True, block_q=16, block_k=16)
+        _close(got, want, jnp.float32)
+        if sq <= sk:
+            _close(blockwise_attention(q, k, v, True, block_k=16), want,
+                   jnp.float32)
+
     def test_front_door_dispatch(self):
         from hpx_tpu.ops.attention import auto_attention
         q, k, v = _qkv(seed=10)

@@ -150,6 +150,23 @@ class TestLocal:
         b.free().get()
 
 
+    def test_free_waits_for_pins(self):
+        # round-1 advisor finding: _free popped the instance without
+        # draining pins, so a running invocation kept using a freed
+        # component. free() must block until the method returns.
+        b = hpx.new_sync(SlowBox)
+        f = b.call("hold")
+        inst = comp._instances[b.gid.key()].inst
+        HPX_TEST(inst.entered.wait(5.0))
+        ff = b.free()                       # must NOT complete yet
+        threading.Event().wait(0.1)
+        HPX_TEST(not ff.is_ready())
+        inst.ev.set()
+        HPX_TEST(f.get() is True)           # invocation saw a live object
+        HPX_TEST(ff.get(timeout=10.0) is True)
+        HPX_TEST(b.gid.key() not in comp._instances)
+
+
 class TestBasenames:
     def test_register_find_roundtrip(self):
         c = hpx.new_sync(Counter, None, 11)

@@ -133,6 +133,59 @@ def test_generate_consistent_with_forward():
     assert int(out[0, 0]) == want
 
 
+def test_generate_matches_full_forward_oracle():
+    """Greedy decode must equal token-by-token decoding with the full
+    (uncached) forward pass, on a TRAINED model whose argmax varies by
+    position. An untrained model's argmax is effectively constant, which
+    masked a round-1 off-by-one (generate() emitted the step's own
+    prediction, dropping the first generated token)."""
+    mesh1 = tfm.make_mesh_3d(1)
+    params = tfm.shard_params(tfm.init_params(CFG, jax.random.PRNGKey(8)),
+                              CFG, mesh1)
+    step = tfm.make_train_step(CFG, mesh1)
+    toks, tgts = tfm.sample_batch(CFG, batch=4, seq=16,
+                                  key=jax.random.PRNGKey(9))
+    toks, tgts = tfm.shard_batch(toks, tgts, mesh1)
+    for _ in range(30):
+        params, _ = step(params, toks, tgts)
+
+    prompt = jnp.array([[3, 1, 4, 1], [2, 7, 1, 8]], dtype=jnp.int32)
+    max_new = 6
+    out = tfm.generate(params, CFG, prompt, max_new=max_new)
+
+    # oracle: grow the sequence one token at a time through the full
+    # forward pass (same shard_map-on-mesh1 path the other tests use)
+    from hpx_tpu.models.transformer import _ln, _block
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def fwd(p, toks):
+        x = p["emb"][toks]
+        for lp in p["layers"]:
+            x = _block(x, lp, 1)
+        x = _ln(x, p["ln_f"])
+        return jnp.einsum("bsd,vd->bsv", x, p["emb"])
+
+    run = jax.jit(shard_map(
+        fwd, mesh=mesh1,
+        in_specs=(tfm.param_specs(CFG), P("dp", "sp")),
+        out_specs=P("dp", "sp")))
+
+    seq = prompt
+    want = []
+    for _ in range(max_new):
+        logits = run(params, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        want.append(nxt)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    want = jnp.stack(want, axis=1)
+
+    # the test is only meaningful if decode is non-constant
+    flat = np.asarray(want).reshape(-1).tolist()
+    assert len(set(flat)) > 1, f"oracle decode degenerate: {flat}"
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+
 def test_params_actually_sharded(mesh3d):
     params = tfm.shard_params(tfm.init_params(CFG, jax.random.PRNGKey(0)),
                               CFG, mesh3d)
