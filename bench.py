@@ -1,45 +1,278 @@
 #!/usr/bin/env python
-"""Flagship benchmark: 1d_stencil cell-updates/s on the real TPU chip.
+"""Benchmarks on the real TPU chip — one JSON line per metric.
 
-BASELINE config #2 (examples/1d_stencil/1d_stencil_4.cpp analog). The
-fused path (ops/stencil.multistep: 1024 steps per dispatch, pallas in-VMEM
-where it fits) is the production configuration; STREAM-triad GB/s is
-reported to stderr for context.
+Metrics (each with a DEFENSIBLE roofline as its vs_baseline):
+  * stream_triad_gbs      — dispatch-level a+s*b (2 reads + 1 write per
+                            element, buffers HBM-resident, output buffer
+                            donated). Roof: 819 GB/s v5e HBM bandwidth.
+  * 1d_stencil_unfused    — ONE heat step per dispatch (BASELINE config
+                            #2's per-step shape): 8 bytes/cell-update.
+                            Roof: HBM => 102.4 Gcells/s.
+  * flash_attention_mfu   — pallas kernel, bf16 B2/S4096/N8/H128 causal.
+                            Roof: 197 bf16 TFLOP/s (v5e MXU peak);
+                            value = TFLOP/s, vs_baseline = MFU.
+  * transformer_step_ms   — single-chip fwd+bwd+sgd on a 4-layer
+                            d512/S1024 model; vs_baseline = achieved
+                            model FLOP/s over MXU peak (MFU).
+  * 1d_stencil_cell_updates (HEADLINE, printed last) — the fused
+    1024-step in-VMEM path. Its honest roof is NOT the unfused HBM
+    bound (it barely touches HBM): per-step work is ~3 VPU flops/cell,
+    so the compute roof is vpu_flops/3. vs_baseline reports against
+    that compute roof; the unfused-HBM ratio the round-1 bench used is
+    reported alongside as `x_vs_unfused_hbm_roof` for continuity.
 
-Timing methodology: the axon TPU tunnel adds a large fixed host<->device
-round-trip to any value materialization, and block_until_ready does not
-reliably fence. All measurements therefore use the SLOPE method — time a
-chain of K dispatches ending in a scalar materialization for two values
-of K and divide the work delta by the time delta. Inputs evolve across
-iterations (chained state) so no dispatch can be deduplicated.
-
-Prints ONE JSON line to stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-
-vs_baseline: measured cells/s over the HBM-bandwidth roof for an unfused
-heat step (8 bytes/cell-update at v5e's ~819 GB/s => ~102.4 Gcells/s).
-The reference publishes no numbers (BASELINE.md), so the hardware roof is
-the honest denominator; 1.0 means the fused/pallas path delivers what a
-perfectly HBM-bound implementation could at best.
+Timing: the axon tunnel adds a large fixed host<->device round trip and
+block_until_ready does not reliably fence, so every number uses the
+SLOPE method — time chains of K dependent dispatches ending in a scalar
+materialization for two K values and divide the deltas. Chained inputs
+evolve, so no dispatch can be deduplicated.
 """
 
+import functools
 import json
 import sys
 import time
 
 import numpy as np
 
-HBM_PEAK_GBS = 819.0  # TPU v5e
+HBM_PEAK_GBS = 819.0      # TPU v5e HBM bandwidth
+MXU_PEAK_BF16 = 197e12    # TPU v5e bf16 FLOP/s
+# (the fused-stencil compute roof is MEASURED — see bench_vpu_rate —
+# rather than derived from an unpublished VPU spec)
 
 
 def slope_time(run_chain, k1: int, k2: int, repeats: int = 3):
-    """Time chains of k1 and k2 iterations (each ending in a host fence);
-    return seconds per iteration from the slope. Min-of-N per point damps
-    the tunnel's fixed-latency jitter, which is larger than a single
-    dispatch."""
+    """Slope timing with min-of-N endpoints. The axon tunnel's fixed
+    round-trip cost is ~60-80 ms and fluctuates by tens of ms, so the
+    k2 chain must put well over 100 ms of real device work above the
+    fixed cost — callers pick (k1, k2) so (k2-k1)*per_iter >> jitter."""
+    run_chain(k1)                        # warm: pages, donation, caches
     t1 = min(run_chain(k1) for _ in range(repeats))
     t2 = min(run_chain(k2) for _ in range(repeats))
     return max(t2 - t1, 1e-9) / (k2 - k1)
+
+
+def emit(metric, value, unit, vs_baseline, **extra):
+    line = {"metric": metric, "value": round(value, 3), "unit": unit,
+            "vs_baseline": round(vs_baseline, 3)}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def bench_triad(jax, jnp):
+    """Dispatch-level STREAM triad: b <- x + s*b, output donated."""
+    m = 1 << 24
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def f(a, b):
+        return a + jnp.float32(1e-7) * b
+
+    x = jnp.asarray(np.random.default_rng(1).random(m, np.float32))
+    b = jnp.asarray(np.random.default_rng(2).random(m, np.float32))
+    b = f(x, b)
+    _ = float(b[0])
+
+    state = [b]
+
+    def chain(k):
+        bb = state[0]
+        t0 = time.perf_counter()
+        for _ in range(k):
+            bb = f(x, bb)
+        _ = float(bb[0])
+        state[0] = bb
+        return time.perf_counter() - t0
+
+    per = slope_time(chain, 64, 640, repeats=5)
+    gbs = 3 * m * 4 / per / 1e9
+    emit("stream_triad_gbs", gbs, "GB/s", gbs / HBM_PEAK_GBS)
+    return gbs
+
+
+def bench_stencil_unfused(jax, jnp, heat_step_best):
+    """One heat step per dispatch: the HBM-bound per-step number (the
+    blocked pallas kernel — ops/stencil.pallas_heat_step — which
+    streams 8 B/cell where XLA's roll lowering moves ~4x that)."""
+    n = 1 << 24
+    coef = jnp.float32(0.25)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(u):
+        return heat_step_best(u, coef)
+
+    u = jnp.asarray(np.random.default_rng(0).random(n, np.float32))
+    u = step(u)
+    _ = float(u[0])
+    state = [u]
+
+    def chain(k):
+        uu = state[0]
+        t0 = time.perf_counter()
+        for _ in range(k):
+            uu = step(uu)
+        _ = float(uu[0])
+        state[0] = uu
+        return time.perf_counter() - t0
+
+    per = slope_time(chain, 64, 640, repeats=5)
+    cells = n / per
+    roof = HBM_PEAK_GBS * 1e9 / 8.0          # read 4B + write 4B per cell
+    emit("1d_stencil_unfused_cell_updates", cells / 1e6, "Mcells/s",
+         cells / roof)
+    return cells
+
+
+def bench_vpu_rate(jax, jnp):
+    """Empirical VPU elementwise-op rate: an in-VMEM FMA chain with the
+    same shape/loop structure as the fused stencil kernel but ONE vector
+    op per element per iteration. This measured rate is the compute roof
+    the fused stencil is judged against."""
+    import functools as ft
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = 1 << 17        # whole array + 8 temporaries must fit scoped VMEM
+    steps = 1024
+
+    def kernel(u_ref, c_ref, o_ref):
+        c = c_ref[0]
+
+        def one(_i, u):
+            # 8 independent FMAs + a 7-add reduction tree: enough ILP
+            # that the VPU pipelines stay full (a single serial FMA
+            # chain measures instruction LATENCY, not throughput).
+            # Coefficients differ by ~1e-9 so nothing CSEs, while the
+            # iteration map stays u' ~ 0.9999*u + 1 (bounded).
+            ys = [u * (c + j * 1e-9) + (c + j * 1e-9) for j in range(8)]
+            s1 = (ys[0] + ys[1]) + (ys[2] + ys[3])
+            s2 = (ys[4] + ys[5]) + (ys[6] + ys[7])
+            return (s1 + s2) * jnp.float32(0.125 * 0.9999)
+        o_ref[:] = jax.lax.fori_loop(0, steps, one, u_ref[:])
+
+    @jax.jit
+    def run(u):
+        u2 = u.reshape(n // 128, 128)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(u2.shape, u2.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(u2, jnp.asarray([0.9999999], jnp.float32))
+        return out.reshape(n)
+
+    u0 = jnp.asarray(np.random.default_rng(0).random(n, np.float32))
+    u0 = run(u0)
+    _ = float(u0[0])
+
+    def chain(k):
+        u = u0
+        t0 = time.perf_counter()
+        for _ in range(k):
+            u = run(u)
+        _ = float(u[0])
+        return time.perf_counter() - t0
+
+    per = slope_time(chain, 8, 72)
+    return n * steps * 16 / per          # vector ops / s (8 FMA + 7 add
+                                         # + 1 scale per element-iter)
+
+
+# vector ops per cell-update in the fused pallas stencil kernel
+# (ops/stencil._pallas_kernel): 2 lane rolls + 2 masked selects + 5
+# arithmetic ops (mul, sub, add, mul, add)
+_STENCIL_OPS_PER_CELL = 9.0
+
+
+def bench_stencil_fused(jax, jnp, multistep):
+    n = 1 << 19               # 512K cells: pallas in-VMEM path
+    spd = 1024
+    coef = jnp.float32(0.25)
+    u0 = jnp.asarray(np.random.default_rng(0).random(n, np.float32))
+    u0 = multistep(u0, coef, spd)
+    _ = float(u0[0])
+
+    def chain(k):
+        u = u0
+        t0 = time.perf_counter()
+        for _ in range(k):
+            u = multistep(u, coef, spd)
+        _ = float(u[0])
+        return time.perf_counter() - t0
+
+    per = slope_time(chain, 8, 72)
+    cells_per_s = n * spd / per
+    hbm_roof = HBM_PEAK_GBS * 1e9 / 8.0
+    return cells_per_s, hbm_roof
+
+
+def bench_attention(jax, jnp):
+    from hpx_tpu.ops.attention_pallas import flash_attention
+    B, S, N, H = 2, 4096, 8, 128
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, S, N, H), np.float32), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    f = jax.jit(functools.partial(flash_attention, causal=True))
+    out = f(q, k, v)
+    jax.block_until_ready(out)
+
+    def chain(kk):
+        qq = q
+        t0 = time.perf_counter()
+        for _ in range(kk):
+            qq = f(qq, k, v)
+        _ = float(qq[0, 0, 0, 0])
+        return time.perf_counter() - t0
+
+    per = slope_time(chain, 8, 48)
+    flops = 4 * B * N * S * S * H * 0.5          # causal halves the work
+    tf = flops / per / 1e12
+    emit("flash_attention_tflops", tf, "TFLOP/s", tf * 1e12 / MXU_PEAK_BF16,
+         shape=f"B{B} S{S} N{N} H{H} bf16 causal")
+    return tf
+
+
+def bench_transformer(jax, jnp):
+    from hpx_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab=32768, d_model=512, n_heads=8,
+                                head_dim=64, n_layers=4, d_ff=2048,
+                                lr=0.01, dtype=jnp.bfloat16)
+    mesh1 = tfm.make_mesh_3d(1)
+    params = tfm.shard_params(tfm.init_params(cfg, jax.random.PRNGKey(0)),
+                              cfg, mesh1)
+    step = tfm.make_train_step(cfg, mesh1)
+    B, S = 8, 1024
+    toks, tgts = tfm.sample_batch(cfg, batch=B, seq=S,
+                                  key=jax.random.PRNGKey(1))
+    toks, tgts = tfm.shard_batch(toks, tgts, mesh1)
+    params, l0 = step(params, toks, tgts)
+    _ = float(l0)
+
+    state = [params]
+
+    def chain(k):
+        p = state[0]
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(k):
+            p, loss = step(p, toks, tgts)
+        _ = float(loss)
+        state[0] = p
+        return time.perf_counter() - t0
+
+    per = slope_time(chain, 2, 10)
+    # model flops: 6 * params * tokens (fwd+bwd) + attention term
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    attn_flops = 4 * B * cfg.n_heads * S * S * cfg.head_dim * \
+        cfg.n_layers * 3 * 0.5            # qk^T+pv, fwd+2bwd, causal
+    flops = 6 * n_params * B * S + attn_flops
+    mfu = flops / per / MXU_PEAK_BF16
+    emit("transformer_step_ms", per * 1e3, "ms", mfu,
+         shape=f"L{cfg.n_layers} d{cfg.d_model} B{B} S{S} bf16",
+         params=n_params)
+    return per
 
 
 def main() -> None:
@@ -47,73 +280,26 @@ def main() -> None:
     import jax.numpy as jnp
 
     sys.path.insert(0, ".")
-    from hpx_tpu.models.stencil1d import StencilParams, print_time_results
-    from hpx_tpu.ops.stencil import multistep
+    from hpx_tpu.ops.stencil import heat_step_best, multistep
 
     dev = jax.devices()[0]
     print(f"# device: {dev} platform={dev.platform}", file=sys.stderr)
 
-    # -- fused stencil (the headline number) --------------------------------
-    n = 1 << 19              # 512K cells: pallas in-VMEM path
-    spd = 1024               # steps per dispatch
-    coef = jnp.float32(0.25)
-    u0 = jnp.asarray(np.random.default_rng(0).random(n, np.float32))
-    u0 = multistep(u0, coef, spd)          # warm: compile
-    _ = float(u0[0])
+    bench_triad(jax, jnp)
+    bench_stencil_unfused(jax, jnp, heat_step_best)
+    bench_attention(jax, jnp)
+    bench_transformer(jax, jnp)
 
-    def stencil_chain(k: int) -> float:
-        u = u0
-        t0 = time.perf_counter()
-        for _ in range(k):
-            u = multistep(u, coef, spd)
-        _ = float(u[0])                    # host fence
-        return time.perf_counter() - t0
-
-    per_dispatch = slope_time(stencil_chain, 8, 72)
-    cells_per_s = n * spd / per_dispatch
-    p = StencilParams(nx=n, np_=1, nt=spd)
-    print_time_results("fused(tpu)", per_dispatch, p, file=sys.stderr)
-
-    # -- STREAM triad (context, stderr) -------------------------------------
-    m = 1 << 24
-    x = jnp.asarray(np.random.default_rng(1).random(m, np.float32))
-    y = jnp.asarray(np.random.default_rng(2).random(m, np.float32))
-    import functools
-
-    @functools.partial(jax.jit, static_argnames=("iters",))
-    def triad_fused(a, b, s, iters):
-        # pair-swap recurrence: each iteration is a genuine triad
-        # (read 2 arrays, write 1) that XLA cannot strength-reduce the
-        # way it collapses `z += s*y` repeated
-        def body(_i, ab):
-            a_, b_ = ab
-            return b_, a_ + s * b_
-        return jax.lax.fori_loop(0, iters, body, (a, b))
-
-    TRIADS = 32
-    z0 = triad_fused(x, y, jnp.float32(1e-7), TRIADS)
-    _ = float(z0[1][0])
-
-    def triad_chain(k: int) -> float:
-        z = z0
-        t0 = time.perf_counter()
-        for _ in range(k):
-            z = triad_fused(z[0], z[1], jnp.float32(1e-7), TRIADS)
-        _ = float(z[1][0])
-        return time.perf_counter() - t0
-
-    per_triad = slope_time(triad_chain, 4, 36) / TRIADS
-    triad_gbs = 3 * m * 4 / per_triad / 1e9
-    print(f"# STREAM-triad: {triad_gbs:.0f} GB/s "
-          f"({triad_gbs / HBM_PEAK_GBS:.0%} of HBM peak)", file=sys.stderr)
-
-    bound_cells = HBM_PEAK_GBS * 1e9 / 8.0
-    print(json.dumps({
-        "metric": "1d_stencil_cell_updates",
-        "value": round(cells_per_s / 1e6, 1),
-        "unit": "Mcells/s",
-        "vs_baseline": round(cells_per_s / bound_cells, 3),
-    }))
+    vpu_rate = bench_vpu_rate(jax, jnp)
+    cells_per_s, hbm_roof = bench_stencil_fused(jax, jnp, multistep)
+    # headline LAST so a last-line JSON parser picks it up. The honest
+    # roof for the VMEM-resident kernel is COMPUTE: the empirically
+    # measured VPU op rate divided by the kernel's 9 vector ops per
+    # cell-update. The unfused-HBM ratio is kept for round-1 continuity.
+    emit("1d_stencil_cell_updates", cells_per_s / 1e6, "Mcells/s",
+         cells_per_s * _STENCIL_OPS_PER_CELL / vpu_rate,
+         x_vs_unfused_hbm_roof=round(cells_per_s / hbm_roof, 3),
+         vpu_rate_gops=round(vpu_rate / 1e9, 1))
 
 
 if __name__ == "__main__":

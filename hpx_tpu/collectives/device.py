@@ -97,10 +97,9 @@ def all_reduce(x: Any, mesh, axis: str = "x", op: str = "add") -> Any:
 
 def all_gather(x: Any, mesh, axis: str = "x") -> Any:
     """Gather shards along the axis: every device ends with the full
-    (concatenated) array, replicated."""
-    sharded, rep = _specs(axis)
-
-    del sharded, rep
+    (concatenated) array, replicated over the WHOLE mesh (`axis` is
+    retained for cache keying and API symmetry; the resharding below
+    replicates across every mesh axis)."""
 
     def build():
         import jax

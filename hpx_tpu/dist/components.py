@@ -373,6 +373,10 @@ def _migrate(gid: IdType, to_loc: int, _hops: int = 0):
         _instances.pop(key, None)
         _forwards[key] = to_loc
     with entry.cv:
+        # clear migrating on the popped entry: a _free blocked on this
+        # migration keys off the flag to re-resolve (and _pin waiters
+        # re-check the table, see the entry gone, and chase the forward)
+        entry.migrating = False
         entry.cv.notify_all()
     return gid
 

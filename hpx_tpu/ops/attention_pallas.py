@@ -25,7 +25,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_chunk"]
+
+
+def _sds(shape, dtype, *operands):
+    """ShapeDtypeStruct whose varying-mesh-axes type is the union of the
+    operands' — required when a pallas_call runs INSIDE a vma-checked
+    shard_map (the kernel output varies over whatever its inputs do)."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in operands))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 _NEG_INF = -1e30     # large-negative instead of -inf: exp() stays exact,
                      # and (m_prev - m_new) never produces inf - inf
@@ -57,12 +68,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)           # (block_q, H)
-        k = k_ref[0].astype(jnp.float32)           # (block_k, H)
-        v = v_ref[0].astype(jnp.float32)
+        # keep q/k/v in their storage dtype for the dots: bf16 operands
+        # run the MXU at full rate; preferred_element_type=f32 keeps the
+        # ACCUMULATION in fp32 (the flash-attention numerics contract).
+        # The scale is applied to the f32 scores, not the bf16 operands.
+        q = q_ref[0]                               # (block_q, H)
+        k = k_ref[0]                               # (block_k, H)
+        v = v_ref[0]
         s = jax.lax.dot_general(
-            q * scale, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)    # (block_q, block_k)
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
 
         # in-tile masks: sequence padding tail + causal diagonal
         kpos = ik * block_k + jax.lax.broadcasted_iota(
@@ -83,8 +98,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = jnp.broadcast_to(corr * l_prev + p.sum(
             axis=1, keepdims=True), l_ref.shape)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        # second matmul in the storage dtype too (p cast bf16 when v is
+        # bf16 — standard flash practice), still accumulated in fp32
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p, v,
+            (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
@@ -95,8 +113,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = False, block_q: int = 128,
-                    block_k: int = 128,
+                    causal: bool = False, block_q: int = 1024,
+                    block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jax.Array:
     """[B, S, N, H] flash attention as one pallas_call per device.
 
@@ -138,7 +156,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         out_specs=pl.BlockSpec((1, block_q, h),
                                lambda bn, iq, ik: (bn, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * n, nq * block_q, h), q.dtype),
+        out_shape=_sds((b * n, nq * block_q, h), q.dtype, q, k, v),
         scratch_shapes=[
             pltpu.VMEM((block_q, h), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -151,3 +169,153 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     out = out[:, :sq].reshape(b, n, sq, h)
     return jnp.moveaxis(out, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# chunked variant with carry I/O — the ring-attention inner kernel
+# ---------------------------------------------------------------------------
+
+def _flash_chunk_kernel(d_ref, q_ref, k_ref, v_ref, acc_in, m_in, l_in,
+                        acc_out, m_out, l_out, acc_s, m_s, l_s, *,
+                        block_q: int, block_k: int, nk: int,
+                        causal: bool, scale: float):
+    """One K/V CHUNK folded into an online-softmax carry.
+
+    Same tile loop as _flash_kernel, but the (acc, m, l) state arrives
+    as inputs and leaves UNNORMALIZED as outputs, so a ring step
+    (ops/attention.py ring_attention_sharded) can fold one rotating
+    chunk per call. `d_ref` (SMEM) holds the TRACED relative offset
+    d = q_global_start - k_global_start: causal masking inside the
+    kernel is kpos <= qpos + d, which stays correct whichever ring step
+    the chunk arrives on. m/l travel in a 128-lane replicated layout
+    ([bn, s, 128]) to match the VMEM scratch tiling.
+    """
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    d = d_ref[0]
+
+    @pl.when(ik == 0)
+    def _load_carry():
+        acc_s[:] = acc_in[0]
+        m_s[:] = m_in[0]
+        l_s[:] = l_in[0]
+
+    if causal:
+        live = ik * block_k <= iq * block_q + block_q - 1 + d
+    else:
+        live = True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = kpos <= qpos + d
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        l_s[:] = jnp.broadcast_to(corr * l_prev + p.sum(
+            axis=1, keepdims=True), l_s.shape)
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p, v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _store_carry():
+        acc_out[0] = acc_s[:]
+        m_out[0] = m_s[:]
+        l_out[0] = l_s[:]
+
+
+def flash_attention_chunk(q, k, v, acc, m, l, d,
+                          causal: bool = False, block_q: int = 1024,
+                          block_k: int = 1024,
+                          interpret: Optional[bool] = None):
+    """Fold one K/V chunk into an online-softmax carry (pallas).
+
+    Layouts (kernel-native, NO [B,S,N,H] public shape here — the ring
+    transposes once outside its scan): q [bn, sq, h]; k/v [bn, sk, h];
+    acc [bn, sq, h] f32; m/l [bn, sq, 128] f32 (lane-replicated).
+    `d` is a traced int32 scalar: q_global_start - k_global_start.
+    Returns updated (acc, m, l), unnormalized. Finalize with
+    acc / max(l, eps) outside (ops/attention._finish agrees).
+
+    sq and sk must be multiples of the (clamped) block sizes — ring
+    chunks are equal by construction.
+    """
+    import math as _math
+    bn, sq, h = q.shape
+    sk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"chunk sizes must divide blocks: sq={sq}/{block_q}, "
+            f"sk={sk}/{block_k}")
+    nq = sq // block_q
+    nk = sk // block_k
+
+    kernel = functools.partial(
+        _flash_chunk_kernel, block_q=block_q, block_k=block_k, nk=nk,
+        causal=causal, scale=1.0 / _math.sqrt(h))
+
+    f32 = jnp.float32
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bn, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h), lambda bn_, iq, ik, *_: (bn_, iq, 0)),
+            pl.BlockSpec((1, block_k, h), lambda bn_, iq, ik, *_: (bn_, ik, 0)),
+            pl.BlockSpec((1, block_k, h), lambda bn_, iq, ik, *_: (bn_, ik, 0)),
+            pl.BlockSpec((1, block_q, h), lambda bn_, iq, ik, *_: (bn_, iq, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda bn_, iq, ik, *_: (bn_, iq, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda bn_, iq, ik, *_: (bn_, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, h), lambda bn_, iq, ik, *_: (bn_, iq, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda bn_, iq, ik, *_: (bn_, iq, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda bn_, iq, ik, *_: (bn_, iq, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, h), f32),
+            pltpu.VMEM((block_q, 128), f32),
+            pltpu.VMEM((block_q, 128), f32),
+        ],
+    )
+
+    acc2, m2, l2 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds((bn, sq, h), f32, q, k, v, acc, m, l),
+            _sds((bn, sq, 128), f32, q, k, v, acc, m, l),
+            _sds((bn, sq, 128), f32, q, k, v, acc, m, l),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray([d], jnp.int32).reshape(1), q, k, v, acc, m, l)
+    return acc2, m2, l2
