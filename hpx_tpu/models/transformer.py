@@ -169,10 +169,19 @@ def _local_loss(params, tokens, targets, cfg: TransformerConfig,
         x = _block(x, lp, sp_size)
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    tok_ll = jnp.take_along_axis(logp, targets[..., None],
-                                 axis=-1)[..., 0]
-    return -tok_ll.sum(), tok_ll.size
+    # -log p[target] = logsumexp(row) - logits[target]. The target
+    # logit is recomputed as a row-wise dot against the gathered
+    # embedding instead of take_along_axis over the [B,S,V] tensor —
+    # the full-vocab array feeds ONLY the logsumexp reduction (which
+    # XLA fuses into the matmul consumer), saving a GB-scale gather
+    # read per step at V=32k. The dot runs in the logits' dtype so both
+    # terms see the same rounding (a f32 recompute against bf16 logits
+    # would make near-deterministic tokens go slightly negative).
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.einsum("bsd,bsd->bs", x, params["emb"][targets]
+                     ).astype(jnp.float32)
+    nll = lse - tgt
+    return nll.sum(), nll.size
 
 
 # ---------------------------------------------------------------------------

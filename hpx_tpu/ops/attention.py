@@ -36,8 +36,9 @@ def auto_attention(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = False) -> jax.Array:
     """Best-available single-device attention: the pallas flash kernel
     on TPU (bf16 MXU tiles with fp32 accumulation, VMEM-resident online
-    softmax — 2-8x the XLA blockwise path on v5e, 40-64% MFU at
-    S=4k-16k), XLA blockwise elsewhere."""
+    softmax; driver-measured 35% MFU at B2/S4096/N8/H128 causal —
+    BENCH_r03.json — higher at longer S), XLA blockwise elsewhere.
+    Differentiable on both paths (flash carries a custom_vjp)."""
     if jax.default_backend() == "tpu":
         from .attention_pallas import flash_attention
         return flash_attention(q, k, v, causal)
@@ -193,23 +194,29 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Any,
 def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
                            axis: str, nshards: int,
                            causal: bool = False,
-                           use_flash: Optional[bool] = False) -> jax.Array:
+                           use_flash: Optional[bool] = None) -> jax.Array:
     """The per-shard ring body, callable from INSIDE an enclosing
     shard_map (e.g. a sharded transformer step). The ring loop is a
     lax.scan, so reverse-mode AD works (scan transposes; the ppermute
     transpose is the inverse rotation) — training steps can
     differentiate straight through the ring.
 
-    use_flash: fold each arriving chunk with the pallas chunk kernel
-    (attention_pallas.flash_attention_chunk) instead of the XLA online
-    block — 2-8x faster on TPU, but FORWARD-ONLY (pallas_call has no
-    transpose rule yet), so it defaults off here where training steps
-    differentiate through; the ring_attention front door passes None
-    (= flash on TPU) since it is a forward entry point.
+    use_flash (default None = flash on TPU): fold each arriving chunk
+    with the pallas chunk kernel (attention_pallas.flash_attention_chunk)
+    instead of the XLA online block — 2-8x faster on TPU, and
+    DIFFERENTIABLE: _ring_flash carries a custom_vjp whose backward
+    replays the ring with the pallas flash-backward kernels
+    (attention_pallas.flash_attention_bwd), rotating dK/dV partial
+    accumulators around the ICI ring alongside the chunks.
     """
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
     if use_flash:
+        if nshards == 1:
+            # degenerate ring: plain flash (custom_vjp) — skips the
+            # scan/ppermute wrapping and the unnormalized f32 carry
+            from .attention_pallas import flash_attention
+            return flash_attention(qc, kc, vc, causal)
         return _ring_flash(qc, kc, vc, axis, nshards, causal)
     b, sq, n, h = qc.shape
     idx = jax.lax.axis_index(axis)
@@ -246,30 +253,35 @@ def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
     return _finish(acc, l, qc.dtype)
 
 
-def _ring_flash(qc: jax.Array, kc: jax.Array, vc: jax.Array,
-                axis: str, nshards: int, causal: bool) -> jax.Array:
+def _ring_blk(sq: int, cap: int) -> int:
+    """Largest kernel block that divides the chunk length (the chunk
+    and backward kernels have no padding path), sublane-aligned when
+    possible."""
+    blk = math.gcd(sq, cap)
+    if blk % 8:
+        blk = sq
+    return blk
+
+
+def _ring_flash_fwd_impl(qc, kc, vc, axis, nshards, causal):
     """Ring attention with the pallas chunk kernel as the inner fold.
 
     Layout transposes to kernel-native [B*N, S/P, H] happen ONCE
     outside the ring scan; each step folds the arriving K/V chunk via
     flash_attention_chunk with the traced global offset
-    d = (idx - src) * sq, then rotates K/V with ppermute. Forward-only
-    (see ring_attention_sharded docstring).
+    d = (idx - src) * sq, then rotates K/V with ppermute. Returns the
+    public-layout output plus the residuals the backward needs
+    (kernel-layout operands, normalized output, row logsumexp).
     """
-    from .attention_pallas import flash_attention_chunk
+    from .attention_pallas import _kernel_layout, flash_attention_chunk
 
     b, sq, n, h = qc.shape
-    # block sizes must DIVIDE the chunk length (the chunk kernel has no
-    # padding path): largest power-of-two divisor <= 1024, falling back
-    # to one whole-chunk block when sq isn't sublane-aligned
-    blk = math.gcd(sq, 1024)
-    if blk % 8:
-        blk = sq
+    blk = _ring_blk(sq, 1024)
     idx = jax.lax.axis_index(axis)
 
-    qt = jnp.moveaxis(qc, 2, 1).reshape(b * n, sq, h)
-    kt = jnp.moveaxis(kc, 2, 1).reshape(b * n, sq, h)
-    vt = jnp.moveaxis(vc, 2, 1).reshape(b * n, sq, h)
+    qt = _kernel_layout(qc)
+    kt = _kernel_layout(kc)
+    vt = _kernel_layout(vc)
 
     # accumulators derive from qt so the scan carry's varying manual
     # axes match inside whatever enclosing mesh axes exist
@@ -291,12 +303,74 @@ def _ring_flash(qc: jax.Array, kc: jax.Array, vc: jax.Array,
         vc_ = jax.lax.ppermute(vc_, axis, perm)
         return (acc, m, l, kc_, vc_), None
 
+    # after nshards rotations the K/V chunks return home, so kt/vt are
+    # valid residuals for the backward replay
     (acc, m, l, _kc, _vc), _ = jax.lax.scan(
         step, (acc, m, l, kt, vt), jnp.arange(nshards))
 
-    den = jnp.where(l[:, :, :1] > 0, l[:, :, :1], 1.0)
-    out = (acc / den).astype(qc.dtype).reshape(b, n, sq, h)
-    return jnp.moveaxis(out, 1, 2)
+    l1 = l[:, :, :1]
+    m1 = m[:, :, :1]
+    den = jnp.where(l1 > 0, l1, 1.0)
+    ot = (acc / den).astype(qc.dtype)              # [bn, sq, h]
+    # one lane of the row logsumexp (the backward re-broadcasts)
+    lse = jnp.where(l1 > 0, m1 + jnp.log(den), 0.0)
+    out = jnp.moveaxis(ot.reshape(b, n, sq, h), 1, 2)
+    return out, (qt, kt, vt, ot, lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(qc: jax.Array, kc: jax.Array, vc: jax.Array,
+                axis: str, nshards: int, causal: bool) -> jax.Array:
+    return _ring_flash_fwd_impl(qc, kc, vc, axis, nshards, causal)[0]
+
+
+def _ring_flash_bwd(axis, nshards, causal, res, g):
+    """Ring-attention backward: replay the forward's chunk rotation;
+    each step runs the pallas flash-backward kernels on the arriving
+    chunk (attention_pallas.flash_attention_bwd with the traced offset
+    d), accumulating dQ locally while dK/dV partial sums travel AROUND
+    THE RING with their chunks — after nshards rotations each chunk's
+    gradient arrives back at its owner, the same lockstep schedule the
+    forward uses."""
+    from .attention_pallas import (_kernel_layout, bwd_prep,
+                                   flash_attention_bwd)
+
+    qt, kt, vt, ot, lse = res
+    b, sq, n, h = g.shape                      # public [B, S/P, N, H]
+    blk = _ring_blk(sq, 512)
+    idx = jax.lax.axis_index(axis)
+    dot_ = _kernel_layout(g).astype(qt.dtype)
+    delta128, lse128 = bwd_prep(dot_, ot, lse)
+
+    perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+    zf = qt.astype(jnp.float32) * 0.0
+
+    def step(carry, t):
+        dq, dk, dv, kr, vr = carry
+        src = (idx - t) % nshards
+        d = (idx - src) * sq
+        dq_p, dk_p, dv_p = flash_attention_bwd(
+            qt, kr, vr, dot_, delta128, lse128, d, causal=causal,
+            block_q=blk, block_k=blk)
+        dq = dq + dq_p
+        dk = dk + dk_p
+        dv = dv + dv_p
+        kr = jax.lax.ppermute(kr, axis, perm)
+        vr = jax.lax.ppermute(vr, axis, perm)
+        dk = jax.lax.ppermute(dk, axis, perm)
+        dv = jax.lax.ppermute(dv, axis, perm)
+        return (dq, dk, dv, kr, vr), None
+
+    (dq, dk, dv, _kr, _vr), _ = jax.lax.scan(
+        step, (zf, zf, zf, kt, vt), jnp.arange(nshards))
+
+    def back(x, dtype):
+        return jnp.moveaxis(x.reshape(b, n, sq, h), 1, 2).astype(dtype)
+
+    return back(dq, qt.dtype), back(dk, kt.dtype), back(dv, vt.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_impl, _ring_flash_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -316,9 +390,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Any,
     TPU both all_to_alls are single fused ICI ops.
 
     use_flash (default None = flash on TPU): the local attention uses
-    the pallas flash kernel, which is FORWARD-ONLY (pallas_call has no
-    transpose rule) — pass use_flash=False to keep the XLA blockwise
-    path when differentiating through this function.
+    the pallas flash kernel. Differentiable either way — flash carries
+    a custom_vjp through the pallas backward kernels; blockwise
+    differentiates through the XLA scan.
     """
     nshards = mesh.shape[axis]
     n = q.shape[2]

@@ -1,4 +1,4 @@
-"""Pallas flash-attention kernel (single chip).
+"""Pallas flash-attention kernels (single chip): forward AND backward.
 
 The MXU-resident inner loop for ops/attention.py: Q/K/V stream through
 VMEM in (block_q × block_k) tiles over a sequential TPU grid; the
@@ -10,8 +10,18 @@ skipped entirely (`pl.when`), not just masked — ~2× fewer tiles.
 Layout: [B, S, N, H] public shape; kernel works on [B*N, S, H] with the
 (S, H) tiles as MXU operands (H = 64/128 hits the 128-lane layout).
 
+`flash_attention` carries a `jax.custom_vjp`: the forward saves the
+per-row logsumexp L = m + log(l) (lane-replicated, the same layout the
+scratch uses), and the backward is the standard two-pass flash
+backward — one kernel accumulates dQ (grid inner axis walks K blocks),
+a second accumulates dK/dV (inner axis walks Q blocks), both
+recomputing p = exp(s − L) tile-by-tile so nothing O(S²) is ever
+materialized. Both backward kernels take the q/k global offset `d` as
+a scalar-prefetch operand, so the SAME kernels serve the ring-attention
+backward (ops/attention._ring_flash), where d is traced per ring step.
+
 `flash_attention` falls back to interpret mode off-TPU so the same
-kernel is testable on the CPU mesh (pallas interpret semantics).
+kernels are testable on the CPU mesh (pallas interpret semantics).
 """
 
 from __future__ import annotations
@@ -25,7 +35,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "flash_attention_chunk"]
+__all__ = ["flash_attention", "flash_attention_chunk",
+           "flash_attention_bwd"]
 
 
 def _sds(shape, dtype, *operands):
@@ -42,9 +53,14 @@ _NEG_INF = -1e30     # large-negative instead of -inf: exp() stays exact,
                      # and (m_prev - m_new) never produces inf - inf
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   block_q: int, block_k: int, nk: int, causal: bool,
-                  scale: float, seq_q: int, seq_k: int):
+                  scale: float, seq_q: int, seq_k: int,
+                  save_res: bool = False):
+    if save_res:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        acc_ref, m_ref, l_ref = rest
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -110,43 +126,57 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, :1]
         den = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_ref[:] / den).astype(o_ref.dtype)
+        if save_res:
+            # logsumexp per row, lane-replicated; fully-masked rows
+            # (l == 0: sequence padding, causal rows with no keys) get
+            # L = 0 so the backward's exp(s - L) stays finite — their
+            # contributions vanish through masks / zero cotangents.
+            lf = l_ref[:]
+            safe = jnp.where(lf > 0, lf, 1.0)
+            lse_ref[0] = jnp.where(lf > 0, m_ref[:] + jnp.log(safe), 0.0)
 
 
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = False, block_q: int = 1024,
-                    block_k: int = 1024,
-                    interpret: Optional[bool] = None) -> jax.Array:
-    """[B, S, N, H] flash attention as one pallas_call per device.
+def _kernel_layout(x: jax.Array) -> jax.Array:
+    """[B, S, N, H] -> [B*N, S, H] (the MXU-operand layout)."""
+    b, s, n, h = x.shape
+    return jnp.moveaxis(x, 2, 1).reshape(b * n, s, h)
 
-    S is padded to the block size internally; H should be a multiple of
-    the 128-lane layout's tile for best MXU utilization (64/128).
-    """
+
+def _pad_seq(x: jax.Array, pad: int) -> jax.Array:
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                    save_res):
     b, sq, n, h = q.shape
     sk = k.shape[1]
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
 
     block_q = min(block_q, max(sq, 8))
     block_k = min(block_k, max(sk, 8))
     pq = -sq % block_q
     pk = -sk % block_k
 
-    qt = jnp.moveaxis(q, 2, 1).reshape(b * n, sq, h)
-    kt = jnp.moveaxis(k, 2, 1).reshape(b * n, sk, h)
-    vt = jnp.moveaxis(v, 2, 1).reshape(b * n, sk, h)
-    if pq:
-        qt = jnp.pad(qt, ((0, 0), (0, pq), (0, 0)))
-    if pk:
-        kt = jnp.pad(kt, ((0, 0), (0, pk), (0, 0)))
-        vt = jnp.pad(vt, ((0, 0), (0, pk), (0, 0)))
+    qt = _pad_seq(_kernel_layout(q), pq)
+    kt = _pad_seq(_kernel_layout(k), pk)
+    vt = _pad_seq(_kernel_layout(v), pk)
     nq = qt.shape[1] // block_q
     nk = kt.shape[1] // block_k
 
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, nk=nk,
-        causal=causal, scale=1.0 / math.sqrt(h), seq_q=sq, seq_k=sk)
+        causal=causal, scale=1.0 / math.sqrt(h), seq_q=sq, seq_k=sk,
+        save_res=save_res)
 
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, h),
+                              lambda bn, iq, ik: (bn, iq, 0))]
+    out_shape = [_sds((b * n, nq * block_q, h), q.dtype, q, k, v)]
+    if save_res:
+        out_specs.append(pl.BlockSpec((1, block_q, 128),
+                                      lambda bn, iq, ik: (bn, iq, 0)))
+        out_shape.append(
+            _sds((b * n, nq * block_q, 128), jnp.float32, q, k, v))
+
+    res = pl.pallas_call(
         kernel,
         grid=(b * n, nq, nk),
         in_specs=[
@@ -154,9 +184,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             pl.BlockSpec((1, block_k, h), lambda bn, iq, ik: (bn, ik, 0)),
             pl.BlockSpec((1, block_k, h), lambda bn, iq, ik: (bn, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, h),
-                               lambda bn, iq, ik: (bn, iq, 0)),
-        out_shape=_sds((b * n, nq * block_q, h), q.dtype, q, k, v),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, h), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -167,8 +196,300 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret=interpret,
     )(qt, kt, vt)
 
-    out = out[:, :sq].reshape(b, n, sq, h)
-    return jnp.moveaxis(out, 1, 2)
+    out = res[0][:, :sq].reshape(b, n, sq, h)
+    out = jnp.moveaxis(out, 1, 2)
+    if save_res:
+        return out, res[1][:, :sq]          # L in kernel layout
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
+                           save_res=False)
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k,
+                               interpret, save_res=True)
+    # keep ONE lane of the lane-replicated logsumexp as the residual
+    # (128x smaller held fwd->bwd); _fa_bwd re-broadcasts
+    return out, (q, k, v, out, lse[:, :, :1])
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    # backward tiles keep four (bq, bk) f32 intermediates live in VMEM
+    # (s, p, dp, ds) — cap blocks at 512 so 512x512x4B x4 = 4 MB fits
+    bq = min(block_q, 512, max(sq, 8))
+    bk = min(block_k, 512, max(sk, 8))
+    pq = -sq % bq
+    pk = -sk % bk
+
+    qt = _pad_seq(_kernel_layout(q), pq)
+    dot_ = _pad_seq(_kernel_layout(g.astype(q.dtype)), pq)
+    ot = _pad_seq(_kernel_layout(o), pq)
+    kt = _pad_seq(_kernel_layout(k), pk)
+    vt = _pad_seq(_kernel_layout(v), pk)
+    lp = jnp.pad(lse, ((0, 0), (0, pq), (0, 0))) if pq else lse
+    delta128, lse128 = bwd_prep(dot_, ot, lp)
+
+    dq, dk, dv = flash_attention_bwd(
+        qt, kt, vt, dot_, delta128, lse128, sk - sq, causal=causal,
+        block_q=bq, block_k=bk, interpret=interpret, seq_k=sk)
+
+    def back(x, s, dtype):
+        return jnp.moveaxis(
+            x[:, :s].reshape(b, n, s, h), 1, 2).astype(dtype)
+
+    return (back(dq, sq, q.dtype), back(dk, sk, k.dtype),
+            back(dv, sk, v.dtype))
+
+
+_flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, block_q: int = 1024,
+                    block_k: int = 1024,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """[B, S, N, H] flash attention as one pallas_call per device.
+
+    S is padded to the block size internally; H should be a multiple of
+    the 128-lane layout's tile for best MXU utilization (64/128).
+    Differentiable: jax.custom_vjp routes reverse-mode through the
+    pallas backward kernels (flash_attention_bwd).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
+
+
+def bwd_prep(dot_, ot, lse1):
+    """flash_attention_bwd's input contract, in one place: delta =
+    rowsum(do * o) in one fused XLA pass (the kernels never touch o),
+    and lse/delta broadcast to the [bn, sq, 128] lane-replicated f32
+    layout the kernels' (1, block_q, 128) tiles expect. `lse1` is the
+    single-lane [bn, sq, 1] residual the forward saves."""
+    delta = (dot_.astype(jnp.float32) * ot.astype(jnp.float32)
+             ).sum(axis=-1, keepdims=True)
+    shape = (dot_.shape[0], dot_.shape[1], 128)
+    return (jnp.broadcast_to(delta, shape),
+            jnp.broadcast_to(lse1, shape))
+
+
+# ---------------------------------------------------------------------------
+# backward kernels — standard two-pass flash backward
+# ---------------------------------------------------------------------------
+#
+# Math (s = scale * q k^T; p = softmax rows; o = p v; L = row logsumexp):
+#   p     = exp(s - L)                      (recomputed per tile, stable:
+#                                            s - L <= -log l <= 0)
+#   delta = rowsum(do * o)                  (= p . dp per row)
+#   ds    = p * (dp - delta) * scale,  dp = do v^T
+#   dq    = ds k        dk = ds^T q        dv = p^T do
+#
+# Both kernels take the q/k global offset d (causal: kpos <= qpos + d)
+# as scalar prefetch so the ring backward can trace it per step.
+
+def _flash_bwd_dq_kernel(d_ref, q_ref, k_ref, v_ref, do_ref,
+                         delta_ref, lse_ref, dq_ref, dq_s, *,
+                         block_q: int, block_k: int, nk: int,
+                         causal: bool, scale: float, seq_k: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    d = d_ref[0]
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    if causal:
+        live = ik * block_k <= iq * block_q + block_q - 1 + d
+    else:
+        live = True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        kpos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = kpos < seq_k
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, kpos <= qpos + d)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds.astype(k.dtype) if k.dtype == jnp.bfloat16 else ds, k,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _store():
+        dq_ref[0] = dq_s[:]
+
+
+def _flash_bwd_dkv_kernel(d_ref, q_ref, k_ref, v_ref, do_ref,
+                          delta_ref, lse_ref, dk_ref, dv_ref, dk_s,
+                          dv_s, *, block_q: int, block_k: int, nq: int,
+                          causal: bool, scale: float, seq_k: int):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    d = d_ref[0]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    if causal:
+        live = ik * block_k <= iq * block_q + block_q - 1 + d
+    else:
+        live = True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        kpos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = kpos < seq_k
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = jnp.logical_and(mask, kpos <= qpos + d)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = jnp.where(mask, p, 0.0)
+        # dv += p^T do  (contract the q dimension)
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p.astype(do.dtype) if do.dtype == jnp.bfloat16 else p, do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds.astype(q.dtype) if q.dtype == jnp.bfloat16 else ds, q,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _store():
+        dk_ref[0] = dk_s[:]
+        dv_ref[0] = dv_s[:]
+
+
+def flash_attention_bwd(q, k, v, do, delta, lse, d,
+                        causal: bool = False, block_q: int = 512,
+                        block_k: int = 512,
+                        interpret: Optional[bool] = None,
+                        seq_k: Optional[int] = None):
+    """Flash-attention backward in kernel-native layout.
+
+    q/do: [bn, sq, h]; k/v: [bn, sk, h]; delta/lse: [bn, sq, 128] f32,
+    lane-replicated — lse is the forward's row logsumexp, delta is
+    rowsum(do * o) precomputed once by the caller (one fused XLA pass;
+    the kernels never touch o). d: int32 scalar (traced OK) =
+    q_global_start - k_global_start, the causal offset. sq/sk must be
+    multiples of the block sizes (callers pad; zero-padded do rows and
+    k/v rows contribute exact zeros). Returns (dq [bn,sq,h],
+    dk [bn,sk,h], dv [bn,sk,h]) — float32, so ring steps can accumulate
+    partials without bf16 round-off.
+    """
+    bn, sq, h = q.shape
+    sk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if seq_k is None:
+        seq_k = sk
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"bwd seq not block-aligned: sq={sq}/{block_q}, "
+            f"sk={sk}/{block_k}")
+    nq = sq // block_q
+    nk = sk // block_k
+    scale = 1.0 / math.sqrt(h)
+    f32 = jnp.float32
+    darr = jnp.asarray([d], jnp.int32).reshape(1)
+
+    q_at_iq = pl.BlockSpec((1, block_q, h),
+                           lambda bn_, iq, ik, *_: (bn_, iq, 0))
+    k_at_ik = pl.BlockSpec((1, block_k, h),
+                           lambda bn_, iq, ik, *_: (bn_, ik, 0))
+    l_at_iq = pl.BlockSpec((1, block_q, 128),
+                           lambda bn_, iq, ik, *_: (bn_, iq, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            nk=nk, causal=causal, scale=scale, seq_k=seq_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bn, nq, nk),
+            in_specs=[q_at_iq, k_at_ik, k_at_ik, q_at_iq, l_at_iq,
+                      l_at_iq],
+            out_specs=[q_at_iq],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, h), f32),
+            ],
+        ),
+        out_shape=[_sds((bn, sq, h), f32, q, k, v, do, delta, lse)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(darr, q, k, v, do, delta, lse)[0]
+
+    # dk/dv grid: k blocks on the parallel axis, q blocks innermost
+    q_at_iq2 = pl.BlockSpec((1, block_q, h),
+                            lambda bn_, ik, iq, *_: (bn_, iq, 0))
+    k_at_ik2 = pl.BlockSpec((1, block_k, h),
+                            lambda bn_, ik, iq, *_: (bn_, ik, 0))
+    l_at_iq2 = pl.BlockSpec((1, block_q, 128),
+                            lambda bn_, ik, iq, *_: (bn_, iq, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            nq=nq, causal=causal, scale=scale, seq_k=seq_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bn, nk, nq),
+            in_specs=[q_at_iq2, k_at_ik2, k_at_ik2, q_at_iq2, l_at_iq2,
+                      l_at_iq2],
+            out_specs=[k_at_ik2, k_at_ik2],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, h), f32),
+                pltpu.VMEM((block_k, h), f32),
+            ],
+        ),
+        out_shape=[_sds((bn, sk, h), f32, q, k, v, do, delta, lse),
+                   _sds((bn, sk, h), f32, q, k, v, do, delta, lse)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(darr, q, k, v, do, delta, lse)
+
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
