@@ -26,6 +26,12 @@ block_until_ready does not reliably fence, so every number uses the
 SLOPE method — time chains of K dependent dispatches ending in a scalar
 materialization for two K values and divide the deltas. Chained inputs
 evolve, so no dispatch can be deduplicated.
+
+Robustness (round-4): the shared chip shows +-10-15% run-to-run drift
+(interleaved A/B of identical kernels swings 0.60-0.81 of roof), so
+every metric repeats its whole slope measurement SAMPLES times and
+reports the MEDIAN, with `spread` = (max-min)/median alongside — a
+metric whose spread rivals its delta hasn't moved.
 """
 
 import functools
@@ -50,6 +56,17 @@ def slope_time(run_chain, k1: int, k2: int, repeats: int = 3):
     t1 = min(run_chain(k1) for _ in range(repeats))
     t2 = min(run_chain(k2) for _ in range(repeats))
     return max(t2 - t1, 1e-9) / (k2 - k1)
+
+
+SAMPLES = 3
+
+
+def robust(per_fn, samples: int = 0):
+    """Repeat a whole slope measurement; (median, (max-min)/median)."""
+    samples = samples or SAMPLES
+    ps = sorted(per_fn() for _ in range(samples))
+    med = ps[samples // 2]
+    return med, (ps[-1] - ps[0]) / med
 
 
 def emit(metric, value, unit, vs_baseline, **extra):
@@ -83,9 +100,10 @@ def bench_triad(jax, jnp):
         state[0] = bb
         return time.perf_counter() - t0
 
-    per = slope_time(chain, 64, 640, repeats=5)
+    per, spread = robust(lambda: slope_time(chain, 64, 640, repeats=5))
     gbs = 3 * m * 4 / per / 1e9
-    emit("stream_triad_gbs", gbs, "GB/s", gbs / HBM_PEAK_GBS)
+    emit("stream_triad_gbs", gbs, "GB/s", gbs / HBM_PEAK_GBS,
+         spread=round(spread, 3))
     return gbs
 
 
@@ -114,11 +132,11 @@ def bench_stencil_unfused(jax, jnp, heat_step_best):
         state[0] = uu
         return time.perf_counter() - t0
 
-    per = slope_time(chain, 64, 640, repeats=5)
+    per, spread = robust(lambda: slope_time(chain, 64, 640, repeats=5))
     cells = n / per
     roof = HBM_PEAK_GBS * 1e9 / 8.0          # read 4B + write 4B per cell
     emit("1d_stencil_unfused_cell_updates", cells / 1e6, "Mcells/s",
-         cells / roof)
+         cells / roof, spread=round(spread, 3))
     return cells
 
 
@@ -174,7 +192,7 @@ def bench_vpu_rate(jax, jnp):
         _ = float(u[0])
         return time.perf_counter() - t0
 
-    per = slope_time(chain, 8, 72)
+    per, _ = robust(lambda: slope_time(chain, 8, 72))
     return n * steps * 16 / per          # vector ops / s (8 FMA + 7 add
                                          # + 1 scale per element-iter)
 
@@ -201,10 +219,10 @@ def bench_stencil_fused(jax, jnp, multistep):
         _ = float(u[0])
         return time.perf_counter() - t0
 
-    per = slope_time(chain, 8, 72)
+    per, spread = robust(lambda: slope_time(chain, 8, 72))
     cells_per_s = n * spd / per
     hbm_roof = HBM_PEAK_GBS * 1e9 / 8.0
-    return cells_per_s, hbm_roof
+    return cells_per_s, hbm_roof, spread
 
 
 def bench_attention(jax, jnp):
@@ -226,11 +244,11 @@ def bench_attention(jax, jnp):
         _ = float(qq[0, 0, 0, 0])
         return time.perf_counter() - t0
 
-    per = slope_time(chain, 8, 48)
+    per, spread = robust(lambda: slope_time(chain, 8, 48))
     flops = 4 * B * N * S * S * H * 0.5          # causal halves the work
     tf = flops / per / 1e12
     emit("flash_attention_tflops", tf, "TFLOP/s", tf * 1e12 / MXU_PEAK_BF16,
-         shape=f"B{B} S{S} N{N} H{H} bf16 causal")
+         shape=f"B{B} S{S} N{N} H{H} bf16 causal", spread=round(spread, 3))
     return tf
 
 
@@ -262,7 +280,7 @@ def bench_transformer(jax, jnp):
         state[0] = p
         return time.perf_counter() - t0
 
-    per = slope_time(chain, 2, 10)
+    per, spread = robust(lambda: slope_time(chain, 2, 10))
     # model flops: 6 * params * tokens (fwd+bwd) + attention term
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     attn_flops = 4 * B * cfg.n_heads * S * S * cfg.head_dim * \
@@ -271,7 +289,7 @@ def bench_transformer(jax, jnp):
     mfu = flops / per / MXU_PEAK_BF16
     emit("transformer_step_ms", per * 1e3, "ms", mfu,
          shape=f"L{cfg.n_layers} d{cfg.d_model} B{B} S{S} bf16",
-         params=n_params)
+         params=n_params, spread=round(spread, 3))
     return per
 
 
@@ -291,7 +309,8 @@ def main() -> None:
     bench_transformer(jax, jnp)
 
     vpu_rate = bench_vpu_rate(jax, jnp)
-    cells_per_s, hbm_roof = bench_stencil_fused(jax, jnp, multistep)
+    cells_per_s, hbm_roof, spread = bench_stencil_fused(jax, jnp,
+                                                        multistep)
     # headline LAST so a last-line JSON parser picks it up. The honest
     # roof for the VMEM-resident kernel is COMPUTE: the empirically
     # measured VPU op rate divided by the kernel's 9 vector ops per
@@ -299,7 +318,7 @@ def main() -> None:
     emit("1d_stencil_cell_updates", cells_per_s / 1e6, "Mcells/s",
          cells_per_s * _STENCIL_OPS_PER_CELL / vpu_rate,
          x_vs_unfused_hbm_roof=round(cells_per_s / hbm_roof, 3),
-         vpu_rate_gops=round(vpu_rate / 1e9, 1))
+         vpu_rate_gops=round(vpu_rate / 1e9, 1), spread=round(spread, 3))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,170 @@
+"""Mesh-scaling measurements for BASELINE configs #3/#4/#5.
+
+Reference analog: the distributed benchmarks HPX runs per-locality-count
+(partitioned_vector STREAM triad, collectives all_reduce, distributed
+Jacobi — SURVEY.md §6 configs #3/#4/#5). Here a locality = a mesh
+device; the same harness takes real multi-chip hardware unchanged (it
+meshes over however many devices jax exposes) and falls back to a
+virtual CPU mesh for development, where the numbers measure SCALING
+SHAPE (collective/halo overhead vs device count), not absolute GB/s.
+
+One command:  python -m hpx_tpu.run --bench-mesh 8
+prints one JSON line per (config, device-count):
+  pv_triad        — partitioned_vector a+s*b via the segmented algo
+                    layer (config #3), elements/s
+  all_reduce_1m   — 1M-float all_reduce over the mesh (config #4),
+                    ops/s and algorithm bandwidth
+  jacobi2d        — sharded 2-D Jacobi, halo exchange both axes
+                    (config #5), Mcells/s
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _emit(**kv) -> None:
+    print(json.dumps(kv), flush=True)
+
+
+def _time_loop(fn, iters: int, warm: int = 2) -> float:
+    """Wall-seconds per iteration (mean of `iters` after warmup)."""
+    import jax
+    for _ in range(warm):
+        out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_pv_triad(ndev: int, devices) -> None:
+    """Config #3: STREAM triad over a PartitionedVector via the
+    segmented-algorithm dispatch (one sharded XLA program)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hpx_tpu.algo import transform
+    from hpx_tpu.containers.partitioned_vector import PartitionedVector
+    from hpx_tpu.dist.distribution_policies import ContainerLayout
+    from hpx_tpu.exec.policies import par
+    from hpx_tpu.parallel import make_mesh
+
+    mesh = make_mesh((ndev,), ("x",), devices[:ndev])
+    layout = ContainerLayout(mesh=mesh)
+    n = ndev * (1 << 20)                      # weak scaling: 1M/device
+    rng = np.random.default_rng(0)
+    a = PartitionedVector.from_array(
+        jnp.asarray(rng.random(n, np.float32)), layout=layout)
+    b = PartitionedVector.from_array(
+        jnp.asarray(rng.random(n, np.float32)), layout=layout)
+    s = jnp.float32(1e-7)
+
+    def run():
+        return transform(par, a, lambda x, y: x + s * y, b).data
+
+    per = _time_loop(run, iters=10)
+    _emit(metric="pv_triad", n_devices=ndev, elements=n,
+          meps=round(n / per / 1e6, 1),
+          gbs=round(3 * n * 4 / per / 1e9, 2),
+          us_per_op=round(per * 1e6, 1))
+
+
+def bench_all_reduce(ndev: int, devices) -> None:
+    """Config #4: 1M-float all_reduce over the mesh (XLA psum over
+    ICI on hardware). Algorithm bandwidth uses the ring-allreduce
+    convention 2(P-1)/P * bytes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hpx_tpu.collectives.device import all_reduce
+    from hpx_tpu.parallel import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    mesh = make_mesh((ndev,), ("x",), devices[:ndev])
+    n = 1 << 20
+    x = jax.device_put(
+        jnp.asarray(np.random.default_rng(1).random(n, np.float32)),
+        NamedSharding(mesh, P("x")))
+
+    def run():
+        return all_reduce(x, mesh, "x")
+
+    per = _time_loop(run, iters=10)
+    bw = 2 * (ndev - 1) / max(ndev, 1) * n * 4 / per / 1e9 if ndev > 1 \
+        else 0.0
+    _emit(metric="all_reduce_1m", n_devices=ndev, elements=n,
+          us_per_op=round(per * 1e6, 1), algo_gbs=round(bw, 2))
+
+
+def bench_jacobi(ndev: int, devices) -> None:
+    """Config #5: sharded 2-D Jacobi, halos via ppermute on both mesh
+    axes, all sweeps fused per dispatch."""
+    import math
+
+    from hpx_tpu.models.jacobi2d import JacobiParams, jacobi_sharded
+    from hpx_tpu.parallel import make_mesh
+
+    ax = 2 ** (int(math.log2(ndev)) // 2) if ndev > 1 else 1
+    ay = ndev // ax
+    mesh = make_mesh((ax, ay), ("x", "y"), devices[:ndev])
+    n = 1024
+    iters = 50
+    p = JacobiParams(nx=n, ny=n, nb=1, iterations=iters)
+
+    def run():
+        u, res = jacobi_sharded(p, mesh)
+        return res
+
+    per = _time_loop(run, iters=5)
+    cells = n * n * iters / per
+    _emit(metric="jacobi2d", n_devices=ndev, grid=f"{n}x{n}",
+          mesh=f"{ax}x{ay}", iterations=iters,
+          mcells=round(cells / 1e6, 1))
+
+
+def sweep(max_devices: int) -> None:
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= max_devices, (
+        f"need {max_devices} devices, have {len(devs)} — launch via "
+        f"`python -m hpx_tpu.run --bench-mesh N` (it provisions a "
+        f"virtual CPU mesh when hardware is short)")
+    _emit(metric="mesh_info", platform=devs[0].platform,
+          n_available=len(devs))
+    counts = []
+    k = 1
+    while k <= max_devices:
+        counts.append(k)
+        k *= 2
+    if counts[-1] != max_devices:       # non-power-of-two request: the
+        counts.append(max_devices)      # asked-for scale must be measured
+    for k in counts:
+        bench_pv_triad(k, devs)
+        bench_all_reduce(k, devs)
+        bench_jacobi(k, devs)
+
+
+if __name__ == "__main__":
+    import argparse
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args()
+    import jax
+    if os.environ.get("HPX_TPU_FORCE_PLATFORM"):
+        try:
+            jax.config.update(
+                "jax_platforms", os.environ["HPX_TPU_FORCE_PLATFORM"])
+        except Exception:  # noqa: BLE001
+            pass
+    sweep(args.devices)

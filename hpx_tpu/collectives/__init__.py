@@ -6,6 +6,10 @@
     collectives over ICI inside shard_map.
 """
 
+from .comm_set import (  # noqa: F401
+    CommunicationSet,
+    create_communication_set,
+)
 from .communicator import (  # noqa: F401
     Communicator,
     create_communicator,

@@ -1,0 +1,148 @@
+"""Tree-shaped host collectives — hpx::collectives::communication_set.
+
+Reference analog: libs/full/collectives' communication_set arranges
+communicators in an arity-A tree so large-site-count collectives don't
+funnel through one root (SURVEY.md §2.4 collectives row; the flat
+Communicator in collectives/communicator.py is a documented O(P) star
+fan-in — correct at 8 sites, the wrong shape at 64+).
+
+Composition, not reimplementation: a CommunicationSet is a tree of
+ordinary Communicators. Sites 0..N-1 split into ceil(N/A) groups of at
+most A; each group gets a leaf communicator whose root-side exchange
+state lives on the GROUP ROOT's locality (so fan-in load spreads across
+localities), and group roots recurse into a smaller CommunicationSet
+(or a single Communicator at the top). Results flow back down with a
+per-group broadcast. Like HPX's communication_set, the tree supports
+the fold-able subset of verbs — all_reduce, reduce, broadcast,
+barrier — the full verb set stays on the flat Communicator.
+
+Stages chain through Future.then (future<future> unwraps), so nothing
+blocks a thread between levels.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Optional
+
+from ..futures.future import Future
+from . import communicator as _flat
+
+__all__ = ["CommunicationSet", "create_communication_set"]
+
+
+class CommunicationSet:
+    """Arity-A collective tree over num_sites sites.
+
+    site_locality maps a site index to the locality hosting it
+    (identity by default — the common one-site-per-locality layout);
+    leaf exchange state is placed on each group root's locality.
+    """
+
+    def __init__(self, basename: str, num_sites: int, this_site: int,
+                 arity: int = 8,
+                 site_locality: Optional[Callable[[int], int]] = None ) -> None:
+        if num_sites < 1 or not (0 <= this_site < num_sites):
+            raise ValueError(f"bad site {this_site}/{num_sites}")
+        if arity < 2:
+            raise ValueError("arity must be >= 2")
+        self.basename = basename
+        self.num_sites = num_sites
+        self.this_site = this_site
+        self.arity = arity
+        loc = site_locality or (lambda s: s)
+        self._site_locality = loc
+
+        group = this_site // arity
+        base = group * arity
+        group_size = min(arity, num_sites - base)
+        self._group_root_site = base
+        self._is_group_root = this_site == base
+        self._leaf = _flat.Communicator(
+            f"{basename}/leaf/{group}", num_sites=group_size,
+            this_site=this_site - base,
+            root_locality=loc(base))
+
+        n_groups = -(-num_sites // arity)
+        # _has_upper: the TREE has more levels (true for every member of
+        # a multi-group set); _upper: only group roots hold the handle
+        self._has_upper = n_groups > 1
+        self._upper: Any = None
+        if n_groups > 1 and self._is_group_root:
+            if n_groups <= arity:
+                self._upper = _flat.Communicator(
+                    f"{basename}/top", num_sites=n_groups,
+                    this_site=group, root_locality=loc(0))
+            else:
+                self._upper = CommunicationSet(
+                    f"{basename}/up", n_groups, group, arity,
+                    site_locality=lambda g: loc(g * arity))
+
+    # -- verbs ---------------------------------------------------------------
+    def all_reduce(self, value: Any,
+                   op: Callable = operator.add) -> Future:
+        """Every site gets the op-fold of all sites' contributions."""
+        local = _flat.all_reduce(self._leaf, value, op=op)
+        if not self._has_upper:
+            return local
+        if self._is_group_root:
+            up = local.then(lambda f: _all_reduce_any(
+                self._upper, f.get(), op))
+            return up.then(
+                lambda f: _flat.broadcast(self._leaf, f.get(), root=0))
+        # non-root member: contribute, then receive the group broadcast
+        return local.then(
+            lambda _f: _flat.broadcast(self._leaf, None, root=0))
+
+    def reduce(self, value: Any, op: Callable = operator.add) -> Future:
+        """Site 0 gets the fold; every other site gets None."""
+        def pick(f):
+            return f.get() if self.this_site == 0 else None
+        return self.all_reduce(value, op=op).then(pick)
+
+    def broadcast(self, value: Any = None) -> Future:
+        """Every site gets site 0's value."""
+        return self.all_reduce(_Tagged(self.this_site, value),
+                               op=_keep_lowest).then(
+            lambda f: f.get().value)
+
+    def barrier(self) -> Future:
+        # module-level op, NOT a lambda: contributions travel in parcels
+        # when the leaf root is remote, and lambdas don't pickle
+        return self.all_reduce(None, op=_none_op)
+
+
+class _Tagged:
+    __slots__ = ("site", "value")
+
+    def __init__(self, site: int, value: Any) -> None:
+        self.site = site
+        self.value = value
+
+
+def _keep_lowest(a: "_Tagged", b: "_Tagged") -> "_Tagged":
+    return a if a.site <= b.site else b
+
+
+def _none_op(a: Any, b: Any) -> None:
+    return None
+
+
+def _all_reduce_any(comm: Any, value: Any, op: Callable) -> Future:
+    if isinstance(comm, CommunicationSet):
+        return comm.all_reduce(value, op=op)
+    return _flat.all_reduce(comm, value, op=op)
+
+
+def create_communication_set(basename: str, num_sites: Optional[int] = None,
+                             this_site: Optional[int] = None,
+                             arity: int = 8,
+                             site_locality: Optional[Callable[[int], int]]
+                             = None) -> CommunicationSet:
+    """hpx::collectives::create_communication_set analog."""
+    from ..dist.runtime import find_here, get_num_localities
+    return CommunicationSet(
+        basename,
+        num_sites if num_sites is not None else get_num_localities(),
+        this_site if this_site is not None else find_here(),
+        arity=arity, site_locality=site_locality)

@@ -1,0 +1,78 @@
+"""Parcelport authentication: HMAC challenge-response handshake.
+
+Reference context: HPX's parcelports run on trusted cluster fabrics and
+do not authenticate (SURVEY.md §2.4 parcelset row); this runtime's
+parcels deserialize via pickle, so an unauthenticated endpoint reachable
+from another host would be an arbitrary-code-execution surface (round-2
+advisor finding). Fix: before ANY pickled frame is accepted from a
+connection, both sides must prove knowledge of a shared secret
+(hpx.parcel.secret / HPX_TPU_PARCEL__SECRET) via a mutual HMAC-SHA256
+challenge-response:
+
+    dialer  -> HELLO(nonce_c)
+    accepter-> REPLY(HMAC(secret, nonce_c || "srv"), nonce_s)
+    dialer  -> FINAL(HMAC(secret, nonce_s || "cli"))
+
+Fresh random nonces make the exchange replay-proof. Auth frames are a
+FIXED binary format (magic + type + fixed-length fields) parsed with
+slicing only — never pickle — so unauthenticated bytes can't reach the
+deserializer. Anything malformed or failing verification is dropped;
+the peer simply never becomes authenticated.
+
+The handshake authenticates and guards bootstrap; it does not encrypt.
+Parcels in flight are as readable as on HPX's fabrics — run multi-node
+jobs on a private interconnect, as the reference assumes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+from typing import Optional, Tuple
+
+MAGIC = b"HPXA"
+T_HELLO = 1
+T_REPLY = 2
+T_FINAL = 3
+NONCE_LEN = 16
+MAC_LEN = 32                      # sha256 digest
+
+
+def mac(secret: str, nonce: bytes, role: bytes) -> bytes:
+    """HMAC-SHA256 proof over nonce||role; role separates the two
+    directions so a reflected REPLY can't serve as a FINAL."""
+    return _hmac.new(secret.encode(), nonce + role,
+                     hashlib.sha256).digest()
+
+
+def verify(expect_mac: bytes, secret: str, nonce: bytes,
+           role: bytes) -> bool:
+    return _hmac.compare_digest(expect_mac, mac(secret, nonce, role))
+
+
+def hello_frame(nonce: bytes) -> bytes:
+    return MAGIC + bytes([T_HELLO]) + nonce
+
+
+def reply_frame(mac_: bytes, nonce: bytes) -> bytes:
+    return MAGIC + bytes([T_REPLY]) + mac_ + nonce
+
+
+def final_frame(mac_: bytes) -> bytes:
+    return MAGIC + bytes([T_FINAL]) + mac_
+
+
+def parse(data: bytes) -> Optional[Tuple]:
+    """(type, fields...) for a well-formed auth frame, None otherwise.
+    Pure slicing on fixed offsets — safe on attacker-controlled bytes."""
+    if len(data) < 5 or data[:4] != MAGIC:
+        return None
+    t = data[4]
+    body = data[5:]
+    if t == T_HELLO and len(body) == NONCE_LEN:
+        return (T_HELLO, body)
+    if t == T_REPLY and len(body) == MAC_LEN + NONCE_LEN:
+        return (T_REPLY, body[:MAC_LEN], body[MAC_LEN:])
+    if t == T_FINAL and len(body) == MAC_LEN:
+        return (T_FINAL, body)
+    return None

@@ -203,23 +203,30 @@ def _create(type_name: str, args: tuple, kwargs: dict):
     return gid
 
 
-def _pin(gid: IdType) -> Optional[_Entry]:
-    """Pin the local instance against migration, or None if it isn't
-    (or no longer is) here. Blocks while a migration is in flight —
-    the reference's AGAS likewise defers resolution mid-migration."""
+_MIGRATING = object()          # _pin sentinel: here, but mid-migration
+
+
+def _pin(gid: IdType):
+    """Pin the local instance against migration: the _Entry on success,
+    None if the component isn't (or no longer is) here, or the
+    _MIGRATING sentinel while a migration is in flight.
+
+    NEVER blocks. The reference's AGAS defers resolution mid-migration
+    by suspending the HPX thread; our tasks run on OS pool workers
+    (possibly a single one on small hosts), so parking here would
+    starve the very pool that must run the migration's install/publish
+    steps — the r4 8-locality soak deadlocked exactly that way. Callers
+    reschedule instead (see _invoke)."""
     key = gid.key()
-    while True:
-        with _inst_lock:
-            entry = _instances.get(key)
-        if entry is None:
-            return None
-        with entry.cv:
-            if not entry.migrating:
-                entry.pins += 1
-                return entry
-            entry.cv.wait(timeout=1.0)
-        # re-loop: migration finished (entry popped + forward recorded)
-        # or aborted (migrating cleared)
+    with _inst_lock:
+        entry = _instances.get(key)
+    if entry is None:
+        return None
+    with entry.cv:
+        if entry.migrating:
+            return _MIGRATING
+        entry.pins += 1
+        return entry
 
 
 def _unpin(entry: _Entry) -> None:
@@ -231,10 +238,25 @@ def _unpin(entry: _Entry) -> None:
 _MAX_HOPS = 8   # forward-chase TTL: a freed/raced gid must error, not loop
 
 
+_MAX_MIGRATION_WAITS = 600     # x 50 ms = 30 s of migration patience
+
+
 @plain_action(name="components.invoke")
 def _invoke(gid: IdType, method: str, args: tuple, kwargs: dict,
-            _hops: int = 0):
+            _hops: int = 0, _waits: int = 0):
     entry = _pin(gid)
+    if entry is _MIGRATING:
+        # mid-migration: re-post after a beat instead of parking a pool
+        # worker (the timer thread fires the retry; the future chain
+        # unwraps through the parcel layer). _waits bounds a stuck
+        # migration; _hops is reserved for forward-chases.
+        if _waits >= _MAX_MIGRATION_WAITS:
+            raise HpxError(Error.invalid_status,
+                           f"migration never completed: {gid}")
+        from ..core.timing import async_after
+        return async_after(
+            0.05, _invoke, gid, method, args, kwargs, _hops,
+            _waits + 1)
     if entry is None:
         cur = _current_locality(gid)
         if cur != find_here() and _hops < _MAX_HOPS:
@@ -390,7 +412,11 @@ def _install_migrated(gid: IdType, type_name: str, state: Any) -> bool:
     else:
         inst.__dict__.update(state)
     _install(gid, inst, ever_migrated=True)
-    inst.on_migrated()
+    # plain registered classes (no Component base) migrate too — the
+    # hook is optional, like every other part of the component surface
+    hook = getattr(inst, "on_migrated", None)
+    if hook is not None:
+        hook()
     return True
 
 

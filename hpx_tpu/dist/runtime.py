@@ -35,6 +35,8 @@ _IDENT = "ident"      # (tag, locality)
 _PARCEL = "parcel"    # (tag, action_name, args, kwargs, req_id, src_loc)
 _RESULT = "result"    # (tag, req_id, ok, payload)
 _BATCH = "batch"      # (tag, [msg, ...])  — coalesced parcels
+_CONNECT = "connect"  # (tag, reachable_host, listen_port) — late join
+_WELCOME = "welcome"  # (tag, assigned_locality, table)
 
 
 class Runtime:
@@ -62,6 +64,17 @@ class Runtime:
         self.bytes_sent = 0
         self.bytes_received = 0
 
+        # parcel auth (advisor r2: parcels deserialize via pickle, so an
+        # unauthenticated reachable endpoint = remote code execution).
+        # When a secret is configured, EVERY connection must complete the
+        # HMAC handshake (dist/auth.py) before any frame is unpickled.
+        self._secret = cfg.get("hpx.parcel.secret", "")
+        self._authed: set = set()             # peer ids past handshake
+        self._auth_events: Dict[int, threading.Event] = {}
+        self._cli_nonce: Dict[int, bytes] = {}
+        self._srv_nonce: Dict[int, bytes] = {}
+        self._auth_lock = threading.Lock()
+
         # plugins: binary filter (parcel compression) + coalescing
         from .plugins import Coalescer, get_filter
         fname = cfg.get("hpx.parcel.compression", "")
@@ -78,7 +91,12 @@ class Runtime:
                 interval_s=cfg.get_float(
                     "hpx.parcel.coalescing_interval", 0.001))
 
-        if self.num_localities > 1:
+        if cfg.get_bool("hpx.connect", False):
+            # hpx::start + --hpx:connect analog (SURVEY §5.3): join a
+            # RUNNING job after bootstrap; locality id assigned by the
+            # console at welcome
+            self._connect_join()
+        elif self.num_localities > 1:
             self._bootstrap()
 
     # -- bootstrap ----------------------------------------------------------
@@ -93,42 +111,65 @@ class Runtime:
         except OSError:
             return "127.0.0.1"
 
+    def _root_endpoint_config(self):
+        """(root_host, root_port, multi_node) + the security gate shared
+        by _bootstrap and _connect_join: a non-loopback (or bind-any)
+        parcelport REQUIRES the auth secret — parcels deserialize via
+        pickle and MUST NOT be reachable unauthenticated (advisor r2)."""
+        root_host = self.cfg.get("hpx.parcel.address", "127.0.0.1")
+        root_port = self.cfg.get_int("hpx.parcel.port", 7910)
+        multi_node = root_host not in ("127.0.0.1", "localhost")
+        bind_any = self.cfg.get_bool("hpx.parcel.bind_any", False)
+        if ((multi_node or bind_any) and not self._secret
+                and not self.cfg.get_bool("hpx.parcel.allow_insecure",
+                                          False)):
+            raise HpxError(
+                Error.bad_parameter,
+                "multi-node parcelport requires hpx.parcel.secret "
+                "(env HPX_TPU_PARCEL__SECRET): parcels deserialize via "
+                "pickle and MUST NOT be reachable unauthenticated. Set "
+                "the same secret on every locality, or acknowledge an "
+                "isolated fabric with hpx.parcel.allow_insecure=1.")
+        return root_host, root_port, multi_node
+
+    def _dial_console(self, root_host: str, root_port: int) -> int:
+        """Securely connect to the console, retrying while it boots."""
+        deadline = time.monotonic() + self.cfg.get_float(
+            "hpx.startup_timeout", 30.0)
+        while True:
+            try:
+                return self._secure_connect(root_host, root_port)
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise NetworkError(
+                        f"cannot reach console at {root_host}:{root_port}")
+                time.sleep(0.05)
+
     def _bootstrap(self) -> None:
         from ..native.loader import NetEndpoint
 
-        root_host = self.cfg.get("hpx.parcel.address", "127.0.0.1")
-        root_port = self.cfg.get_int("hpx.parcel.port", 7910)
-        # Multi-node launches (console address not loopback) must accept
-        # connections from other hosts; single-node stays on loopback.
-        bind_any = self.cfg.get_bool(
-            "hpx.parcel.bind_any",
-            root_host not in ("127.0.0.1", "localhost"))
+        root_host, root_port, multi_node = self._root_endpoint_config()
+        # 0.0.0.0 is explicit opt-in only; multi-node binds the ONE
+        # interface that reaches the console (advisor r2: INADDR_ANY
+        # exposed the pickle endpoint on every interface).
+        bind_any = self.cfg.get_bool("hpx.parcel.bind_any", False)
 
         if self.locality == 0:
+            bind = ("0.0.0.0" if bind_any
+                    else root_host if multi_node else "127.0.0.1")
             self._endpoint = NetEndpoint(root_port, self._on_message,
-                                         bind_any=bind_any)
+                                         bind=bind)
             with self._boot_lock:
                 self._hellos[0] = (root_host, self._endpoint.port)
             # workers may all have said hello before our own entry landed
             self._maybe_broadcast_table()
         else:
-            self._endpoint = NetEndpoint(0, self._on_message,
-                                         bind_any=bind_any)
-            # dial the console; retry while it boots
-            deadline = time.monotonic() + self.cfg.get_float(
-                "hpx.startup_timeout", 30.0)
-            while True:
-                try:
-                    pid = self._endpoint.connect(root_host, root_port)
-                    break
-                except OSError:
-                    if time.monotonic() > deadline:
-                        raise NetworkError(
-                            f"cannot reach console at {root_host}:{root_port}")
-                    time.sleep(0.05)
-            self._add_route(0, pid)
             my_host = (self._reachable_host(root_host, root_port)
-                       if bind_any else "127.0.0.1")
+                       if multi_node else "127.0.0.1")
+            bind = "0.0.0.0" if bind_any else my_host
+            self._endpoint = NetEndpoint(0, self._on_message, bind=bind)
+            pid = self._dial_console(root_host, root_port)
+            self._add_route(0, pid)
             self._send_raw(pid, (_HELLO, self.locality, my_host,
                                  self._endpoint.port))
 
@@ -141,9 +182,95 @@ class Runtime:
         for loc, (host, port) in sorted(self._table.items()):
             if loc >= self.locality or loc in self._peer_of_loc:
                 continue
-            pid = self._endpoint.connect(host, port)
+            pid = self._secure_connect(host, port)
             self._add_route(loc, pid)
             self._send_raw(pid, (_IDENT, self.locality))
+
+    def _connect_join(self) -> None:
+        """Late-join attach: dial the console of a RUNNING job, receive
+        an assigned locality id + the current table, then wire the full
+        mesh exactly like a bootstrapped worker. Incumbents learn about
+        us from the console's table broadcast plus our IDENT dials."""
+        from ..native.loader import NetEndpoint
+
+        root_host, root_port, multi_node = self._root_endpoint_config()
+        my_host = (self._reachable_host(root_host, root_port)
+                   if multi_node else "127.0.0.1")
+        self._endpoint = NetEndpoint(0, self._on_message, bind=my_host)
+        pid = self._dial_console(root_host, root_port)
+        self._add_route(0, pid)
+        self._send_raw(pid, (_CONNECT, my_host, self._endpoint.port))
+        if not self._table_ready.wait(self.cfg.get_float(
+                "hpx.startup_timeout", 30.0)):
+            raise HpxError(Error.startup_timed_out,
+                           "late-join: no welcome from console")
+        # full mesh: dial every lower-numbered incumbent
+        for loc, (host, port) in sorted(self._table.items()):
+            if loc >= self.locality or loc in self._peer_of_loc:
+                continue
+            wpid = self._secure_connect(host, port)
+            self._add_route(loc, wpid)
+            self._send_raw(wpid, (_IDENT, self.locality))
+
+    def _secure_connect(self, host: str, port: int) -> int:
+        """connect() + (when a secret is configured) the blocking HMAC
+        handshake — no parcel leaves for this peer until it has proven
+        the secret and accepted our proof."""
+        pid = self._endpoint.connect(host, port)
+        if not self._secret:
+            self._authed.add(pid)
+            return pid
+        import os as _os
+
+        from . import auth
+        ev = threading.Event()
+        nonce = _os.urandom(auth.NONCE_LEN)
+        with self._auth_lock:
+            self._auth_events[pid] = ev
+            self._cli_nonce[pid] = nonce
+        self._endpoint.send(pid, auth.hello_frame(nonce))
+        if not ev.wait(self.cfg.get_float("hpx.startup_timeout", 30.0)):
+            raise NetworkError(
+                f"auth handshake with {host}:{port} timed out "
+                f"(secret mismatch?)")
+        return pid
+
+    def _handle_auth(self, peer_id: int, data: bytes) -> None:
+        """Auth-frame handling for not-yet-authenticated peers. Runs on
+        the IO thread; fixed-format parsing only — attacker bytes never
+        reach pickle. Malformed/failed frames are dropped and the peer
+        stays unauthenticated."""
+        import os as _os
+
+        from . import auth
+        fr = auth.parse(data)
+        if fr is None:
+            return
+        if fr[0] == auth.T_HELLO:
+            nsrv = _os.urandom(auth.NONCE_LEN)
+            with self._auth_lock:
+                self._srv_nonce[peer_id] = nsrv
+            self._endpoint.send(peer_id, auth.reply_frame(
+                auth.mac(self._secret, fr[1], b"srv"), nsrv))
+        elif fr[0] == auth.T_REPLY:
+            with self._auth_lock:
+                nonce_cli = self._cli_nonce.pop(peer_id, None)
+                ev = self._auth_events.pop(peer_id, None)
+            if nonce_cli is None:
+                return
+            if not auth.verify(fr[1], self._secret, nonce_cli, b"srv"):
+                return
+            self._endpoint.send(peer_id, auth.final_frame(
+                auth.mac(self._secret, fr[2], b"cli")))
+            self._authed.add(peer_id)
+            if ev is not None:
+                ev.set()
+        elif fr[0] == auth.T_FINAL:
+            with self._auth_lock:
+                nsrv = self._srv_nonce.pop(peer_id, None)
+            if nsrv is not None and auth.verify(
+                    fr[1], self._secret, nsrv, b"cli"):
+                self._authed.add(peer_id)
 
     # -- wire ---------------------------------------------------------------
     def _send_raw(self, peer_id: int, msg: Any) -> None:
@@ -178,6 +305,11 @@ class Runtime:
         """Runs on the IO thread: decode, then dispatch cheaply."""
         self.parcels_received += 1
         self.bytes_received += len(data)
+        if self._secret and peer_id not in self._authed:
+            # gate BEFORE deserialize: unauthenticated bytes must never
+            # reach pickle (that is the whole attack surface)
+            self._handle_auth(peer_id, data)
+            return
         try:
             from .plugins import decode_payload
             msg = deserialize(decode_payload(data))
@@ -219,9 +351,57 @@ class Runtime:
             self._maybe_broadcast_table()
         elif tag == _TABLE:
             self._table = msg[1]
+            # late joins grow the job: membership follows the table
+            self.num_localities = max(self.num_localities,
+                                      len(self._table))
             self._table_ready.set()
         elif tag == _IDENT:
             self._add_route(msg[1], peer_id)
+        elif tag == _CONNECT:
+            self._handle_connect(peer_id, msg)
+        elif tag == _WELCOME:
+            _tag, loc, table = msg
+            self.locality = loc
+            self._table = table
+            self.num_localities = len(table)
+            self._table_ready.set()
+
+    def _handle_connect(self, peer_id: int, msg: Any) -> None:
+        """Console side of a late join: assign the next locality id,
+        grow the table, welcome the joiner, broadcast the new table to
+        every incumbent (their routes to the joiner form lazily from
+        its IDENT dials).
+
+        Joins are only admitted AFTER bootstrap completes — a _CONNECT
+        racing the initial hellos would otherwise assign a colliding
+        id from the still-empty table and corrupt num_localities, so
+        early joins are parked on a pool task until the table is up
+        (the joiner is dialing a running job; its own welcome timeout
+        bounds the wait)."""
+        if self.locality != 0:
+            return                      # only the console admits joins
+        if not self._table_ready.is_set():
+            from ..runtime.threadpool import default_pool
+
+            def later() -> None:
+                if self._table_ready.wait(self.cfg.get_float(
+                        "hpx.startup_timeout", 30.0)):
+                    self._handle_connect(peer_id, msg)
+
+            default_pool().submit(later)
+            return
+        _tag, host, port = msg
+        with self._boot_lock:
+            new_loc = max(self._table) + 1 if self._table else 1
+            self._table[new_loc] = (host, port)
+            self.num_localities = max(self.num_localities,
+                                      len(self._table))
+            table = dict(self._table)
+        self._add_route(new_loc, peer_id)
+        self._send_raw(peer_id, (_WELCOME, new_loc, table))
+        for loc, pid in list(self._peer_of_loc.items()):
+            if loc not in (0, new_loc):
+                self._send_raw(pid, (_TABLE, table))
 
     def _maybe_broadcast_table(self) -> None:
         with self._boot_lock:

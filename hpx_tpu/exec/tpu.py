@@ -23,6 +23,19 @@ Two completion models (hpx.tpu.eager_futures):
     ready (a watcher thread calls block_until_ready). Matches HPX
     semantics exactly (future ready == computation done) at the price of
     host round-trips; use for host-side control decisions on device data.
+
+Error semantics (pinned by tests/test_executor_errors.py):
+  * trace/compile failures -> exceptional future in BOTH modes
+    (async_execute never leaks a raise).
+  * device-side failures after a successful dispatch:
+      watched — the watcher observes them; the future completes
+      exceptionally and .get() raises (HPX contract).
+      eager   — the future is already ready holding the in-flight
+      array; the failure surfaces at the first MATERIALIZATION
+      (np.asarray / block_until_ready / target.synchronize), NOT at
+      .get(). This is the ONE deliberate divergence from HPX future
+      semantics, the price of zero-sync dispatch — flip
+      hpx.tpu.eager_futures=0 when exactness matters.
 """
 
 from __future__ import annotations

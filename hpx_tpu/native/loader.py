@@ -225,10 +225,22 @@ _NET_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int,
 def _bind_net(lib: ctypes.CDLL) -> None:
     if getattr(lib, "_net_bound", False):
         return
+    # symbol probe BEFORE binding: a stale prebuilt .so (built from older
+    # sources, e.g. copied between checkouts — the Makefile's always-
+    # remake only covers in-tree builds) would otherwise surface as a
+    # bare AttributeError deep inside NetEndpoint.__init__
+    for sym in ("hpxrt_net_create", "hpxrt_net_create2",
+                "hpxrt_net_create3"):
+        if not hasattr(lib, sym):
+            raise RuntimeError(
+                f"libhpx_tpu_rt.so is stale (missing symbol {sym}); "
+                f"rebuild it: make -C {_HERE} clean && make -C {_HERE}")
     lib.hpxrt_net_create.restype = ctypes.c_void_p
     lib.hpxrt_net_create.argtypes = [ctypes.c_uint16]
     lib.hpxrt_net_create2.restype = ctypes.c_void_p
     lib.hpxrt_net_create2.argtypes = [ctypes.c_uint16, ctypes.c_int]
+    lib.hpxrt_net_create3.restype = ctypes.c_void_p
+    lib.hpxrt_net_create3.argtypes = [ctypes.c_uint16, ctypes.c_char_p]
     lib.hpxrt_net_port.restype = ctypes.c_uint16
     lib.hpxrt_net_port.argtypes = [ctypes.c_void_p]
     lib.hpxrt_net_set_callback.argtypes = [ctypes.c_void_p, _NET_CB,
@@ -253,16 +265,22 @@ class NetEndpoint:
 
     def __init__(self, port: int = 0,
                  on_message: Optional[Callable[[int, bytes], None]] = None,
-                 bind_any: bool = False):
+                 bind: str = "127.0.0.1"):
         lib = native_lib()
         if lib is None:
             raise RuntimeError("native runtime library unavailable")
         _bind_net(lib)
         self._lib = lib
-        self._h = lib.hpxrt_net_create2(port, 1 if bind_any else 0)
+        # the native path takes IPv4 literals only; resolve names here
+        import socket as _s
+        try:
+            _s.inet_pton(_s.AF_INET, bind)
+        except OSError:
+            bind = _s.getaddrinfo(bind, port, _s.AF_INET,
+                                  _s.SOCK_STREAM)[0][4][0]
+        self._h = lib.hpxrt_net_create3(port, bind.encode())
         if not self._h:
-            host = "0.0.0.0" if bind_any else "127.0.0.1"
-            raise OSError(f"cannot listen on {host}:{port}")
+            raise OSError(f"cannot listen on {bind}:{port}")
         self.on_message = on_message
 
         def _cb(_user, peer_id, data, length):

@@ -160,14 +160,19 @@ struct Net {
   }
 };
 
-int make_listener(uint16_t* port, int bind_any) {
+int make_listener(uint16_t* port, const char* bind_ip) {
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(bind_any ? INADDR_ANY : INADDR_LOOPBACK);
+  // bind a SPECIFIC interface (the security default — 0.0.0.0 only when
+  // the caller passes it explicitly)
+  if (inet_pton(AF_INET, bind_ip, &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
   addr.sin_port = htons(*port);
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       listen(fd, 64) < 0) {
@@ -184,12 +189,12 @@ int make_listener(uint16_t* port, int bind_any) {
 
 extern "C" {
 
-// Create endpoint listening on port (0 = ephemeral); bind_any selects
-// 0.0.0.0 (multi-node) vs 127.0.0.1 (default). Returns handle or null.
-void* hpxrt_net_create2(uint16_t port, int bind_any) {
+// Create endpoint listening on port (0 = ephemeral) bound to the IPv4
+// literal bind_ip. Returns handle or null.
+void* hpxrt_net_create3(uint16_t port, const char* bind_ip) {
   auto* net = new Net();
   net->port = port;
-  net->listen_fd = make_listener(&net->port, bind_any);
+  net->listen_fd = make_listener(&net->port, bind_ip);
   if (net->listen_fd < 0) {
     delete net;
     return nullptr;
@@ -205,6 +210,10 @@ void* hpxrt_net_create2(uint16_t port, int bind_any) {
   wev.data.u64 = (0ull << 32) | static_cast<uint32_t>(net->wake_fd);
   epoll_ctl(net->epoll_fd, EPOLL_CTL_ADD, net->wake_fd, &wev);
   return net;
+}
+
+void* hpxrt_net_create2(uint16_t port, int bind_any) {
+  return hpxrt_net_create3(port, bind_any ? "0.0.0.0" : "127.0.0.1");
 }
 
 void* hpxrt_net_create(uint16_t port) { return hpxrt_net_create2(port, 0); }

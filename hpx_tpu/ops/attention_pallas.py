@@ -263,7 +263,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     pallas backward kernels (flash_attention_bwd).
     """
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = jax.default_backend() != "tpu"
     return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
 
 
@@ -419,7 +419,7 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
     bn, sq, h = q.shape
     sk = k.shape[1]
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = jax.default_backend() != "tpu"
     if seq_k is None:
         seq_k = sk
     if sq % block_q or sk % block_k:
@@ -585,7 +585,7 @@ def flash_attention_chunk(q, k, v, acc, m, l, d,
     bn, sq, h = q.shape
     sk = k.shape[1]
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:

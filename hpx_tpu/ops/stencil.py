@@ -107,47 +107,60 @@ def pallas_multistep(u: jax.Array, coef, steps: int) -> jax.Array:
 _VMEM_F32_LIMIT = 1 << 19
 
 
-def _pallas_blocked_kernel(u_ref, coef_ref, out_ref):
-    """ONE heat step on a (R, 128) slab streamed from HBM — seam-free
-    interior.
+def _pallas_blocked_kernel(u_ref, edges_ref, coef_ref, out_ref):
+    """ONE heat step on a (R, 128) slab streamed from HBM.
 
     Flattened-order neighbors in the (rows, 128) layout are lane shifts
     with a row carry, computed with the SLAB-periodic wrap (the slab's
-    first/last elements borrow from its own far edge). That makes
-    exactly 2 output elements per slab wrong — the host-side fix-up in
-    pallas_heat_step scatters the correct values — and keeps the kernel
-    down to one input stream + one output stream (8 B/cell, the HBM
-    roofline's assumption). Separate halo-block inputs were measured to
+    first/last elements borrow from its own far edge). The 2 elements
+    per slab that wrap wrongly are patched IN-KERNEL from `edges_ref`
+    (SMEM: [grid, 2] true global neighbors, 8 bytes per slab gathered
+    once in XLA) — so ONE program streams one input + one output
+    (8 B/cell, the HBM roofline's assumption). The round-1..3 variant
+    patched them with a host-side scatter instead, which forced a
+    second full pass over `out` and capped the bench at ~61% of roof.
+    Separate halo-block INPUTS (vs these SMEM scalars) were measured to
     stall the DMA pipeline (~15 points of roof); XLA's roll/concat
     lowering of the same step materializes shifted copies (~4x
     traffic)."""
+    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    i = pl.program_id(0)
     u = u_ref[:]
     coef = coef_ref[0]
     col = jax.lax.broadcasted_iota(jnp.int32, u.shape, 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
 
     lane_r = pltpu.roll(u, 1, axis=1)
     carry_r = pltpu.roll(u[:, LANES - 1:], 1, axis=0)
     left = jnp.where(col == 0, carry_r, lane_r)
+    first_cell = jnp.logical_and(row == 0, col == 0)
+    left = jnp.where(first_cell, edges_ref[i, 0], left)
 
     lane_l = pltpu.roll(u, LANES - 1, axis=1)
     carry_l = pltpu.roll(u[:, :1], u.shape[0] - 1, axis=0)
     right = jnp.where(col == LANES - 1, carry_l, lane_l)
+    last_cell = jnp.logical_and(row == u.shape[0] - 1, col == LANES - 1)
+    right = jnp.where(last_cell, edges_ref[i, 1], right)
 
     out_ref[:] = u + coef * ((left + right) - 2.0 * u)
 
 
-_BLOCK_ROWS = 2048           # 1 MB/slab: deep DMA pipeline, low VMEM
+_BLOCK_ROWS = 2048           # 1 MB/slab: deep DMA pipeline; 8192 looked
+                             # ~5% faster in the r4 sweep but OOMs the
+                             # 16 MB scoped VMEM under some jit wrappings
+                             # (5 live slab temporaries x 4 MB)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def pallas_heat_step(u: jax.Array, coef) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_heat_step(u: jax.Array, coef,
+                     interpret: bool = False) -> jax.Array:
     """Single periodic heat step for arrays too big for VMEM: slabs
-    stream through a 1-D grid; the 2-per-slab seam elements are patched
-    by a tiny gather/scatter in the same program. Requires
-    len(u) % 128 == 0 and rows % block == 0 (the benchmark shapes; use
-    heat_step_best for automatic fallback)."""
+    stream through a 1-D grid with the global-periodic seam neighbors
+    fed as per-slab SMEM scalars. Requires len(u) % 128 == 0 and
+    rows % block == 0 (the benchmark shapes; use heat_step_best for
+    automatic fallback)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -158,26 +171,26 @@ def pallas_heat_step(u: jax.Array, coef) -> jax.Array:
     u2 = u.reshape(rows, LANES)
     grid = rows // r
 
+    # true global neighbors of each slab's first/last element — a tiny
+    # fused gather (2 scalars per slab)
+    import numpy as _np
+    starts = jnp.asarray(_np.arange(grid) * r * LANES, jnp.int32)
+    edges = jnp.stack([u[(starts - 1) % n],
+                       u[(starts + r * LANES) % n]], axis=1)
+
     out = pl.pallas_call(
         _pallas_blocked_kernel,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((r, LANES), lambda i: (i, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((r, LANES), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct(u2.shape, u2.dtype),
-    )(u2, jnp.asarray([coef], dtype=u.dtype)).reshape(n)
-
-    # fix the slab-boundary elements (first/last of each slab) with the
-    # true global-periodic neighbors — 2*grid scalars, fused scatter
-    import numpy as _np
-    starts = jnp.asarray(_np.arange(grid) * r * LANES, jnp.int32)
-    fix = jnp.concatenate([starts, starts + r * LANES - 1])
-    left = u[(fix - 1) % n]
-    right = u[(fix + 1) % n]
-    c = u[fix]
-    return out.at[fix].set(c + coef * (left - 2.0 * c + right))
+        interpret=interpret,
+    )(u2, edges, jnp.asarray([coef], dtype=u.dtype)).reshape(n)
+    return out
 
 
 def heat_step_best(u: jax.Array, coef) -> jax.Array:
@@ -186,7 +199,9 @@ def heat_step_best(u: jax.Array, coef) -> jax.Array:
     n = u.shape[0]
     rows = n // LANES if n % LANES == 0 else 0
     r = min(_BLOCK_ROWS, rows) if rows else 0
-    if (jax.default_backend() not in ("cpu",) and rows
+    # == "tpu", not "not cpu": the kernel is Mosaic-only — a GPU backend
+    # must take the XLA path, not crash in pallas lowering (advisor r2)
+    if (jax.default_backend() == "tpu" and rows
             and rows % r == 0 and r % 8 == 0):
         return pallas_heat_step(u, coef)
     return heat_step(u, coef)
@@ -198,9 +213,9 @@ def multistep(u: jax.Array, coef: jax.Array, steps: int,
     """Best-available T-step stencil: pallas when the array fits VMEM.
 
     Auto mode only picks pallas on a real TPU backend — the mosaic
-    kernel doesn't run on the CPU test platform."""
+    kernel runs neither on the CPU test platform nor on GPU."""
     if use_pallas is None:
-        use_pallas = (jax.default_backend() not in ("cpu",) and
+        use_pallas = (jax.default_backend() == "tpu" and
                       u.shape[0] % LANES == 0 and
                       u.shape[0] <= _VMEM_F32_LIMIT)
     if use_pallas:

@@ -33,13 +33,21 @@ def _free_port() -> int:
 def launch(script: str, script_args: List[str], localities: int,
            threads: int = 0, jax_platform: str = "cpu",
            timeout: float = 300.0) -> int:
+    import secrets as _secrets
     port = _free_port()
+    # per-launch shared secret: every locality authenticates its parcel
+    # connections (dist/auth.py HMAC handshake) even on loopback, so the
+    # pickle deserializer is never reachable unauthenticated and the
+    # handshake path is exercised by every multi-process run
+    secret = os.environ.get("HPX_TPU_PARCEL__SECRET",
+                            _secrets.token_hex(16))
     procs = []
     for loc in range(localities):
         env = dict(os.environ)
         env["HPX_TPU_LOCALITY"] = str(loc)
         env["HPX_TPU_LOCALITIES"] = str(localities)
         env["HPX_TPU_PARCEL__PORT"] = str(port)
+        env["HPX_TPU_PARCEL__SECRET"] = secret
         if threads:
             env["HPX_TPU_OS_THREADS"] = str(threads)
         if jax_platform:
@@ -70,12 +78,48 @@ def launch(script: str, script_args: List[str], localities: int,
     return rc
 
 
+def bench_mesh(n_devices: int, timeout: float = 1800.0) -> int:
+    """`python -m hpx_tpu.run --bench-mesh N`: BASELINE configs #3/#4/#5
+    (partitioned_vector triad, 1M all_reduce, sharded Jacobi) at
+    1/2/4/../N devices — real chips when jax exposes enough, otherwise a
+    virtual N-device CPU mesh in a child process (the same harness runs
+    unchanged on multi-chip hardware)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "benchmarks", "mesh_scaling.py")
+    env = dict(os.environ)
+    # probe the device count in a THROWAWAY subprocess: importing jax
+    # here would grab exclusive accelerator locks (libtpu) / preallocate
+    # (GPU) in a process that never releases them, starving the child
+    enough = False
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, sys; sys.stdout.write(str(len(jax.devices())))"],
+            capture_output=True, text=True, timeout=120)
+        enough = (probe.returncode == 0
+                  and probe.stdout.strip().isdigit()
+                  and int(probe.stdout.strip()) >= n_devices)
+    except Exception:  # noqa: BLE001
+        pass
+    if not enough:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HPX_TPU_FORCE_PLATFORM"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split() if not
+                 f.startswith("--xla_force_host_platform_device_count")]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n_devices}"])
+    proc = subprocess.run(
+        [sys.executable, script, "--devices", str(n_devices)],
+        cwd=repo, env=env, timeout=timeout)
+    return proc.returncode
+
+
 def _split_argv(argv: List[str]):
     """Launcher flags BEFORE the script path; everything from the
     script on is the script's own (so a script's --timeout is never
     swallowed — hpxrun convention)."""
     takes_value = {"-l", "--localities", "-t", "--threads", "--timeout",
-                   "--platform"}
+                   "--platform", "--bench-mesh"}
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -95,7 +139,8 @@ def _split_argv(argv: List[str]):
                 "see --help)")
         else:
             return argv[:i], argv[i], argv[i + 1:]
-    raise SystemExit("hpx_tpu.run: no script given")
+    # no script: legal only for script-less launcher modes (--bench-mesh)
+    return argv, None, []
 
 
 def main() -> None:
@@ -104,11 +149,17 @@ def main() -> None:
     ap.add_argument("-t", "--threads", type=int, default=0)
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--bench-mesh", type=int, default=0)
+    # only PRE-SCRIPT flags are the launcher's: `run.py script.py
+    # --bench-mesh 4` passes --bench-mesh through to the script
     launcher_args, script, script_args = _split_argv(sys.argv[1:])
-    if script is None:          # -h/--help: print usage and exit
-        ap.parse_args(launcher_args)
-        return
     ns = ap.parse_args(launcher_args)
+    if script is None:
+        if ns.bench_mesh:           # script-less mode: harness IS the job
+            sys.exit(bench_mesh(ns.bench_mesh, max(ns.timeout, 1800.0)))
+        raise SystemExit("hpx_tpu.run: no script given")
+    if ns.bench_mesh:
+        raise SystemExit("hpx_tpu.run: --bench-mesh takes no script")
     sys.exit(launch(script, script_args, ns.localities, ns.threads,
                     ns.platform, ns.timeout))
 

@@ -1,0 +1,91 @@
+"""Late-join (hpx::start + --hpx:connect analog) smoke.
+
+Launched as a 2-locality job via hpx_tpu.run; locality 0 then spawns a
+THIRD process with HPX_TPU_CONNECT=1 that attaches to the running job.
+Checks: the joiner gets locality id 2, incumbents observe the grown
+membership, and actions flow BOTH directions between incumbents and the
+joiner. Exit 0 per process on success.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import hpx_tpu as hpx
+from hpx_tpu.dist.actions import async_action, plain_action
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+T = 60.0
+
+_done_n = [0]
+_done_cv = threading.Condition()
+
+
+@plain_action(name="lj.echo")
+def echo(tag, caller):
+    return (tag, caller, hpx.find_here())
+
+
+@plain_action(name="lj.done")
+def done():
+    with _done_cv:
+        _done_n[0] += 1
+        _done_cv.notify_all()
+    return True
+
+
+def wait_members(n, timeout=T):
+    from hpx_tpu.dist.runtime import get_runtime
+    deadline = time.monotonic() + timeout
+    while hpx.get_num_localities() < n:
+        HPX_TEST(time.monotonic() < deadline,
+                 f"membership never reached {n}")
+        time.sleep(0.05)
+    return get_runtime()
+
+
+def main() -> int:
+    rt = hpx.init()
+    if os.environ.get("HPX_TPU_CONNECT") == "1":
+        # ---- the late joiner --------------------------------------------
+        me = hpx.find_here()
+        HPX_TEST_EQ(me, 2)
+        HPX_TEST_EQ(hpx.get_num_localities(), 3)
+        # joiner -> incumbents
+        HPX_TEST_EQ(async_action("lj.echo", 0, "from-joiner", me
+                                 ).get(timeout=T), ("from-joiner", 2, 0))
+        HPX_TEST_EQ(async_action("lj.echo", 1, "from-joiner", me
+                                 ).get(timeout=T), ("from-joiner", 2, 1))
+        # leave only after BOTH incumbents have called into us
+        with _done_cv:
+            HPX_TEST(_done_cv.wait_for(lambda: _done_n[0] >= 2, T),
+                     "incumbents never reached the joiner")
+        rt._stopped = True
+        rt._endpoint.close()
+        return report_errors()
+
+    me = hpx.find_here()
+    child = None
+    if me == 0:
+        env = dict(os.environ)
+        env["HPX_TPU_CONNECT"] = "1"
+        env.pop("HPX_TPU_LOCALITY", None)
+        child = subprocess.Popen([sys.executable, __file__], env=env)
+    rt = wait_members(3)
+    # incumbents -> joiner (route forms from the joiner's IDENT dial)
+    HPX_TEST_EQ(async_action("lj.echo", 2, "to-joiner", me
+                             ).get(timeout=T), ("to-joiner", me, 2))
+    HPX_TEST_EQ(async_action("lj.done", 2).get(timeout=T), True)
+    if child is not None:
+        HPX_TEST_EQ(child.wait(timeout=T), 0)
+    rt._stopped = True
+    rt._endpoint.close()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

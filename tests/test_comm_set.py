@@ -1,0 +1,100 @@
+"""communication_set (tree collectives) — hpx::collectives analog.
+
+Sites here are threads within one locality (the Communicator contract
+allows it), so the tree topology — leaf groups, recursive upper levels,
+downward broadcast — is exercised exactly; distribution of the leaf
+roots across real localities is covered by the 8-locality mp smoke
+(tests/mp_scripts/comm_set_smoke.py).
+"""
+
+import operator
+import threading
+
+import pytest
+
+from hpx_tpu.collectives.comm_set import CommunicationSet
+
+
+def _run_sites(num_sites, arity, verb):
+    """Run verb(site_comm) on every site concurrently; list of results."""
+    results = [None] * num_sites
+    errors = []
+
+    def site(i):
+        try:
+            cs = CommunicationSet("t", num_sites, i, arity=arity,
+                                  site_locality=lambda s: 0)
+            results[i] = verb(cs, i).get(timeout=60)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    ts = [threading.Thread(target=site, args=(i,))
+          for i in range(num_sites)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(90)
+    assert not errors, errors
+    return results
+
+
+@pytest.mark.parametrize("num_sites,arity", [
+    (4, 2),      # two leaf groups + top
+    (8, 2),      # recursive upper CommunicationSet (4 groups > arity)
+    (16, 4),     # 4 groups of 4
+    (9, 4),      # ragged tail group
+    (3, 8),      # single group, no upper level
+])
+def test_all_reduce_sum(num_sites, arity):
+    got = _run_sites(num_sites, arity,
+                     lambda cs, i: cs.all_reduce(i + 1))
+    want = num_sites * (num_sites + 1) // 2
+    assert got == [want] * num_sites
+
+
+def test_all_reduce_noncommutative_order():
+    """Tree fold must respect site order for associative-but-
+    noncommutative ops (string concat)."""
+    got = _run_sites(9, 2, lambda cs, i: cs.all_reduce(
+        str(i), op=operator.add))
+    assert got == ["012345678"] * 9
+
+
+def test_reduce_to_site0():
+    got = _run_sites(8, 2, lambda cs, i: cs.reduce(i + 1))
+    assert got[0] == 36
+    assert got[1:] == [None] * 7
+
+
+def test_broadcast_from_site0():
+    got = _run_sites(16, 4,
+                     lambda cs, i: cs.broadcast("root-data" if i == 0
+                                                else None))
+    assert got == ["root-data"] * 16
+
+
+def test_barrier_releases_all():
+    got = _run_sites(8, 2, lambda cs, i: cs.barrier())
+    assert len(got) == 8
+
+
+def test_fan_in_bounded_by_arity():
+    """The point of the tree: no single communicator sees more than
+    `arity` contributions."""
+    cs = CommunicationSet("shape", 64, 0, arity=8,
+                          site_locality=lambda s: 0)
+    assert cs._leaf.num_sites <= 8
+    assert cs._upper is not None and cs._upper.num_sites <= 8
+
+    cs2 = CommunicationSet("shape2", 65, 0, arity=8,
+                           site_locality=lambda s: 0)
+    # 9 groups > arity: the upper level recurses
+    assert isinstance(cs2._upper, CommunicationSet)
+    assert cs2._upper._leaf.num_sites <= 8
+
+
+def test_bad_args():
+    with pytest.raises(ValueError):
+        CommunicationSet("x", 4, 4)
+    with pytest.raises(ValueError):
+        CommunicationSet("x", 4, 0, arity=1)

@@ -1,0 +1,54 @@
+"""Smoke for the mesh-scaling harness (BASELINE configs #3/#4/#5).
+
+The conftest provisions the 8-device virtual CPU mesh, so the configs
+run in-process here; `python -m hpx_tpu.run --bench-mesh N` wraps the
+same functions for the one-command sweep (child-provisioned mesh).
+"""
+
+import json
+
+import jax
+import pytest
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 4])
+def test_configs_run(ndev, capsys):
+    from benchmarks import mesh_scaling as ms
+    devs = jax.devices()
+    ms.bench_pv_triad(ndev, devs)
+    ms.bench_all_reduce(ndev, devs)
+    ms.bench_jacobi(ndev, devs)
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    metrics = {l["metric"] for l in lines}
+    assert metrics == {"pv_triad", "all_reduce_1m", "jacobi2d"}
+    for l in lines:
+        assert l["n_devices"] == ndev
+    triad = next(l for l in lines if l["metric"] == "pv_triad")
+    assert triad["elements"] == ndev * (1 << 20)   # weak scaling
+    assert triad["meps"] > 0
+
+
+def test_run_flag_parses():
+    """--bench-mesh must be a launcher flag, not a script arg."""
+    from hpx_tpu.run import _split_argv
+    flags, script, rest = _split_argv(
+        ["-l", "2", "myscript.py", "--bench-mesh", "4"])
+    assert script == "myscript.py"
+    assert rest == ["--bench-mesh", "4"]
+    # script-less launcher mode (both spellings)
+    for argv in (["--bench-mesh", "8"], ["--bench-mesh=8"]):
+        flags, script, rest = _split_argv(argv)
+        assert script is None and rest == []
+
+
+def test_sweep_covers_non_power_of_two(monkeypatch, capsys):
+    """--bench-mesh 6 must measure AT 6 devices, not stop at 4."""
+    from benchmarks import mesh_scaling as ms
+    seen = []
+    for name in ("bench_pv_triad", "bench_all_reduce", "bench_jacobi"):
+        monkeypatch.setattr(ms, name,
+                            lambda k, d, _n=name: seen.append(k))
+    ms.sweep(6)
+    capsys.readouterr()
+    assert sorted(set(seen)) == [1, 2, 4, 6]

@@ -193,3 +193,31 @@ class TestBatchEnvironments:
             argv=["--hpx:localities=7"],
             environ={"SLURM_JOB_ID": "1", "SLURM_NTASKS": "2"})
         HPX_TEST_EQ(cfg.get_int("hpx.localities"), 7)
+
+
+def test_late_join_attach():
+    """--hpx:connect analog (SURVEY §5.3): a third process attaches to a
+    running 2-locality job, gets locality id 2, and actions flow both
+    ways (tests/mp_scripts/late_join_smoke.py)."""
+    import os
+    from hpx_tpu.run import launch
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    rc = launch(os.path.join(repo, "tests", "mp_scripts",
+                             "late_join_smoke.py"),
+                [], localities=2, timeout=240.0)
+    assert rc == 0
+
+
+@pytest.mark.soak
+def test_eight_locality_soak():
+    """8 real processes: collectives generations, communication_set
+    tree, channel soak, migrate-vs-invoke storm
+    (tests/mp_scripts/eight_locality_smoke.py)."""
+    import os
+    from hpx_tpu.run import launch
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    # 8 jax processes share one sandbox core: imports alone are ~5 min
+    rc = launch(os.path.join(repo, "tests", "mp_scripts",
+                             "eight_locality_smoke.py"),
+                [], localities=8, timeout=900.0)
+    assert rc == 0

@@ -111,3 +111,18 @@ def test_conservation():
     u = stencil_fused(p, use_pallas=False)
     np.testing.assert_allclose(float(jnp.sum(u)),
                                float(jnp.sum(init_domain(p))), rtol=1e-3)
+
+
+def test_pallas_heat_step_seams_interpret(monkeypatch):
+    """The blocked kernel's in-kernel seam patch (r4: per-slab SMEM edge
+    scalars replaced the host-side scatter): every slab-boundary element
+    must get its TRUE global-periodic neighbors. Small slabs force
+    multiple grid steps so all seam cases (interior + wraparound) hit."""
+    from hpx_tpu.ops import stencil as st
+    monkeypatch.setattr(st, "_BLOCK_ROWS", 8)
+    n, coef = 8 * 128 * 4, jnp.float32(0.3)
+    u = jnp.asarray(np.random.default_rng(7).random(n, np.float32))
+    got = st.pallas_heat_step(u, coef, interpret=True)
+    want = heat_step(u, coef)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)

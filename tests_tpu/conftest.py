@@ -1,0 +1,26 @@
+"""Real-chip kernel tests (SURVEY.md §4; VERDICT r3 weak #7).
+
+Unlike tests/conftest.py this does NOT force the CPU platform — the
+whole point is compiling the pallas kernels through Mosaic on the real
+TPU, so a Mosaic regression fails a test instead of silently showing up
+as a bench drop. Every test is marked `tpu` and auto-skips off-chip.
+
+Run on the bench host:  python -m pytest tests_tpu -q
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    on_tpu = False
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        pass
+    if on_tpu:
+        return
+    skip = pytest.mark.skip(reason="real TPU chip not available")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
