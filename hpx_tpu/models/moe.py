@@ -1,0 +1,168 @@
+"""Mixture-of-experts FFN with expert parallelism over a mesh axis.
+
+The reference (HPX) has no ML layers; this is part of the mandated
+model family (SURVEY.md §2.9), built GShard/Switch-style for TPU:
+STATIC shapes throughout (top-k gating lowered to one-hot einsums with
+a fixed per-expert capacity), experts sharded over a mesh axis, and
+token exchange as ONE tiled `lax.all_to_all` each way — the same
+collective substrate ulysses_attention rides (SURVEY.md §5.7).
+
+Layout (inside shard_map; the "ep" axis may be a dedicated mesh axis or
+an existing data axis — tokens must be sharded over it, expert weights
+sharded over it, everything else replicated over it):
+
+    tokens   x       [T, D]           (T = local tokens)
+    gate     wg      [D, E]           replicated
+    experts  w1      [E/P, D, F]      sharded over ep
+             b1      [E/P, F]
+             w2      [E/P, F, D]
+
+    dispatch [T, E, C] one-hot   -> einsum -> [E, C, D]
+    reshape  [P, E/P, C, D] -> all_to_all -> [E/P, P*C, D]
+    expert FFN (batched einsum over the local experts)
+    all_to_all back -> combine [T, E, C] -> [T, D]
+
+Everything is differentiable (einsums + all_to_all transpose); dropped
+tokens (over capacity) contribute zero output and zero gradient, the
+standard Switch behavior. The auxiliary load-balance loss
+(Switch §2.2: E * sum_e f_e * p_e) is returned for the trainer to add.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MoeConfig", "init_moe_params", "moe_ffn", "moe_param_specs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    n_experts: int = 4
+    top_k: int = 2                 # 1 = Switch, 2 = GShard default
+    capacity_factor: float = 1.5   # C = ceil(T*k*cf / E)
+    d_model: int = 64
+    d_ff: int = 128                # per-expert hidden
+    dtype: Any = jnp.float32
+
+
+def init_moe_params(cfg: MoeConfig, key: jax.Array) -> Dict[str, Any]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    e, d, f = cfg.n_experts, cfg.d_model, cfg.d_ff
+    s = 1.0 / math.sqrt(d)
+    return {
+        "wg": (jax.random.normal(k1, (d, e)) * s).astype(cfg.dtype),
+        "w1": (jax.random.normal(k2, (e, d, f)) * s).astype(cfg.dtype),
+        "b1": jnp.zeros((e, f), cfg.dtype),
+        "w2": (jax.random.normal(k3, (e, f, d)) / math.sqrt(f)
+               ).astype(cfg.dtype),
+    }
+
+
+def moe_param_specs(axis: str = "ep",
+                    tp_axis: Any = None) -> Dict[str, Any]:
+    """PartitionSpecs: experts sharded over `axis`; with tp_axis set,
+    each expert's d_ff additionally shards Megatron-style over it (the
+    caller must psum the MoE output over tp_axis, exactly like the
+    dense MLP's row-parallel close)."""
+    from jax.sharding import PartitionSpec as P
+    return {"wg": P(),
+            "w1": P(axis, None, tp_axis),
+            "b1": P(axis, tp_axis),
+            "w2": P(axis, tp_axis, None)}
+
+
+def _top_k_dispatch(gates: jax.Array, k: int, capacity: int):
+    """One-hot dispatch/combine tensors for top-k routing.
+
+    gates [T, E] (softmax rows). Returns (dispatch [T, E, C] one-hot,
+    combine [T, E, C] weighted, aux_loss scalar). GShard order: the
+    k-th choice claims capacity AFTER all earlier choices, so first
+    choices are never bumped by second choices.
+    """
+    t, e = gates.shape
+    masks = []
+    g = gates
+    for _ in range(k):
+        idx = jnp.argmax(g, axis=-1)
+        m = jax.nn.one_hot(idx, e, dtype=gates.dtype)      # [T, E]
+        masks.append(m)
+        g = g * (1.0 - m)                  # mask out the chosen expert
+
+    # capacity positions: later choices rank after every earlier
+    # choice's claims (GShard's cumsum-with-offset)
+    dispatch = jnp.zeros((t, e, capacity), gates.dtype)
+    combine = jnp.zeros((t, e, capacity), gates.dtype)
+    used = jnp.zeros((1, e), gates.dtype)  # tokens claimed per expert
+    for m in masks:
+        pos = jnp.cumsum(m, axis=0) - m + used             # [T, E]
+        keep = m * (pos < capacity)
+        oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=gates.dtype) * keep[..., None]
+        dispatch = dispatch + oh
+        combine = combine + oh * jnp.sum(gates * m, axis=-1,
+                                         keepdims=True)[..., None]
+        used = used + jnp.sum(m, axis=0, keepdims=True)
+
+    # Switch load-balance loss on FIRST choices: E * sum_e f_e * p_e
+    f_e = jnp.mean(masks[0], axis=0)
+    p_e = jnp.mean(gates, axis=0)
+    aux = e * jnp.sum(f_e * p_e)
+    return dispatch, combine, aux
+
+
+def moe_ffn(x: jax.Array, params: Dict[str, Any], cfg: MoeConfig,
+            axis: str = "", axis_size: int = 1
+            ) -> Tuple[jax.Array, jax.Array]:
+    """MoE feed-forward on a [T, D] token block.
+
+    axis: mesh axis the experts are sharded over ("" = single shard —
+    all experts local, no collective). Call from INSIDE shard_map when
+    axis != "". Returns (out [T, D], aux_load_balance_loss).
+    """
+    t, d = x.shape
+    e = cfg.n_experts
+    p = max(axis_size, 1)
+    if e % p:
+        raise ValueError(f"n_experts ({e}) not divisible by ep={p}")
+    if cfg.top_k > e:
+        # an all-masked gate row would silently re-route to expert 0
+        raise ValueError(f"top_k ({cfg.top_k}) > n_experts ({e})")
+    e_loc = e // p
+    capacity = max(1, math.ceil(t * cfg.top_k
+                                * cfg.capacity_factor / e))
+
+    xf = x.astype(jnp.float32)
+    gates = jax.nn.softmax(xf @ params["wg"].astype(jnp.float32),
+                           axis=-1)
+    dispatch, combine, aux = _top_k_dispatch(gates, cfg.top_k, capacity)
+
+    # [T, E, C] x [T, D] -> [E, C, D] in the compute dtype
+    xd = x.astype(cfg.dtype)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype), xd)
+
+    if p > 1:
+        # exchange over the ep axis: [P, E/P, C, D] -> [E/P, P*C, D]
+        ei = expert_in.reshape(p, e_loc, capacity, d)
+        ei = jax.lax.all_to_all(ei, axis, split_axis=0, concat_axis=2,
+                                tiled=True)
+        ei = ei.reshape(e_loc, p * capacity, d)
+    else:
+        ei = expert_in                                 # [E, C, D]
+
+    h = jnp.einsum("ecd,edf->ecf", ei, params["w1"])
+    h = jax.nn.gelu(h + params["b1"][:, None, :])
+    eo = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+
+    if p > 1:
+        eo = eo.reshape(1, e_loc, p * capacity, d)
+        eo = jax.lax.all_to_all(eo, axis, split_axis=2, concat_axis=0,
+                                tiled=True)            # [P, E/P, C, D]
+        eo = eo.reshape(e, capacity, d)
+
+    out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), eo)
+    return out.astype(x.dtype), aux

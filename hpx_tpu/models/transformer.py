@@ -47,6 +47,15 @@ class TransformerConfig:
     d_ff: int = 128
     dtype: Any = jnp.float32
     lr: float = 1e-2
+    # mixture-of-experts: n_experts > 0 replaces every block's MLP with
+    # a MoE FFN (models/moe.py); experts shard over the dp axis —
+    # tokens are batch-sharded there, so the MoE all_to_all exchanges
+    # tokens within data-parallel groups (the GShard layout) — giving
+    # the dp x sp x tp x EP parallelism combination in one train step
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 2.0
+    moe_aux_weight: float = 0.01
 
 
 def make_mesh_3d(n_devices: int, devices=None):
@@ -71,6 +80,14 @@ def make_mesh_3d(n_devices: int, devices=None):
     return Mesh(np.array(devs).reshape(dp, sp, tp), ("dp", "sp", "tp"))
 
 
+def _moe_cfg(cfg: TransformerConfig):
+    from .moe import MoeConfig
+    return MoeConfig(n_experts=cfg.n_experts, top_k=cfg.moe_top_k,
+                     capacity_factor=cfg.moe_capacity,
+                     d_model=cfg.d_model, d_ff=cfg.d_ff,
+                     dtype=cfg.dtype)
+
+
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     """Weight pytree. tp-sharded leaves carry their FULL logical shape
     here; shard_params() places them."""
@@ -80,18 +97,26 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 
     def layer(k):
         k1, k2, k3, k4 = jax.random.split(k, 4)
-        return {
+        out = {
             "ln1": jnp.ones((d,), cfg.dtype),
             "wqkv": (jax.random.normal(k1, (3, d, nh, hd)) * s
                      ).astype(cfg.dtype),
             "wo": (jax.random.normal(k2, (nh, hd, d)) * s
                    ).astype(cfg.dtype),
             "ln2": jnp.ones((d,), cfg.dtype),
-            "w1": (jax.random.normal(k3, (d, f)) * s).astype(cfg.dtype),
-            "b1": jnp.zeros((f,), cfg.dtype),
-            "w2": (jax.random.normal(k4, (f, d)) / math.sqrt(f)
-                   ).astype(cfg.dtype),
         }
+        if cfg.n_experts > 0:
+            from .moe import init_moe_params
+            out["moe"] = init_moe_params(_moe_cfg(cfg), k3)
+        else:
+            out.update({
+                "w1": (jax.random.normal(k3, (d, f)) * s
+                       ).astype(cfg.dtype),
+                "b1": jnp.zeros((f,), cfg.dtype),
+                "w2": (jax.random.normal(k4, (f, d)) / math.sqrt(f)
+                       ).astype(cfg.dtype),
+            })
+        return out
 
     return {
         "emb": (jax.random.normal(keys[0], (cfg.vocab, d)) * s
@@ -102,12 +127,20 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 
 
 def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
-    """PartitionSpecs: heads/ffn over tp, everything else replicated."""
+    """PartitionSpecs: heads/ffn over tp; MoE experts over dp (the ep
+    layout — see TransformerConfig); everything else replicated."""
     layer = {
         "ln1": P(), "wqkv": P(None, None, "tp", None),
         "wo": P("tp", None, None), "ln2": P(),
-        "w1": P(None, "tp"), "b1": P("tp"), "w2": P("tp", None),
     }
+    if cfg.n_experts > 0:
+        from .moe import moe_param_specs
+        # experts over dp (ep layout) AND each expert's d_ff over tp —
+        # the MoE output closes with a tp psum like the dense MLP
+        layer["moe"] = moe_param_specs("dp", tp_axis="tp")
+    else:
+        layer.update({"w1": P(None, "tp"), "b1": P("tp"),
+                      "w2": P("tp", None)})
     return {"emb": P(), "ln_f": P(),
             "layers": [dict(layer) for _ in range(cfg.n_layers)]}
 
@@ -143,11 +176,11 @@ def _ln(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
 
-def _block(x, lp, sp_size: int):
+def _block(x, lp, cfg: TransformerConfig, sp_size: int, dp_size: int):
     """One decoder block on a [B/dp, S/sp, D] shard; heads already
     tp-local. The Megatron f/g conjugate pair is implicit: with vma
     tracking on, jax transposes the closing psums and reduces the
-    mixed replicated/partial cotangents itself."""
+    mixed replicated/partial cotangents itself. Returns (x, moe_aux)."""
     h = _ln(x, lp["ln1"])
     q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
     att = ring_attention_sharded(q, k, v, "sp", sp_size, causal=True)
@@ -155,18 +188,28 @@ def _block(x, lp, sp_size: int):
     o = jax.lax.psum(o, "tp")              # Megatron row-parallel close
     x = x + o
     h = _ln(x, lp["ln2"])
+    if "moe" in lp:
+        from .moe import moe_ffn
+        b, s, d = x.shape
+        h, aux = moe_ffn(h.reshape(b * s, d), lp["moe"], _moe_cfg(cfg),
+                         axis="dp", axis_size=dp_size)
+        h = jax.lax.psum(h, "tp")      # experts' d_ff is tp-sharded
+        return x + h.reshape(b, s, d), aux
     h = jax.nn.gelu(h @ lp["w1"] + lp["b1"])
     h = h @ lp["w2"]
     h = jax.lax.psum(h, "tp")
-    return x + h
+    return x + h, jnp.float32(0.0)
 
 
 def _local_loss(params, tokens, targets, cfg: TransformerConfig,
-                sp_size: int):
-    """Shard-local token loss SUM and count (psum'd by the caller)."""
+                sp_size: int, dp_size: int = 1):
+    """Shard-local token loss SUM, count, and MoE aux sum (psum'd by
+    the caller)."""
     x = params["emb"][tokens]              # [B/dp, S/sp, D]
+    aux = jnp.float32(0.0)
     for lp in params["layers"]:
-        x = _block(x, lp, sp_size)
+        x, a = _block(x, lp, cfg, sp_size, dp_size)
+        aux = aux + a
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
     # -log p[target] = logsumexp(row) - logits[target]. The target
@@ -181,7 +224,7 @@ def _local_loss(params, tokens, targets, cfg: TransformerConfig,
     tgt = jnp.einsum("bsd,bsd->bs", x, params["emb"][targets]
                      ).astype(jnp.float32)
     nll = lse - tgt
-    return nll.sum(), nll.size
+    return nll.sum(), nll.size, aux
 
 
 # ---------------------------------------------------------------------------
@@ -202,14 +245,22 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer: Any = None):
     make_opt_state().
     """
     sp_size = mesh.shape["sp"]
+    dp_size = mesh.shape["dp"]
     pspecs = param_specs(cfg)
     data_spec = P("dp", "sp")
 
     def loss_of(params, tokens, targets):
-        s, n = _local_loss(params, tokens, targets, cfg, sp_size)
+        s, n, aux = _local_loss(params, tokens, targets, cfg, sp_size,
+                                dp_size)
         total = jax.lax.psum(s, ("dp", "sp"))
         count = jax.lax.psum(jnp.float32(n), ("dp", "sp"))
-        return total / count
+        loss = total / count
+        if cfg.n_experts > 0:
+            # mean the router load-balance term the same way as the nll
+            aux_m = jax.lax.psum(aux, ("dp", "sp")) / (
+                dp_size * sp_size * cfg.n_layers)
+            loss = loss + cfg.moe_aux_weight * aux_m
+        return loss
 
     # vma (varying-manual-axes) tracking is ON: jax's AD knows each
     # param enters invariant (replicated) over the axes its spec omits,
@@ -263,7 +314,7 @@ def _opt_state_specs(cfg: TransformerConfig, optimizer: Any):
         transform_non_params=lambda _leaf: P())
 
 
-def _block_decode(x, lp, kv, write_at):
+def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig):
     """One decoder block for a single new token position with a KV
     cache. x: [B, 1, D]; kv: (k_cache, v_cache) each [B, Smax, N, H];
     write_at: scalar index. Heads unsharded (single-device decode)."""
@@ -279,6 +330,12 @@ def _block_decode(x, lp, kv, write_at):
     att = jnp.einsum("bnqk,bknh->bqnh", p, vc)
     x = x + jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
     h = _ln(x, lp["ln2"])
+    if "moe" in lp:
+        from .moe import moe_ffn
+        b, s, d = h.shape
+        out, _aux = moe_ffn(h.reshape(b * s, d), lp["moe"],
+                            _moe_cfg(cfg))
+        return x + out.reshape(b, s, d), (kc, vc)
     x = x + jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"]
     return x, (kc, vc)
 
@@ -304,7 +361,7 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         x = params["emb"][tok][:, None, :]            # [B, 1, D]
         new_caches = []
         for lp, kv in zip(params["layers"], caches):
-            x, kv = _block_decode(x, lp, kv, pos)
+            x, kv = _block_decode(x, lp, kv, pos, cfg)
             new_caches.append(kv)
         x = _ln(x, params["ln_f"])
         logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])

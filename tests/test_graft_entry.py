@@ -61,3 +61,4 @@ def test_dryrun_multichip_self_provisions(n):
     assert proc.returncode == 0, proc.stdout
     assert f"dryrun_multichip({n}): ok" in proc.stdout, proc.stdout
     assert "transformer train step" in proc.stdout, proc.stdout
+    assert "MoE train step" in proc.stdout, proc.stdout
