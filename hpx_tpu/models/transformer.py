@@ -333,8 +333,15 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig):
     if "moe" in lp:
         from .moe import moe_ffn
         b, s, d = h.shape
-        out, _aux = moe_ffn(h.reshape(b * s, d), lp["moe"],
-                            _moe_cfg(cfg))
+        # decode routes DROP-FREE (capacity_factor = n_experts makes
+        # C >= every possible claim): with no drops, each token's output
+        # is independent of the rest of the batch — generating a prompt
+        # alone or inside a batch yields identical tokens, and the
+        # serving path never silently zeroes a token the way
+        # capacity-limited training legitimately does
+        mcfg = dataclasses.replace(_moe_cfg(cfg),
+                                   capacity_factor=float(cfg.n_experts))
+        out, _aux = moe_ffn(h.reshape(b * s, d), lp["moe"], mcfg)
         return x + out.reshape(b, s, d), (kc, vc)
     x = x + jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"]
     return x, (kc, vc)

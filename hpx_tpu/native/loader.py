@@ -216,6 +216,82 @@ class NativePool:
             pass
 
 
+# -- Chase-Lev lock-free deque binding --------------------------------------
+
+def _bind_cldeque(lib: ctypes.CDLL) -> None:
+    if getattr(lib, "_cld_bound", False):
+        return
+    for sym in ("hpxrt_cldeque_create", "hpxrt_cldeque_push",
+                "hpxrt_cldeque_take", "hpxrt_cldeque_steal",
+                "hpxrt_cldeque_size", "hpxrt_cldeque_destroy"):
+        if not hasattr(lib, sym):
+            raise RuntimeError(
+                f"libhpx_tpu_rt.so is stale (missing symbol {sym}); "
+                f"rebuild it: make -C {_HERE} clean && make -C {_HERE}")
+    lib.hpxrt_cldeque_create.restype = ctypes.c_void_p
+    lib.hpxrt_cldeque_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.hpxrt_cldeque_take.restype = ctypes.c_void_p
+    lib.hpxrt_cldeque_take.argtypes = [ctypes.c_void_p]
+    lib.hpxrt_cldeque_steal.restype = ctypes.c_void_p
+    lib.hpxrt_cldeque_steal.argtypes = [ctypes.c_void_p]
+    lib.hpxrt_cldeque_size.restype = ctypes.c_long
+    lib.hpxrt_cldeque_size.argtypes = [ctypes.c_void_p]
+    lib.hpxrt_cldeque_destroy.argtypes = [ctypes.c_void_p]
+    lib._cld_bound = True
+
+
+class ChaseLevDeque:
+    """Lock-free work-stealing deque of nonzero ints (C Chase-Lev).
+
+    push()/take() are OWNER-thread operations; steal() may be called
+    from any thread (ctypes releases the GIL during the call, so Python
+    threads genuinely race the lock-free C code). Items are opaque
+    pointer-sized nonzero ints — 0 means empty.
+    """
+
+    def __init__(self) -> None:
+        lib = native_lib()
+        if lib is None:
+            raise RuntimeError("native runtime library unavailable")
+        _bind_cldeque(lib)
+        self._lib = lib
+        self._h = lib.hpxrt_cldeque_create()
+
+    def _handle(self):
+        # a NULL handle would segfault in C, not raise — same guard
+        # discipline as NativePool._shut
+        if self._h is None:
+            raise RuntimeError("deque is closed")
+        return self._h
+
+    def push(self, item: int) -> None:
+        if item == 0:
+            raise ValueError("0 is the empty sentinel")
+        self._lib.hpxrt_cldeque_push(self._handle(), item)
+
+    def take(self) -> Optional[int]:
+        v = self._lib.hpxrt_cldeque_take(self._handle())
+        return None if not v else int(v)
+
+    def steal(self) -> Optional[int]:
+        v = self._lib.hpxrt_cldeque_steal(self._handle())
+        return None if not v else int(v)
+
+    def __len__(self) -> int:
+        return int(self._lib.hpxrt_cldeque_size(self._handle()))
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.hpxrt_cldeque_destroy(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 # -- TCP parcel transport binding -------------------------------------------
 
 _NET_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int,

@@ -1,19 +1,24 @@
-// Native runtime core: work-stealing task scheduler, monotonic timer,
-// atomic counters.
+// Native runtime core: lock-free work-stealing scheduler, monotonic
+// timer, atomic counters.
 //
-// Reference analog: libs/core/schedulers (local_priority_queue_scheduler /
-// abp work stealing) + libs/core/thread_pools (scheduled_thread_pool,
-// scheduling_loop) — re-designed for the TPU-native runtime where host
-// tasks are orchestration (graph building, XLA dispatch, IO callbacks)
-// rather than compute. Tasks enter as C function pointers; the Python
-// binding (hpx_tpu/native/loader.py) provides a trampoline that re-enters
-// the interpreter under the GIL.
+// Reference analog: libs/core/schedulers (local_priority_queue_scheduler
+// / abp work stealing) + libs/core/concurrency (lock-free structures) +
+// libs/core/thread_pools (scheduling_loop) — re-designed for the
+// TPU-native runtime where host tasks are orchestration (graph building,
+// XLA dispatch, IO callbacks) rather than compute. Tasks enter as C
+// function pointers; the Python binding (hpx_tpu/native/loader.py)
+// provides a trampoline that re-enters the interpreter under the GIL.
 //
-// Scheduling discipline (same as the Python fallback pool, so the two are
-// interchangeable behind one interface):
-//   * per-worker deques; owner pops LIFO (hot cache), thieves steal FIFO
-//   * external submits round-robin across queues
-//   * idle workers park on a condition variable
+// Scheduling discipline:
+//   * per-worker LOCK-FREE Chase-Lev deques (Lê et al., "Correct and
+//     Efficient Work-Stealing for Weak Memory Models", PPoPP'13):
+//     owner pushes/takes LIFO at the bottom, thieves CAS-steal FIFO at
+//     the top — no mutex anywhere on the worker hot path
+//   * external (non-worker) submits go to small per-worker mutexed
+//     inboxes — HPX's thread_queue stages "new tasks" the same way —
+//     which workers drain into their own deque
+//   * idle workers park on a condition variable with backoff; producers
+//     only touch it when a racy read shows parked workers
 //   * help_one() lets any thread (incl. a worker blocked on a future)
 //     execute one queued task — the suspension/starvation-safety analog.
 
@@ -33,14 +38,123 @@ typedef void (*hpxrt_task_fn)(void*);
 
 namespace {
 
+// ---------------------------------------------------------------------------
+// Chase-Lev lock-free work-stealing deque of opaque pointers.
+//
+// Single owner thread calls push()/take(); any thread may call steal().
+// The circular buffer grows by doubling; retired buffers are kept until
+// destruction (a stealer may still be reading one — the standard simple
+// reclamation policy; memory is bounded by 2x the high-water mark).
+// ---------------------------------------------------------------------------
+
+class CLDeque {
+ public:
+  explicit CLDeque(int64_t cap = 64) {
+    array_.store(new Buf(cap), std::memory_order_relaxed);
+  }
+
+  ~CLDeque() {
+    delete array_.load(std::memory_order_relaxed);
+    for (Buf* b : retired_) delete b;
+  }
+
+  void push(void* x) {                       // owner only
+    int64_t b = bottom_.load(std::memory_order_relaxed);
+    int64_t t = top_.load(std::memory_order_acquire);
+    Buf* a = array_.load(std::memory_order_relaxed);
+    if (b - t > a->cap - 1) {
+      a = grow(a, t, b);
+    }
+    a->put(b, x);
+    std::atomic_thread_fence(std::memory_order_release);
+    bottom_.store(b + 1, std::memory_order_relaxed);
+  }
+
+  void* take() {                             // owner only
+    int64_t b = bottom_.load(std::memory_order_relaxed) - 1;
+    Buf* a = array_.load(std::memory_order_relaxed);
+    bottom_.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t t = top_.load(std::memory_order_relaxed);
+    void* x = nullptr;
+    if (t <= b) {
+      x = a->get(b);
+      if (t == b) {
+        // last element: race the thieves for it
+        if (!top_.compare_exchange_strong(t, t + 1,
+                                          std::memory_order_seq_cst,
+                                          std::memory_order_relaxed))
+          x = nullptr;
+        bottom_.store(b + 1, std::memory_order_relaxed);
+      }
+    } else {
+      bottom_.store(b + 1, std::memory_order_relaxed);
+    }
+    return x;
+  }
+
+  void* steal() {                            // any thread
+    int64_t t = top_.load(std::memory_order_acquire);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t b = bottom_.load(std::memory_order_acquire);
+    if (t < b) {
+      Buf* a = array_.load(std::memory_order_acquire);
+      void* x = a->get(t);
+      if (!top_.compare_exchange_strong(t, t + 1,
+                                        std::memory_order_seq_cst,
+                                        std::memory_order_relaxed))
+        return nullptr;                      // lost the race: caller retries
+      return x;
+    }
+    return nullptr;
+  }
+
+  int64_t size() const {
+    int64_t b = bottom_.load(std::memory_order_relaxed);
+    int64_t t = top_.load(std::memory_order_relaxed);
+    return b > t ? b - t : 0;
+  }
+
+ private:
+  struct Buf {
+    const int64_t cap;                       // power of two
+    std::unique_ptr<std::atomic<void*>[]> slots;
+    explicit Buf(int64_t c)
+        : cap(c), slots(new std::atomic<void*>[c]) {}
+    void put(int64_t i, void* x) {
+      slots[i & (cap - 1)].store(x, std::memory_order_relaxed);
+    }
+    void* get(int64_t i) {
+      return slots[i & (cap - 1)].load(std::memory_order_relaxed);
+    }
+  };
+
+  Buf* grow(Buf* a, int64_t t, int64_t b) {
+    Buf* na = new Buf(a->cap * 2);
+    for (int64_t i = t; i < b; ++i) na->put(i, a->get(i));
+    retired_.push_back(a);                   // owner-only: no lock needed
+    array_.store(na, std::memory_order_release);
+    return na;
+  }
+
+  std::atomic<int64_t> top_{0};
+  std::atomic<int64_t> bottom_{0};
+  std::atomic<Buf*> array_{nullptr};
+  std::vector<Buf*> retired_;               // owner-managed
+};
+
+// ---------------------------------------------------------------------------
+// pool
+// ---------------------------------------------------------------------------
+
 struct Task {
   hpxrt_task_fn fn;
   void* arg;
 };
 
-struct Queue {
+struct Inbox {                               // external-submit staging
   std::mutex m;
-  std::deque<Task> q;
+  std::deque<Task*> q;
 };
 
 struct Pool;
@@ -48,105 +162,159 @@ thread_local Pool* tls_pool = nullptr;
 thread_local int tls_wid = -1;
 
 struct Pool {
-  std::vector<std::unique_ptr<Queue>> queues;
+  std::vector<std::unique_ptr<CLDeque>> deques;
+  std::vector<std::unique_ptr<Inbox>> inboxes;
   std::vector<std::thread> workers;
   std::mutex cv_m;
   std::condition_variable cv;
-  long pending = 0;  // guarded by cv_m
-  bool shutdown = false;
+  std::atomic<int> idle{0};
+  std::atomic<bool> shutdown{false};
   std::atomic<uint64_t> executed{0};
   std::atomic<uint64_t> stolen{0};
+  std::atomic<long> pending{0};
   std::atomic<unsigned> rr{0};
 
   explicit Pool(int nthreads) {
-    queues.reserve(nthreads);
-    for (int i = 0; i < nthreads; ++i)
-      queues.emplace_back(std::make_unique<Queue>());
+    deques.reserve(nthreads);
+    inboxes.reserve(nthreads);
+    for (int i = 0; i < nthreads; ++i) {
+      deques.emplace_back(std::make_unique<CLDeque>());
+      inboxes.emplace_back(std::make_unique<Inbox>());
+    }
     workers.reserve(nthreads);
     for (int i = 0; i < nthreads; ++i)
       workers.emplace_back([this, i] { worker(i); });
   }
 
-  bool try_pop(int wid, Task* out) {
-    {
-      Queue& mine = *queues[wid];
-      std::lock_guard<std::mutex> lk(mine.m);
-      if (!mine.q.empty()) {
-        *out = mine.q.back();  // own queue: LIFO
-        mine.q.pop_back();
-        return true;
-      }
-    }
-    const int n = static_cast<int>(queues.size());
-    for (int off = 1; off < n; ++off) {
-      Queue& victim = *queues[(wid + off) % n];
-      std::lock_guard<std::mutex> lk(victim.m);
-      if (!victim.q.empty()) {
-        *out = victim.q.front();  // steal: FIFO
-        victim.q.pop_front();
-        stolen.fetch_add(1, std::memory_order_relaxed);
-        return true;
-      }
-    }
-    return false;
+  ~Pool() {
+    // drain leftovers (tasks submitted after/during shutdown)
+    for (auto& d : deques)
+      while (void* x = d->take()) delete static_cast<Task*>(x);
+    for (auto& ib : inboxes)
+      for (Task* t : ib->q) delete t;
   }
 
-  void run_task(const Task& t) {
-    {
-      std::lock_guard<std::mutex> lk(cv_m);
-      --pending;
+  Task* drain_inbox(int wid) {
+    Inbox& ib = *inboxes[wid];
+    std::lock_guard<std::mutex> lk(ib.m);
+    if (ib.q.empty()) return nullptr;
+    Task* t = ib.q.front();
+    ib.q.pop_front();
+    // move the rest into the owner's lock-free deque so subsequent
+    // pops skip the mutex entirely
+    CLDeque& d = *deques[wid];
+    while (!ib.q.empty()) {
+      d.push(ib.q.front());
+      ib.q.pop_front();
     }
-    t.fn(t.arg);  // exceptions cannot cross the C boundary; the Python
-                  // trampoline captures them into futures
+    return t;
+  }
+
+  Task* try_pop(int wid, bool owner) {
+    const int n = static_cast<int>(deques.size());
+    if (owner) {
+      if (void* x = deques[wid]->take()) return static_cast<Task*>(x);
+      if (Task* t = drain_inbox(wid)) return t;
+    }
+    for (int off = owner ? 1 : 0; off < n; ++off) {
+      int vid = (wid + off) % n;
+      if (void* x = deques[vid]->steal()) {
+        stolen.fetch_add(1, std::memory_order_relaxed);
+        return static_cast<Task*>(x);
+      }
+      Inbox& ib = *inboxes[vid];
+      std::unique_lock<std::mutex> lk(ib.m, std::try_to_lock);
+      if (lk.owns_lock() && !ib.q.empty()) {
+        Task* t = ib.q.front();
+        ib.q.pop_front();
+        if (off != 0) stolen.fetch_add(1, std::memory_order_relaxed);
+        return t;
+      }
+    }
+    return nullptr;
+  }
+
+  void run_task(Task* t) {
+    pending.fetch_sub(1, std::memory_order_relaxed);
+    t->fn(t->arg);  // exceptions cannot cross the C boundary; the Python
+                    // trampoline captures them into futures
+    delete t;
     executed.fetch_add(1, std::memory_order_relaxed);
   }
 
   void worker(int wid) {
     tls_pool = this;
     tls_wid = wid;
+    int misses = 0;
     for (;;) {
-      Task t;
-      if (try_pop(wid, &t)) {
+      if (Task* t = try_pop(wid, /*owner=*/true)) {
         run_task(t);
+        misses = 0;
         continue;
       }
-      std::unique_lock<std::mutex> lk(cv_m);
-      cv.wait(lk, [this] { return pending > 0 || shutdown; });
-      if (shutdown && pending == 0) return;
+      if (shutdown.load(std::memory_order_acquire) &&
+          pending.load(std::memory_order_acquire) <= 0)
+        return;
+      if (++misses < 4) {
+        // shallow park: cheap latency for bursty gaps; a submit that
+        // lands here (idle not yet raised) is picked up within ~ms
+        std::unique_lock<std::mutex> lk(cv_m);
+        idle.fetch_add(1, std::memory_order_seq_cst);
+        cv.wait_for(lk, std::chrono::milliseconds(1 << misses));
+        idle.fetch_sub(1, std::memory_order_relaxed);
+      } else {
+        // deep park: INDEFINITE wait, zero idle churn. No lost wakeup:
+        // submit orders pending++ BEFORE its idle check, and we raise
+        // idle (seq_cst) before testing the predicate under the lock —
+        // either submit sees idle>0 and notifies under this mutex, or
+        // the predicate sees pending>0 and skips the wait.
+        std::unique_lock<std::mutex> lk(cv_m);
+        idle.fetch_add(1, std::memory_order_seq_cst);
+        cv.wait(lk, [this] {
+          return pending.load(std::memory_order_acquire) > 0 ||
+                 shutdown.load(std::memory_order_acquire);
+        });
+        idle.fetch_sub(1, std::memory_order_relaxed);
+        misses = 0;
+      }
     }
   }
 
   void submit(hpxrt_task_fn fn, void* arg) {
-    int wid = (tls_pool == this && tls_wid >= 0)
-                  ? tls_wid
-                  : static_cast<int>(rr.fetch_add(1, std::memory_order_relaxed) %
-                                     queues.size());
-    {
-      Queue& q = *queues[wid];
-      std::lock_guard<std::mutex> lk(q.m);
-      q.q.push_back(Task{fn, arg});
+    Task* t = new Task{fn, arg};
+    // seq_cst: must be globally ordered BEFORE the idle check below
+    // (pairs with the deep-park handshake in worker())
+    pending.fetch_add(1, std::memory_order_seq_cst);
+    if (tls_pool == this && tls_wid >= 0) {
+      deques[tls_wid]->push(t);              // owner fast path: lock-free
+    } else {
+      int wid = static_cast<int>(
+          rr.fetch_add(1, std::memory_order_relaxed) % inboxes.size());
+      Inbox& ib = *inboxes[wid];
+      std::lock_guard<std::mutex> lk(ib.m);
+      ib.q.push_back(t);
     }
-    {
+    if (idle.load(std::memory_order_seq_cst) > 0) {
       std::lock_guard<std::mutex> lk(cv_m);
-      ++pending;
+      cv.notify_one();
     }
-    cv.notify_one();
   }
 
   int help_one() {
-    int wid = (tls_pool == this && tls_wid >= 0) ? tls_wid : 0;
-    Task t;
-    if (!try_pop(wid, &t)) return 0;
+    bool owner = (tls_pool == this && tls_wid >= 0);
+    int wid = owner ? tls_wid : 0;
+    Task* t = try_pop(wid, owner);
+    if (!t) return 0;
     run_task(t);
     return 1;
   }
 
   void stop() {
+    shutdown.store(true, std::memory_order_release);
     {
       std::lock_guard<std::mutex> lk(cv_m);
-      shutdown = true;
+      cv.notify_all();
     }
-    cv.notify_all();
     for (auto& w : workers)
       if (w.joinable() && w.get_id() != std::this_thread::get_id()) w.join();
   }
@@ -188,10 +356,32 @@ uint64_t hpxrt_pool_stolen(void* pool) {
 }
 
 long hpxrt_pool_pending(void* pool) {
-  Pool* p = static_cast<Pool*>(pool);
-  std::lock_guard<std::mutex> lk(p->cv_m);
-  return p->pending;
+  long v = static_cast<Pool*>(pool)->pending.load(std::memory_order_relaxed);
+  return v > 0 ? v : 0;
 }
+
+// -- standalone Chase-Lev deque (lock-free structure surface) ---------------
+// Exposed for direct use and stress testing: items are opaque pointers;
+// push/take are OWNER-thread ops, steal is any-thread (ctypes releases
+// the GIL, so Python threads genuinely race these).
+
+void* hpxrt_cldeque_create() { return new CLDeque(); }
+
+void hpxrt_cldeque_push(void* d, void* item) {
+  static_cast<CLDeque*>(d)->push(item);
+}
+
+void* hpxrt_cldeque_take(void* d) { return static_cast<CLDeque*>(d)->take(); }
+
+void* hpxrt_cldeque_steal(void* d) {
+  return static_cast<CLDeque*>(d)->steal();
+}
+
+long hpxrt_cldeque_size(void* d) {
+  return static_cast<long>(static_cast<CLDeque*>(d)->size());
+}
+
+void hpxrt_cldeque_destroy(void* d) { delete static_cast<CLDeque*>(d); }
 
 // -- high-resolution timer (hpx::chrono::high_resolution_timer analog) -----
 

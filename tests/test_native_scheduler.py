@@ -1,0 +1,155 @@
+"""Lock-free native scheduler (native/scheduler.cpp, round 4).
+
+The per-worker queues are Chase-Lev deques (Lê et al. PPoPP'13): the
+owner pushes/takes lock-free at the bottom, thieves CAS-steal at the
+top. Exposed standalone as loader.ChaseLevDeque for direct stress
+testing — ctypes releases the GIL during calls, so the Python threads
+below genuinely race the C code paths.
+"""
+
+import threading
+
+import pytest
+
+from hpx_tpu.native.loader import NativePool, native_lib
+
+pytestmark = pytest.mark.skipif(native_lib() is None,
+                                reason="native library unavailable")
+
+
+def _deque():
+    from hpx_tpu.native.loader import ChaseLevDeque
+    return ChaseLevDeque()
+
+
+class TestCLDeque:
+    def test_owner_lifo_thief_fifo(self):
+        d = _deque()
+        for i in (1, 2, 3):
+            d.push(i)
+        assert len(d) == 3
+        assert d.take() == 3          # owner end: LIFO
+        assert d.steal() == 1         # thief end: FIFO
+        assert d.take() == 2
+        assert d.take() is None
+        assert d.steal() is None
+        d.close()
+
+    def test_growth_past_initial_capacity(self):
+        d = _deque()
+        n = 10_000                    # initial cap 64: multiple doublings
+        for i in range(1, n + 1):
+            d.push(i)
+        assert len(d) == n
+        got = [d.take() for _ in range(n)]
+        assert got == list(range(n, 0, -1))
+        d.close()
+
+    def test_owner_vs_thieves_stress(self):
+        """One owner push/take thread races three stealers; every item
+        must be claimed exactly once, none lost, none duplicated."""
+        import time
+        d = _deque()
+        n = 10_000
+        taken, stolen = [], [[] for _ in range(3)]
+        stop = threading.Event()
+
+        def owner():
+            for i in range(1, n + 1):
+                d.push(i)
+                if i % 3 == 0:        # interleave owner takes
+                    v = d.take()
+                    if v is not None:
+                        taken.append(v)
+            while True:               # drain whatever the thieves left
+                v = d.take()
+                if v is None:
+                    break
+                taken.append(v)
+            stop.set()
+
+        def thief(out):
+            while not stop.is_set() or len(d):
+                v = d.steal()
+                if v is not None:
+                    out.append(v)
+                else:
+                    time.sleep(0)     # yield: don't starve the owner
+
+        ts = [threading.Thread(target=thief, args=(s,)) for s in stolen]
+        ot = threading.Thread(target=owner)
+        for t in ts:
+            t.start()
+        ot.start()
+        ot.join(120)
+        for t in ts:
+            t.join(120)
+        assert not ot.is_alive() and not any(t.is_alive() for t in ts)
+        # post-stop sweep: the owner may have set `stop` between a
+        # thief's steal and its append; steal anything left
+        while True:
+            v = d.steal()
+            if v is None:
+                break
+            taken.append(v)
+        everything = sorted(taken + sum(stolen, []))
+        assert everything == list(range(1, n + 1)), (
+            len(everything), n)
+        d.close()
+
+
+class TestNativePoolLockFree:
+    def test_all_tasks_run_exactly_once(self):
+        p = NativePool(4)
+        n = 20_000
+        hits = []
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def task(i):
+            with lock:
+                hits.append(i)
+                if len(hits) == n:
+                    done.set()
+
+        try:
+            for i in range(n):
+                p.submit(task, i)
+            assert done.wait(60), f"only {len(hits)}/{n} ran"
+            assert sorted(hits) == list(range(n))
+            # `executed` increments AFTER the task body (done.set fires
+            # inside the last body) — give the counter a beat to land
+            import time
+            for _ in range(500):
+                if p.stats()["executed"] >= n:
+                    break
+                time.sleep(0.01)
+            assert p.stats()["executed"] >= n
+        finally:
+            p.shutdown()
+
+    def test_worker_submits_use_owner_fast_path(self):
+        """Tasks that spawn subtasks from INSIDE workers exercise the
+        lock-free owner push/take path (external submits only stage
+        through the inbox)."""
+        p = NativePool(2)
+        total = 1 + 4 + 16
+        count = [0]
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def spawn(depth):
+            with lock:
+                count[0] += 1
+                if count[0] == total:
+                    done.set()
+            if depth < 2:
+                for _ in range(4):
+                    p.submit(spawn, depth + 1)
+
+        try:
+            p.submit(spawn, 0)
+            assert done.wait(60), count[0]
+            assert count[0] == total
+        finally:
+            p.shutdown()

@@ -247,3 +247,16 @@ def test_moe_generate():
     out = tfm.generate(params, MOE_CFG, prompt, max_new=4)
     assert out.shape == (1, 4)
     assert ((out >= 0) & (out < MOE_CFG.vocab)).all()
+
+
+def test_moe_generate_batch_independent():
+    """Serving is drop-free (decode capacity = every claim fits), so a
+    prompt's continuation must not depend on the rest of the batch."""
+    params = tfm.init_params(MOE_CFG, jax.random.PRNGKey(16))
+    p1 = jnp.array([[1, 2, 3]], dtype=jnp.int32)
+    batch = jnp.array([[1, 2, 3], [9, 9, 9], [4, 5, 6], [7, 7, 7]],
+                      dtype=jnp.int32)
+    alone = tfm.generate(params, MOE_CFG, p1, max_new=5)
+    together = tfm.generate(params, MOE_CFG, batch, max_new=5)
+    np.testing.assert_array_equal(np.asarray(alone[0]),
+                                  np.asarray(together[0]))
