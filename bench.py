@@ -293,7 +293,32 @@ def bench_transformer(jax, jnp):
     return per
 
 
+def _probe_device(timeout_s: float = 180.0) -> bool:
+    """Check the accelerator answers at all — in a THROWAWAY subprocess,
+    because a wedged device tunnel hangs jax.devices() forever inside
+    whatever process asks (observed: the axon tunnel went down for hours
+    mid-session). Failing fast with a message beats a silent hang."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, sys; sys.stdout.write(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return proc.returncode == 0 and bool(proc.stdout.strip())
+    except Exception:
+        return False
+
+
 def main() -> None:
+    if not _probe_device():
+        print(json.dumps({
+            "metric": "bench_unavailable", "value": 0, "unit": "none",
+            "vs_baseline": 0,
+            "error": "device tunnel unresponsive (jax.devices() probe "
+                     "timed out in a subprocess); bench not run"}),
+            flush=True)
+        sys.exit(1)
+
     import jax
     import jax.numpy as jnp
 

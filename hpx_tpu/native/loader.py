@@ -256,34 +256,65 @@ class ChaseLevDeque:
         _bind_cldeque(lib)
         self._lib = lib
         self._h = lib.hpxrt_cldeque_create()
+        # close() must not free the C object under a thread that is
+        # INSIDE a (GIL-released) deque call: ops register in-flight
+        # around the call — the C calls themselves still race lock-free
+        # — and close waits for quiescence before destroying.
+        self._cv = threading.Condition()
+        self._inflight = 0
 
-    def _handle(self):
-        # a NULL handle would segfault in C, not raise — same guard
-        # discipline as NativePool._shut
-        if self._h is None:
-            raise RuntimeError("deque is closed")
-        return self._h
+    def _enter(self):
+        with self._cv:
+            if self._h is None:
+                raise RuntimeError("deque is closed")
+            self._inflight += 1
+            return self._h
+
+    def _exit(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._cv.notify_all()
 
     def push(self, item: int) -> None:
         if item == 0:
             raise ValueError("0 is the empty sentinel")
-        self._lib.hpxrt_cldeque_push(self._handle(), item)
+        h = self._enter()
+        try:
+            self._lib.hpxrt_cldeque_push(h, item)
+        finally:
+            self._exit()
 
     def take(self) -> Optional[int]:
-        v = self._lib.hpxrt_cldeque_take(self._handle())
+        h = self._enter()
+        try:
+            v = self._lib.hpxrt_cldeque_take(h)
+        finally:
+            self._exit()
         return None if not v else int(v)
 
     def steal(self) -> Optional[int]:
-        v = self._lib.hpxrt_cldeque_steal(self._handle())
+        h = self._enter()
+        try:
+            v = self._lib.hpxrt_cldeque_steal(h)
+        finally:
+            self._exit()
         return None if not v else int(v)
 
     def __len__(self) -> int:
-        return int(self._lib.hpxrt_cldeque_size(self._handle()))
+        h = self._enter()
+        try:
+            return int(self._lib.hpxrt_cldeque_size(h))
+        finally:
+            self._exit()
 
     def close(self) -> None:
-        if self._h is not None:
-            self._lib.hpxrt_cldeque_destroy(self._h)
-            self._h = None
+        with self._cv:
+            if self._h is None:
+                return
+            self._cv.wait_for(lambda: self._inflight == 0)
+            h, self._h = self._h, None
+        self._lib.hpxrt_cldeque_destroy(h)
 
     def __del__(self) -> None:
         try:

@@ -146,10 +146,27 @@ def _pad_seq(x: jax.Array, pad: int) -> jax.Array:
     return jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
 
 
+def _kv_row_map(q_heads: int, kv_heads: int):
+    """Grid-row remap for grouped-query attention: q row bn = bi*Nq + ni
+    reads K/V row bi*Nkv + ni // (Nq/Nkv). Identity when heads match —
+    GQA costs ONLY this index arithmetic, never a materialized repeat."""
+    if q_heads == kv_heads:
+        return lambda bn: bn
+    group = q_heads // kv_heads
+    return lambda bn: (bn // q_heads) * kv_heads + (bn % q_heads) // group
+
+
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
                     save_res):
     b, sq, n, h = q.shape
     sk = k.shape[1]
+    nkv = k.shape[2]
+    if v.shape[2] != nkv:
+        raise ValueError(f"k heads ({nkv}) != v heads ({v.shape[2]})")
+    if n % nkv:
+        raise ValueError(f"q heads ({n}) not a multiple of kv heads "
+                         f"({nkv})")
+    kv_of = _kv_row_map(n, nkv)
 
     block_q = min(block_q, max(sq, 8))
     block_k = min(block_k, max(sk, 8))
@@ -181,8 +198,10 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
         grid=(b * n, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, h), lambda bn, iq, ik: (bn, iq, 0)),
-            pl.BlockSpec((1, block_k, h), lambda bn, iq, ik: (bn, ik, 0)),
-            pl.BlockSpec((1, block_k, h), lambda bn, iq, ik: (bn, ik, 0)),
+            pl.BlockSpec((1, block_k, h),
+                         lambda bn, iq, ik: (kv_of(bn), ik, 0)),
+            pl.BlockSpec((1, block_k, h),
+                         lambda bn, iq, ik: (kv_of(bn), ik, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -221,6 +240,7 @@ def _fa_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     b, sq, n, h = q.shape
     sk = k.shape[1]
+    nkv = k.shape[2]
     # backward tiles keep four (bq, bk) f32 intermediates live in VMEM
     # (s, p, dp, ds) — cap blocks at 512 so 512x512x4B x4 = 4 MB fits
     bq = min(block_q, 512, max(sq, 8))
@@ -238,14 +258,15 @@ def _fa_bwd(causal, block_q, block_k, interpret, res, g):
 
     dq, dk, dv = flash_attention_bwd(
         qt, kt, vt, dot_, delta128, lse128, sk - sq, causal=causal,
-        block_q=bq, block_k=bk, interpret=interpret, seq_k=sk)
+        block_q=bq, block_k=bk, interpret=interpret, seq_k=sk,
+        q_heads=n, kv_heads=nkv)
 
-    def back(x, s, dtype):
+    def back(x, s, nh, dtype):
         return jnp.moveaxis(
-            x[:, :s].reshape(b, n, s, h), 1, 2).astype(dtype)
+            x[:, :s].reshape(b, nh, s, h), 1, 2).astype(dtype)
 
-    return (back(dq, sq, q.dtype), back(dk, sk, k.dtype),
-            back(dv, sk, v.dtype))
+    return (back(dq, sq, n, q.dtype), back(dk, sk, nkv, k.dtype),
+            back(dv, sk, nkv, v.dtype))
 
 
 _flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -261,6 +282,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     the 128-lane layout's tile for best MXU utilization (64/128).
     Differentiable: jax.custom_vjp routes reverse-mode through the
     pallas backward kernels (flash_attention_bwd).
+
+    GQA/MQA: k/v may carry FEWER heads than q (N % Nkv == 0). K/V tiles
+    are shared across each q-head group via BlockSpec index remapping —
+    no materialized repeat, so the serving-standard grouped layouts get
+    the full KV-bandwidth saving; backward group-sums dK/dV.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -403,18 +429,24 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
                         causal: bool = False, block_q: int = 512,
                         block_k: int = 512,
                         interpret: Optional[bool] = None,
-                        seq_k: Optional[int] = None):
+                        seq_k: Optional[int] = None,
+                        q_heads: int = 1, kv_heads: int = 1):
     """Flash-attention backward in kernel-native layout.
 
-    q/do: [bn, sq, h]; k/v: [bn, sk, h]; delta/lse: [bn, sq, 128] f32,
-    lane-replicated — lse is the forward's row logsumexp, delta is
+    q/do: [bn, sq, h]; k/v: [bn_kv, sk, h]; delta/lse: [bn, sq, 128]
+    f32, lane-replicated — lse is the forward's row logsumexp, delta is
     rowsum(do * o) precomputed once by the caller (one fused XLA pass;
     the kernels never touch o). d: int32 scalar (traced OK) =
     q_global_start - k_global_start, the causal offset. sq/sk must be
     multiples of the block sizes (callers pad; zero-padded do rows and
-    k/v rows contribute exact zeros). Returns (dq [bn,sq,h],
-    dk [bn,sk,h], dv [bn,sk,h]) — float32, so ring steps can accumulate
-    partials without bf16 round-off.
+    k/v rows contribute exact zeros).
+
+    GQA: with q_heads > kv_heads (q rows bn = b*q_heads, k/v rows
+    bn_kv = b*kv_heads), K/V tiles are index-remapped per q row and the
+    per-q-head dK/dV partials are group-summed before returning.
+
+    Returns (dq [bn,sq,h], dk [bn_kv,sk,h], dv [bn_kv,sk,h]) — float32,
+    so ring steps can accumulate partials without bf16 round-off.
     """
     bn, sq, h = q.shape
     sk = k.shape[1]
@@ -431,11 +463,12 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
     scale = 1.0 / math.sqrt(h)
     f32 = jnp.float32
     darr = jnp.asarray([d], jnp.int32).reshape(1)
+    kv_of = _kv_row_map(q_heads, kv_heads)
 
     q_at_iq = pl.BlockSpec((1, block_q, h),
                            lambda bn_, iq, ik, *_: (bn_, iq, 0))
     k_at_ik = pl.BlockSpec((1, block_k, h),
-                           lambda bn_, iq, ik, *_: (bn_, ik, 0))
+                           lambda bn_, iq, ik, *_: (kv_of(bn_), ik, 0))
     l_at_iq = pl.BlockSpec((1, block_q, 128),
                            lambda bn_, iq, ik, *_: (bn_, iq, 0))
 
@@ -459,11 +492,16 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
         interpret=interpret,
     )(darr, q, k, v, do, delta, lse)[0]
 
-    # dk/dv grid: k blocks on the parallel axis, q blocks innermost
+    # dk/dv grid: k blocks on the parallel axis, q blocks innermost.
+    # Outputs are PER Q ROW (bn) — with GQA several q rows share a K/V
+    # row, and overlapping output maps across a parallel grid axis
+    # would race; the group-sum below folds them to per-KV-row grads.
     q_at_iq2 = pl.BlockSpec((1, block_q, h),
                             lambda bn_, ik, iq, *_: (bn_, iq, 0))
-    k_at_ik2 = pl.BlockSpec((1, block_k, h),
-                            lambda bn_, ik, iq, *_: (bn_, ik, 0))
+    kin_at_ik2 = pl.BlockSpec((1, block_k, h),
+                              lambda bn_, ik, iq, *_: (kv_of(bn_), ik, 0))
+    kout_at_ik2 = pl.BlockSpec((1, block_k, h),
+                               lambda bn_, ik, iq, *_: (bn_, ik, 0))
     l_at_iq2 = pl.BlockSpec((1, block_q, 128),
                             lambda bn_, ik, iq, *_: (bn_, iq, 0))
 
@@ -474,9 +512,9 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(bn, nk, nq),
-            in_specs=[q_at_iq2, k_at_ik2, k_at_ik2, q_at_iq2, l_at_iq2,
-                      l_at_iq2],
-            out_specs=[k_at_ik2, k_at_ik2],
+            in_specs=[q_at_iq2, kin_at_ik2, kin_at_ik2, q_at_iq2,
+                      l_at_iq2, l_at_iq2],
+            out_specs=[kout_at_ik2, kout_at_ik2],
             scratch_shapes=[
                 pltpu.VMEM((block_k, h), f32),
                 pltpu.VMEM((block_k, h), f32),
@@ -489,6 +527,13 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
         interpret=interpret,
     )(darr, q, k, v, do, delta, lse)
 
+    if q_heads != kv_heads:
+        group = q_heads // kv_heads
+        b = bn // q_heads
+        dk = dk.reshape(b, kv_heads, group, sk, h).sum(axis=2)
+        dk = dk.reshape(b * kv_heads, sk, h)
+        dv = dv.reshape(b, kv_heads, group, sk, h).sum(axis=2)
+        dv = dv.reshape(b * kv_heads, sk, h)
     return dq, dk, dv
 
 
