@@ -30,11 +30,13 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import ring_attention_sharded
+from ..ops.attention import auto_attention, ring_attention_sharded
 
 __all__ = ["TransformerConfig", "init_params", "make_train_step",
            "make_mesh_3d", "shard_params", "shard_batch", "sample_batch",
-           "make_opt_state", "generate"]
+           "make_opt_state", "generate", "make_pipelined_train_step",
+           "stack_pipeline_params", "shard_pipeline_params",
+           "pipelined_param_specs"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -201,6 +203,27 @@ def _block(x, lp, cfg: TransformerConfig, sp_size: int, dp_size: int):
     return x + h, jnp.float32(0.0)
 
 
+def _nll_head(params, x, targets):
+    """ln_f + tied-embedding loss head on a [B, S, D] shard; returns
+    (nll_sum, count).
+
+    -log p[target] = logsumexp(row) - logits[target]. The target
+    logit is recomputed as a row-wise dot against the gathered
+    embedding instead of take_along_axis over the [B,S,V] tensor —
+    the full-vocab array feeds ONLY the logsumexp reduction (which
+    XLA fuses into the matmul consumer), saving a GB-scale gather
+    read per step at V=32k. The dot runs in the logits' dtype so both
+    terms see the same rounding (a f32 recompute against bf16 logits
+    would make near-deterministic tokens go slightly negative)."""
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.einsum("bsd,bsd->bs", x, params["emb"][targets]
+                     ).astype(jnp.float32)
+    nll = lse - tgt
+    return nll.sum(), nll.size
+
+
 def _local_loss(params, tokens, targets, cfg: TransformerConfig,
                 sp_size: int, dp_size: int = 1):
     """Shard-local token loss SUM, count, and MoE aux sum (psum'd by
@@ -210,21 +233,8 @@ def _local_loss(params, tokens, targets, cfg: TransformerConfig,
     for lp in params["layers"]:
         x, a = _block(x, lp, cfg, sp_size, dp_size)
         aux = aux + a
-    x = _ln(x, params["ln_f"])
-    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-    # -log p[target] = logsumexp(row) - logits[target]. The target
-    # logit is recomputed as a row-wise dot against the gathered
-    # embedding instead of take_along_axis over the [B,S,V] tensor —
-    # the full-vocab array feeds ONLY the logsumexp reduction (which
-    # XLA fuses into the matmul consumer), saving a GB-scale gather
-    # read per step at V=32k. The dot runs in the logits' dtype so both
-    # terms see the same rounding (a f32 recompute against bf16 logits
-    # would make near-deterministic tokens go slightly negative).
-    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-    tgt = jnp.einsum("bsd,bsd->bs", x, params["emb"][targets]
-                     ).astype(jnp.float32)
-    nll = lse - tgt
-    return nll.sum(), nll.size, aux
+    s, n = _nll_head(params, x, targets)
+    return s, n, aux
 
 
 # ---------------------------------------------------------------------------
@@ -312,6 +322,152 @@ def _opt_state_specs(cfg: TransformerConfig, optimizer: Any):
     return optax.tree_map_params(
         optimizer, lambda _leaf, spec: spec, state_shape, pspecs,
         transform_non_params=lambda _leaf: P())
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel training step (the pp axis, in one sharded program)
+# ---------------------------------------------------------------------------
+
+def stack_pipeline_params(params) -> Dict[str, Any]:
+    """Restack the per-layer param list into leading-axis arrays so the
+    layer dimension can shard over the "pp" mesh axis (each stage holds
+    n_layers/pp layers and scans over them locally)."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params["layers"])
+    return {"emb": params["emb"], "ln_f": params["ln_f"],
+            "layers": stacked}
+
+
+def pipelined_param_specs(cfg: TransformerConfig,
+                          tp_axis: Optional[str] = None) -> Dict[str, Any]:
+    """Specs for stacked params: layer axis over "pp", heads/ffn over
+    tp (when present), embedding/final-norm replicated."""
+    t = tp_axis
+    layer = {
+        "ln1": P("pp", None),
+        "wqkv": P("pp", None, None, t, None),
+        "wo": P("pp", t, None, None),
+        "ln2": P("pp", None),
+        "w1": P("pp", None, t),
+        "b1": P("pp", t),
+        "w2": P("pp", t, None),
+    }
+    return {"emb": P(), "ln_f": P(), "layers": layer}
+
+
+def shard_pipeline_params(stacked, cfg: TransformerConfig, mesh):
+    from jax.sharding import NamedSharding
+    tp_axis = "tp" if "tp" in mesh.axis_names else None
+    specs = pipelined_param_specs(cfg, tp_axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        stacked, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def _pp_block(x, lp, cfg: TransformerConfig, tp_axis: Optional[str]):
+    """One decoder block on a [mb, S, D] microbatch shard inside the
+    pipeline: attention is sequence-LOCAL (auto_attention — flash on
+    TPU; the sp ring belongs to the dp x sp x tp step), heads/ffn
+    tp-sharded when a tp axis exists."""
+    h = _ln(x, lp["ln1"])
+    q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+    att = auto_attention(q, k, v, causal=True)
+    o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
+    if tp_axis:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
+    h = _ln(x, lp["ln2"])
+    h = jax.nn.gelu(h @ lp["w1"] + lp["b1"])
+    h = h @ lp["w2"]
+    if tp_axis:
+        h = jax.lax.psum(h, tp_axis)
+    return x + h
+
+
+def make_pipelined_train_step(cfg: TransformerConfig, mesh,
+                              n_microbatches: int):
+    """Train step with pipeline parallelism INSIDE the jitted program:
+    layers shard over the mesh's "pp" axis (stacked leading dim),
+    microbatches hand off stage-to-stage via one lax.ppermute hop per
+    scan step (parallel/pipeline_spmd.pipeline_run), batch shards over
+    "dp", heads/ffn over "tp" when present. AD through the scan IS the
+    backward pipeline (ppermute transposes to the inverse rotation).
+
+    Params must be in the STACKED layout (stack_pipeline_params +
+    shard_pipeline_params). step(params, tokens, targets) ->
+    (params, loss) with plain-SGD update, matching make_train_step's
+    optimizer=None contract.
+
+    The loss head runs on every stage every step with non-last stages
+    masked to zero — wasted V x D FLOPs on P-1 stages that a
+    production run would hoist behind a pp-uniform lax.cond; kept
+    branch-free here for AD robustness. MoE configs take the dp/ep
+    step instead (expert all_to_all inside a pipeline stage would
+    deadlock against the pp ppermute schedule if capacity buffers
+    ever shard over dp x pp jointly).
+    """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "pipeline-parallel MoE is not supported; use make_train_step "
+            "with the dp/ep layout")
+    from ..parallel.pipeline_spmd import pipeline_run
+    from ..ops.attention import _pvary
+
+    axes = mesh.axis_names
+    if "pp" not in axes or "dp" not in axes:
+        raise ValueError(f"mesh must carry ('dp', 'pp'); has {axes}")
+    tp_axis = "tp" if "tp" in axes else None
+    pp, dp = mesh.shape["pp"], mesh.shape["dp"]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"pp={pp}")
+    M = n_microbatches
+    pspecs = pipelined_param_specs(cfg, tp_axis)
+    data_spec = P("dp", None)
+
+    def loss_of(params, tokens, targets):
+        bl, s = tokens.shape
+        if bl % M:
+            raise ValueError(f"per-dp-shard batch {bl} not divisible "
+                             f"by n_microbatches={M}")
+        mb = bl // M
+        toks = tokens.reshape(M, mb, s)
+        tgts = targets.reshape(M, mb, s)
+
+        def stage_fn(x):
+            block = jax.checkpoint(
+                lambda x, lp: _pp_block(x, lp, cfg, tp_axis))
+            x, _ = jax.lax.scan(
+                lambda x, lp: (block(x, lp), None), x, params["layers"])
+            return x
+
+        def feed(t):
+            return params["emb"][toks[t]].astype(cfg.dtype)
+
+        def collect(acc, y, t_out, valid):
+            ls, cnt = acc
+            ssum, n = _nll_head(params, y, tgts[t_out])
+            w = valid.astype(jnp.float32)
+            return (ls + w * ssum, cnt + w * jnp.float32(n))
+
+        vary = ("dp", "pp")
+        x0 = _pvary(jnp.zeros((mb, s, cfg.d_model), cfg.dtype), vary)
+        acc0 = (_pvary(jnp.float32(0.0), vary),
+                _pvary(jnp.float32(0.0), vary))
+        ls, cnt = pipeline_run("pp", pp, M, stage_fn, feed, collect,
+                               acc0, x0)
+        return jax.lax.psum(ls, ("dp", "pp")) / jax.lax.psum(
+            cnt, ("dp", "pp"))
+
+    def step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_of)(params, tokens, targets)
+        new_params = jax.tree.map(
+            lambda p, g: p - cfg.lr * g.astype(p.dtype), params, grads)
+        return new_params, loss
+
+    return jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, data_spec, data_spec),
+        out_specs=(pspecs, P())))
 
 
 def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig):

@@ -1,0 +1,88 @@
+"""In-jit (SPMD) pipeline parallelism over a mesh axis.
+
+parallel/pipeline.py runs each stage as its own jitted program on its
+own device and lets XLA's async dispatch overlap them — host futures
+ARE the schedule (the HPX dataflow-pipeline pattern, SURVEY.md §2.9 PP
+row). This module is the compiler-side counterpart for when the
+pipeline must live INSIDE one jitted multi-chip program so it composes
+with dp/tp axes and rides ICI: stage parameters are stacked on a
+leading axis sharded over the "pp" mesh axis, microbatches march
+through a lax.scan, and the stage-to-stage handoff is one lax.ppermute
+hop per step — the GPipe schedule expressed as data movement.
+
+Schedule shape: with P stages and M microbatches the scan runs
+T = M + P - 1 steps. At step t, stage 0 feeds microbatch min(t, M-1)
+(clamped re-feeds are computed and discarded — every device runs the
+same program), stage p processes what stage p-1 produced at t-1, and
+stage P-1 emits microbatch t-(P-1) once t >= P-1. The fill/drain
+bubble is the standard GPipe (P-1)/(M+P-1) fraction.
+
+Differentiation: reverse-mode AD transposes the scan (reversed steps)
+and each ppermute (inverse rotation), which IS the backward pipeline —
+cotangents drain stage P-1 -> 0 in reverse schedule order. No
+hand-written backward schedule exists or is needed; memory follows
+GPipe (live activations for all in-flight microbatches), mitigated by
+jax.checkpoint around the stage body (the caller's choice).
+
+vma note (newer jax tracks varying-manual-axes): the scan carry's vma
+set must match the stepped values'. `x0` and `acc0` must therefore be
+pvaried over every axis the in-flight activation/accumulator varies on
+(typically ("dp", "pp")) before calling pipeline_run — see
+ops.attention._pvary and models/transformer.make_pipelined_train_step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pipeline_run"]
+
+
+def pipeline_run(axis: str, n_stages: int, n_microbatches: int,
+                 stage_fn: Callable[[Any], Any],
+                 feed: Callable[[jax.Array], Any],
+                 collect: Callable[[Any, Any, jax.Array, jax.Array], Any],
+                 acc0: Any, x0: Any) -> Any:
+    """March n_microbatches through the pp stages; runs INSIDE an
+    enclosing shard_map whose mesh carries `axis`.
+
+    stage_fn(x) -> y        this device's stage (its slice of the
+                            stacked layers), applied every step
+    feed(t) -> x            microbatch t's entry activation (t is a
+                            traced scalar already clamped to [0, M-1]);
+                            only stage 0's result is consumed
+    collect(acc, y, t_out, valid) -> acc
+                            fold stage P-1's step output into the
+                            accumulator; `valid` is a traced bool that
+                            is True only on the last stage once real
+                            output emerges (mask with it — do NOT
+                            branch on it)
+    acc0, x0                initial accumulator and in-flight
+                            activation (zeros_like the stage output),
+                            pvaried to the carry's vma (see module
+                            docstring)
+    """
+    P, M = n_stages, n_microbatches
+    idx = jax.lax.axis_index(axis)
+    perm = [(i, i + 1) for i in range(P - 1)]
+
+    def step(carry, t):
+        x_recv, acc = carry
+        x_first = feed(jnp.clip(t, 0, M - 1))
+        x_in = jax.tree.map(
+            lambda a, b: jnp.where(idx == 0, a, b), x_first, x_recv)
+        y = stage_fn(x_in)
+        t_out = jnp.clip(t - (P - 1), 0, M - 1)
+        valid = jnp.logical_and(idx == P - 1, t >= P - 1)
+        acc = collect(acc, y, t_out, valid)
+        # stage p -> p+1; stage 0 receives zeros (it feeds itself),
+        # stage P-1's send has no target (its output was collected)
+        x_send = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis, perm), y)
+        return (x_send, acc), None
+
+    (_, acc), _ = jax.lax.scan(step, (x0, acc0), jnp.arange(M + P - 1))
+    return acc
