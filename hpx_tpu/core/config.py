@@ -36,6 +36,9 @@ DEFAULTS: Dict[str, str] = {
     "hpx.stacks.small_size": "0",         # no stackful coroutines on host
     "hpx.parcel.enable": "1",
     "hpx.parcel.port": "7910",
+    # generous: fresh interpreters on a loaded one-core host take tens
+    # of seconds to boot; ini/env/CLI can lower it to fail fast
+    "hpx.startup_timeout": "120",
     "hpx.parcel.address": "127.0.0.1",
     "hpx.parcel.bootstrap": "tcp",
     "hpx.parcel.max_message_size": str(1 << 30),

@@ -147,13 +147,15 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
             "layers": [dict(layer) for _ in range(cfg.n_layers)]}
 
 
-def shard_params(params, cfg: TransformerConfig, mesh):
+def _place(tree, specs, mesh):
     from jax.sharding import NamedSharding
-    specs = param_specs(cfg)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs,
-        is_leaf=lambda x: isinstance(x, P))
+        tree, specs)
+
+
+def shard_params(params, cfg: TransformerConfig, mesh):
+    return _place(params, param_specs(cfg), mesh)
 
 
 def shard_batch(tokens, targets, mesh):
@@ -337,10 +339,10 @@ def stack_pipeline_params(params) -> Dict[str, Any]:
             "layers": stacked}
 
 
-def pipelined_param_specs(cfg: TransformerConfig,
-                          tp_axis: Optional[str] = None) -> Dict[str, Any]:
+def pipelined_param_specs(tp_axis: Optional[str] = None) -> Dict[str, Any]:
     """Specs for stacked params: layer axis over "pp", heads/ffn over
-    tp (when present), embedding/final-norm replicated."""
+    tp (when present), embedding/final-norm replicated. (Dense blocks
+    only — make_pipelined_train_step rejects MoE configs.)"""
     t = tp_axis
     layer = {
         "ln1": P("pp", None),
@@ -354,13 +356,9 @@ def pipelined_param_specs(cfg: TransformerConfig,
     return {"emb": P(), "ln_f": P(), "layers": layer}
 
 
-def shard_pipeline_params(stacked, cfg: TransformerConfig, mesh):
-    from jax.sharding import NamedSharding
+def shard_pipeline_params(stacked, mesh):
     tp_axis = "tp" if "tp" in mesh.axis_names else None
-    specs = pipelined_param_specs(cfg, tp_axis)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        stacked, specs, is_leaf=lambda x: isinstance(x, P))
+    return _place(stacked, pipelined_param_specs(tp_axis), mesh)
 
 
 def _pp_block(x, lp, cfg: TransformerConfig, tp_axis: Optional[str]):
@@ -421,7 +419,7 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"pp={pp}")
     M = n_microbatches
-    pspecs = pipelined_param_specs(cfg, tp_axis)
+    pspecs = pipelined_param_specs(tp_axis)
     data_spec = P("dp", None)
 
     def loss_of(params, tokens, targets):

@@ -48,11 +48,6 @@ def launch(script: str, script_args: List[str], localities: int,
         env["HPX_TPU_LOCALITIES"] = str(localities)
         env["HPX_TPU_PARCEL__PORT"] = str(port)
         env["HPX_TPU_PARCEL__SECRET"] = secret
-        # On a loaded host (e.g. the full test suite on one core) fresh
-        # interpreters can take tens of seconds to reach _bootstrap; the
-        # 30 s default then kills one locality and cascades into
-        # send-to-peer failures in the rest. Keep explicit settings.
-        env.setdefault("HPX_TPU_STARTUP_TIMEOUT", "120")
         if threads:
             env["HPX_TPU_OS_THREADS"] = str(threads)
         if jax_platform:

@@ -39,6 +39,7 @@ def run_distributed(name, localities, timeout=240):
     ("jacobi2d.py", ["64", "4", "6"]),
     ("ring_attention_demo.py", ["128"]),
     ("checkpointed_stencil.py", ["128", "4", "8"]),
+    ("fft_distributed.py", ["12", "14"]),
 ])
 def test_example_single(name, args):
     r = run_example(name, *args)

@@ -38,7 +38,7 @@ def _pipelined_step(toks, tgts, mesh, n_microbatches):
     from jax.sharding import NamedSharding, PartitionSpec as P
     params = tfm.init_params(CFG, jax.random.PRNGKey(0))
     stacked = tfm.stack_pipeline_params(params)
-    stacked = tfm.shard_pipeline_params(stacked, CFG, mesh)
+    stacked = tfm.shard_pipeline_params(stacked, mesh)
     step = tfm.make_pipelined_train_step(CFG, mesh, n_microbatches)
     sh = NamedSharding(mesh, P("dp", None))
     t = jax.device_put(toks, sh)
@@ -79,7 +79,7 @@ def test_pp_trains(devices):
     from jax.sharding import NamedSharding, PartitionSpec as P
     params = tfm.stack_pipeline_params(
         tfm.init_params(CFG, jax.random.PRNGKey(0)))
-    params = tfm.shard_pipeline_params(params, CFG, mesh)
+    params = tfm.shard_pipeline_params(params, mesh)
     step = tfm.make_pipelined_train_step(CFG, mesh, 4)
     sh = NamedSharding(mesh, P("dp", None))
     t, g = jax.device_put(toks, sh), jax.device_put(tgts, sh)
