@@ -128,6 +128,39 @@ def bench_jacobi(ndev: int, devices) -> None:
           mcells=round(cells / 1e6, 1))
 
 
+def bench_fft(ndev: int, devices) -> None:
+    """Distributed 1-D FFT (four-step, three all_to_alls) — the
+    collectives workload HPX's published FFT study measures; weak
+    scaling at 2^18 points/device."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hpx_tpu.algo import fft as dfft
+    from hpx_tpu.parallel import make_mesh
+
+    mesh = make_mesh((ndev,), ("x",), devices[:ndev])
+    # n = P^2 * m always satisfies the four-step factorability (P | n1
+    # and P | n2); m sized for ~2^18 points per device
+    n = ndev * ndev * max(1, (1 << 18) // ndev)
+    rng = np.random.default_rng(1)
+    v = jax.device_put(
+        jnp.asarray((rng.standard_normal(n) + 1j * rng.standard_normal(n)
+                     ).astype(np.complex64)),
+        NamedSharding(mesh, P("x")))
+
+    def run():
+        return dfft.fft_sharded(v, mesh)
+
+    per = _time_loop(run, iters=5)
+    gflops = 5 * n * math.log2(n) / per / 1e9
+    _emit(metric="fft_1d", n_devices=ndev, n=n,
+          gflops=round(gflops, 2), ms=round(per * 1e3, 3))
+
+
 def sweep(max_devices: int) -> None:
     import jax
     devs = jax.devices()
@@ -148,6 +181,7 @@ def sweep(max_devices: int) -> None:
         bench_pv_triad(k, devs)
         bench_all_reduce(k, devs)
         bench_jacobi(k, devs)
+        bench_fft(k, devs)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,111 @@
+"""Pipeline-parallel training two ways.
+
+Reference analog: HPX expresses pipelines as dataflow chains with
+channel handoff (SURVEY.md §2.9 PP row). This demo trains the same
+tiny transformer with BOTH TPU-native forms and checks they agree:
+
+  1. host-driven (parallel/pipeline.py): each stage is its own jitted
+     program on its own device; XLA async dispatch overlaps stages —
+     the futures ARE the schedule;
+  2. in-jit SPMD (parallel/pipeline_spmd.py via
+     models/transformer.make_pipelined_train_step): layers stacked
+     over the "pp" mesh axis, one ppermute hop per scan step, backward
+     is AD through the scan.
+
+Usage: python examples/pipeline_train.py [steps] [--cpu-mesh N]
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+argv = setup_platform()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import hpx_tpu.models.transformer as tfm  # noqa: E402
+
+
+def main() -> int:
+    steps = int(argv[0]) if argv else 6
+    devs = jax.devices()
+    ndev = len(devs)
+    pp = 4 if ndev % 4 == 0 else (2 if ndev % 2 == 0 else 1)
+    dp = 2 if (ndev // pp) % 2 == 0 else 1
+    mesh = Mesh(np.array(devs[:dp * pp]).reshape(dp, pp), ("dp", "pp"))
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                head_dim=8, n_layers=2 * pp, d_ff=64,
+                                lr=0.05)
+    toks, tgts = tfm.sample_batch(cfg, batch=4 * dp, seq=16,
+                                  key=jax.random.PRNGKey(1))
+
+    # -- in-jit SPMD pipeline -------------------------------------------
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    stacked = tfm.shard_pipeline_params(
+        tfm.stack_pipeline_params(params), mesh)
+    step = tfm.make_pipelined_train_step(cfg, mesh, n_microbatches=2)
+    sh = NamedSharding(mesh, P("dp", None))
+    t, g = jax.device_put(toks, sh), jax.device_put(tgts, sh)
+    losses = []
+    for _ in range(steps):
+        stacked, loss = step(stacked, t, g)
+        losses.append(float(loss))
+    # loss AT the final params (each step reports pre-update loss)
+    _ignored, final_loss = step(stacked, t, g)
+    final_loss = float(final_loss)
+    print(f"in-jit pp (dp={dp}, pp={pp}, M=2): "
+          f"{losses[0]:.4f} -> {final_loss:.4f}")
+
+    # -- host-driven pipeline (inference of the trained model) ----------
+    from hpx_tpu.parallel.pipeline import Pipeline
+
+    # stage s = layers [s*2, s*2+2); embed/head folded into first/last
+    host_params = jax.device_get(stacked)
+
+    def mk_stage(lo, hi, first, last):
+        def fn(sp, x):
+            if first:
+                x = sp["emb"][x.astype(jnp.int32)]
+            for i in range(hi - lo):
+                lp = jax.tree.map(lambda a, i=i: a[i], sp["layers"])
+                x = tfm._pp_block(x, lp, cfg, None)
+            if last:
+                x = tfm._ln(x, sp["ln_f"])
+                x = jnp.einsum("bsd,vd->bsv", x, sp["emb"])
+            return x
+        return fn
+
+    per = cfg.n_layers // pp
+    stage_defs = []
+    for s in range(pp):
+        sp = {"layers": jax.tree.map(
+            lambda a, s=s: a[s * per:(s + 1) * per], host_params["layers"])}
+        if s == 0:
+            sp["emb"] = host_params["emb"]
+        if s == pp - 1:
+            sp["emb"] = host_params["emb"]
+            sp["ln_f"] = host_params["ln_f"]
+        stage_defs.append((mk_stage(s * per, (s + 1) * per, s == 0,
+                                    s == pp - 1), sp))
+    pipe = Pipeline(stage_defs, devices=devs[:pp])
+    mbs = [toks[i:i + 2] for i in range(0, toks.shape[0], 2)]
+    outs = pipe.forward(mbs)
+    logits = jnp.concatenate([jnp.asarray(o) for o in outs])
+
+    # cross-check: host pipeline logits match a direct forward
+    nll = -jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    ce = float(jnp.take_along_axis(nll, tgts[..., None], -1).mean())
+    print(f"host pipeline CE of trained model: {ce:.4f} "
+          f"(in-jit loss at same params {final_loss:.4f})")
+    ok = final_loss < losses[0] and abs(ce - final_loss) < 1e-3
+    print("OK" if ok else "MISMATCH")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@ adjacent_find = _seg(_sc.adjacent_find)
 
 # -- sorting / permutations --------------------------------------------------
 sort = _seg(_so.sort, preserves_shape=True)
+sort_sharded = _so.sort_sharded        # explicit distributed surface
 stable_sort = _seg(_so.stable_sort, preserves_shape=True)
 is_sorted = _seg(_so.is_sorted)
 merge = _seg(_so.merge)
@@ -79,6 +80,6 @@ __all__ = [
     "minmax_element", "equal", "mismatch", "find", "find_if",
     "inclusive_scan", "exclusive_scan", "transform_inclusive_scan",
     "transform_exclusive_scan", "adjacent_difference", "adjacent_find",
-    "sort", "stable_sort", "is_sorted", "merge", "reverse", "rotate",
-    "unique", "partition",
+    "sort", "sort_sharded", "stable_sort", "is_sorted", "merge",
+    "reverse", "rotate", "unique", "partition",
 ]

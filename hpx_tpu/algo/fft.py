@@ -36,7 +36,7 @@ import functools
 import math
 from typing import Any, Optional, Tuple
 
-__all__ = ["fft2_sharded", "ifft2_sharded", "fft_sharded",
+__all__ = ["fft", "ifft", "fft2_sharded", "ifft2_sharded", "fft_sharded",
            "ifft_sharded", "fft2_body", "fft1d_body"]
 
 
@@ -181,3 +181,29 @@ def fft_sharded(v: Any, mesh, axis: str = "x", inverse: bool = False):
 
 def ifft_sharded(v: Any, mesh, axis: str = "x"):
     return fft_sharded(v, mesh, axis, inverse=True)
+
+
+def fft(v: Any, mesh=None, axis: str = "x", inverse: bool = False):
+    """Front door: a sharded jax.Array (pass mesh) or a
+    PartitionedVector (its layout carries mesh + axis) — the segmented-
+    algorithm pattern (algo/__init__) applied to the FFT."""
+    from ..containers.partitioned_vector import PartitionedVector
+    if isinstance(v, PartitionedVector):
+        if mesh is not None and mesh is not v.mesh:
+            raise ValueError(
+                "fft(pv, mesh=...): the layout's mesh governs; drop the "
+                "mesh argument or pass the plain sharded array")
+        if v.data.shape[0] != v.size:
+            raise ValueError(
+                f"fft over a padded partitioned_vector (size {v.size}, "
+                f"padded {v.data.shape[0]}): resize so the axis divides "
+                f"the length")
+        out = fft_sharded(v.data, v.mesh, v.layout.axis, inverse)
+        return PartitionedVector.from_array(out, layout=v.layout)
+    if mesh is None:
+        raise ValueError("pass mesh= for a plain sharded array")
+    return fft_sharded(v, mesh, axis, inverse)
+
+
+def ifft(v: Any, mesh=None, axis: str = "x"):
+    return fft(v, mesh, axis, inverse=True)

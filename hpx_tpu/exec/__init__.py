@@ -29,3 +29,4 @@ from .policies import (  # noqa: F401
 )
 from .tpu import Target, TpuExecutor, default_target, get_future, get_targets  # noqa: F401
 from . import p2300  # noqa: F401
+from .execution_base import AgentRef, this_task, yield_while  # noqa: F401

@@ -381,8 +381,37 @@ def _pp_block(x, lp, cfg: TransformerConfig, tp_axis: Optional[str]):
     return x + h
 
 
+def _pipelined_opt_state_specs(cfg: TransformerConfig, optimizer: Any,
+                               tp_axis: Optional[str]):
+    """Opt-state specs for the STACKED layout (mirrors
+    _opt_state_specs: param-shaped moments take the param's spec)."""
+    import optax
+    stacked = jax.eval_shape(
+        lambda: stack_pipeline_params(
+            init_params(cfg, jax.random.PRNGKey(0))))
+    state_shape = jax.eval_shape(lambda p: optimizer.init(p), stacked)
+    pspecs = pipelined_param_specs(tp_axis)
+    return optax.tree_map_params(
+        optimizer, lambda _leaf, spec: spec, state_shape, pspecs,
+        transform_non_params=lambda _leaf: P())
+
+
+def make_pipelined_opt_state(stacked, cfg: TransformerConfig, mesh,
+                             optimizer: Any):
+    """optimizer.init under jit with shardings matching the stacked
+    layout (moments pp/tp-sharded like their weights)."""
+    from jax.sharding import NamedSharding
+    tp_axis = "tp" if "tp" in mesh.axis_names else None
+    ospecs = _pipelined_opt_state_specs(cfg, optimizer, tp_axis)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), ospecs,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(optimizer.init, out_shardings=shardings)(stacked)
+
+
 def make_pipelined_train_step(cfg: TransformerConfig, mesh,
-                              n_microbatches: int):
+                              n_microbatches: int,
+                              optimizer: Any = None):
     """Train step with pipeline parallelism INSIDE the jitted program:
     layers shard over the mesh's "pp" axis (stacked leading dim),
     microbatches hand off stage-to-stage via one lax.ppermute hop per
@@ -456,22 +485,42 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
         return jax.lax.psum(ls, ("dp", "pp")) / jax.lax.psum(
             cnt, ("dp", "pp"))
 
-    def step(params, tokens, targets):
+    if optimizer is None:
+        def step(params, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_of)(
+                params, tokens, targets)
+            new_params = jax.tree.map(
+                lambda p, g: p - cfg.lr * g.astype(p.dtype), params, grads)
+            return new_params, loss
+
+        return jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(pspecs, data_spec, data_spec),
+            out_specs=(pspecs, P())))
+
+    ospecs = _pipelined_opt_state_specs(cfg, optimizer, tp_axis)
+
+    def step_opt(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_of)(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
         new_params = jax.tree.map(
-            lambda p, g: p - cfg.lr * g.astype(p.dtype), params, grads)
-        return new_params, loss
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        return new_params, opt_state, loss
 
     return jax.jit(shard_map(
-        step, mesh=mesh,
-        in_specs=(pspecs, data_spec, data_spec),
-        out_specs=(pspecs, P())))
+        step_opt, mesh=mesh,
+        in_specs=(pspecs, ospecs, data_spec, data_spec),
+        out_specs=(pspecs, ospecs, P())))
 
 
-def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig):
+def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
+                  tp_axis: Optional[str] = None):
     """One decoder block for a single new token position with a KV
-    cache. x: [B, 1, D]; kv: (k_cache, v_cache) each [B, Smax, N, H];
-    write_at: scalar index. Heads unsharded (single-device decode)."""
+    cache. x: [B, 1, D]; kv: (k_cache, v_cache) each [B, Smax, N, H]
+    (N = the tp-LOCAL head count under sharded decode); write_at:
+    scalar index. With tp_axis set, the wo/w2 contractions close with
+    a psum — the same Megatron split the train step uses, so the KV
+    cache shards over heads and never replicates."""
     kc, vc = kv
     h = _ln(x, lp["ln1"])
     q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
@@ -482,7 +531,10 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig):
     s = jnp.where(pos[None, None, None, :] <= write_at, s, -jnp.inf)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
     att = jnp.einsum("bnqk,bknh->bqnh", p, vc)
-    x = x + jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
+    o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
+    if tp_axis:
+        o = jax.lax.psum(o, tp_axis)
+    x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
         from .moe import moe_ffn
@@ -497,46 +549,77 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig):
                                    capacity_factor=float(cfg.n_experts))
         out, _aux = moe_ffn(h.reshape(b * s, d), lp["moe"], mcfg)
         return x + out.reshape(b, s, d), (kc, vc)
-    x = x + jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"]
-    return x, (kc, vc)
+    h = jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"]
+    if tp_axis:
+        h = jax.lax.psum(h, tp_axis)
+    return x + h, (kc, vc)
 
 
 def generate(params, cfg: TransformerConfig, prompt: jax.Array,
-             max_new: int = 32) -> jax.Array:
-    """Greedy decode (single device): prefill the prompt token-by-token
-    into KV caches, then emit max_new argmax tokens. Static shapes
-    throughout (lax.scan over cache positions) — one compile per
-    (prompt_len, max_new)."""
+             max_new: int = 32, mesh=None) -> jax.Array:
+    """Greedy decode: prefill the prompt token-by-token into KV caches,
+    then emit max_new argmax tokens. Static shapes throughout (lax.scan
+    over cache positions) — one compile per (prompt_len, max_new).
+
+    mesh=None: single device. Otherwise a Mesh with axes ("dp", "tp")
+    (either size may be 1) runs SHARDED serving as one program: batch
+    over dp, attention heads + ffn + KV caches over tp (Megatron decode
+    — caches never replicate), params placed by shard_params, prompt
+    sharded [dp, None]. Dense models only (MoE decode is the drop-free
+    single-device path)."""
     b, plen = prompt.shape
     smax = plen + max_new
-    nh, hd, d = cfg.n_heads, cfg.head_dim, cfg.d_model
+    nh, hd = cfg.n_heads, cfg.head_dim
+    tp = dp = 1
+    tp_axis = None
+    if mesh is not None:
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "sharded decode supports dense models; MoE decodes "
+                "single-device (drop-free routing)")
+        names = mesh.axis_names
+        if "dp" not in names or "tp" not in names:
+            raise ValueError(f"decode mesh needs ('dp','tp'); has {names}")
+        dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+        if nh % tp:
+            raise ValueError(f"n_heads={nh} not divisible by tp={tp}")
+        if b % dp:
+            raise ValueError(f"batch {b} not divisible by dp={dp}")
+        tp_axis = "tp"       # size-1 tp: the psums are no-ops
 
-    def fresh_cache():
-        return [(jnp.zeros((b, smax, nh, hd), cfg.dtype),
-                 jnp.zeros((b, smax, nh, hd), cfg.dtype))
-                for _ in range(cfg.n_layers)]
+    def fresh_cache(b_local, nh_local):
+        caches = [(jnp.zeros((b_local, smax, nh_local, hd), cfg.dtype),
+                   jnp.zeros((b_local, smax, nh_local, hd), cfg.dtype))
+                  for _ in range(cfg.n_layers)]
+        if mesh is not None:
+            # zeros are axis-invariant; the scanned k/v updates vary
+            # over dp (batch) and tp (heads) — match the carry's vma
+            from ..ops.attention import _pvary
+            caches = jax.tree.map(lambda z: _pvary(z, ("dp", "tp")),
+                                  caches)
+        return caches
 
-    def step_token(carry, inp):
+    def step_token(params, carry, inp):
         caches, _prev = carry
         tok, pos = inp
         x = params["emb"][tok][:, None, :]            # [B, 1, D]
         new_caches = []
         for lp, kv in zip(params["layers"], caches):
-            x, kv = _block_decode(x, lp, kv, pos, cfg)
+            x, kv = _block_decode(x, lp, kv, pos, cfg, tp_axis=tp_axis)
             new_caches.append(kv)
         x = _ln(x, params["ln_f"])
         logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
         nxt = jnp.argmax(logits[:, 0, :], axis=-1)
         return (new_caches, nxt), nxt
 
-    @jax.jit
-    def run(prompt):
-        caches = fresh_cache()
+    def run(params, prompt):
+        b_local = prompt.shape[0]
+        caches = fresh_cache(b_local, nh // tp)
         carry = (caches, prompt[:, 0])
         # prefill: feed prompt tokens at positions 0..plen-1
+        step = functools.partial(step_token, params)
         carry, _ = jax.lax.scan(
-            step_token, carry,
-            (prompt.T, jnp.arange(plen)))
+            step, carry, (prompt.T, jnp.arange(plen)))
         # decode: feed back the argmax token. After prefill the carry
         # already holds t0 (the prediction following the last prompt
         # token), so each step emits the token it FEEDS — emitting the
@@ -544,14 +627,25 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         # whole output by one.
         def gen(carry, pos):
             caches, tok = carry
-            (caches, nxt), _ = step_token((caches, tok), (tok, pos))
+            (caches, nxt), _ = step((caches, tok), (tok, pos))
             return (caches, nxt), tok
 
         _carry, toks = jax.lax.scan(
             gen, carry, jnp.arange(plen, smax))
-        return toks.T                                  # [B, max_new]
+        return toks.T                                  # [B_local, max_new]
 
-    return run(prompt)
+    if mesh is None:
+        return jax.jit(lambda p, t: run(p, t))(params, prompt)
+
+    from jax.sharding import NamedSharding
+    pspecs = param_specs(cfg)
+    data_spec = P("dp", None)
+    prog = jax.jit(shard_map(
+        run, mesh=mesh,
+        in_specs=(pspecs, data_spec),
+        out_specs=data_spec))
+    prompt = jax.device_put(prompt, NamedSharding(mesh, data_spec))
+    return prog(params, prompt)
 
 
 def make_opt_state(params, cfg: TransformerConfig, mesh, optimizer: Any):

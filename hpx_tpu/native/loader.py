@@ -389,6 +389,14 @@ class NetEndpoint:
         if not self._h:
             raise OSError(f"cannot listen on {bind}:{port}")
         self.on_message = on_message
+        # surface the epoll thread in the io_service registry (the
+        # reference's "parcel" helper pool) for io_pool_names()/counters
+        try:
+            from ..runtime.io_service import register_external_pool
+            register_external_pool("parcel", 1,
+                                   "native/net.cpp epoll thread")
+        except Exception:  # noqa: BLE001 — observability only
+            pass
 
         def _cb(_user, peer_id, data, length):
             payload = ctypes.string_at(data, length)

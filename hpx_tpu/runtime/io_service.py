@@ -1,0 +1,185 @@
+"""Helper OS-thread pools for blocking work — the io_service analog.
+
+Reference analog: libs/core/io_service (SURVEY.md §2.1): HPX keeps
+small dedicated asio pools ("io", "timer", "parcel") OUTSIDE the
+compute workers so blocking syscalls and timer waits never occupy a
+scheduler core. Same split here: compute tasks run on the
+work-stealing pool (runtime/threadpool.py, native scheduler); BLOCKING
+host work — file IO for checkpoints, socket waits, subprocess reaps —
+belongs on a named helper pool from this module.
+
+Differences from the compute pool, on purpose:
+  * plain FIFO queue, no stealing (helper work is latency-, not
+    throughput-bound, and usually blocks);
+  * threads are daemons created lazily and sized small (default 1 —
+    asio's io_service_pool default);
+  * submitting from a helper thread to its own pool is allowed and
+    never deadlocks the queue (no work-helping wait() semantics here;
+    a Future from a helper pool is waited on from compute threads,
+    which DO work-help).
+
+The well-known pool names mirror the reference: "io", "timer",
+"parcel". "timer" is registered by core/timing when its deadline
+thread starts; "parcel" by native/loader when the epoll endpoint
+comes up (external pools: listed and counted, threads owned
+elsewhere); "io" is a real submittable pool created on first use.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..futures.future import Future, SharedState
+
+__all__ = ["IoServicePool", "get_io_service_pool", "io_pool_names",
+           "register_external_pool", "shutdown_io_pools"]
+
+
+class IoServicePool:
+    """A named pool of daemon OS threads draining a FIFO of callables."""
+
+    def __init__(self, name: str, threads: int = 1) -> None:
+        if threads < 1:
+            raise ValueError("io pool needs >= 1 thread")
+        self.name = name
+        self._want = threads
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._threads: List[threading.Thread] = []
+        self._stopping = False
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def _ensure_started(self) -> None:
+        with self._cv:
+            if self._started or self._stopping:
+                return
+            self._started = True
+            for i in range(self._want):
+                t = threading.Thread(target=self._run, daemon=True,
+                                     name=f"hpx-io-{self.name}-{i}")
+                self._threads.append(t)
+                t.start()
+
+    def stop(self, wait: bool = True) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if wait:
+            for t in self._threads:
+                if t is not threading.current_thread():
+                    t.join(timeout=5.0)
+
+    @property
+    def size(self) -> int:
+        return self._want
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # -- submission --------------------------------------------------------
+    def post(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
+        """Fire-and-forget (hpx::post onto the helper pool)."""
+        self._ensure_started()
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError(f"io pool {self.name!r} is stopped")
+            self._q.append((fn, args, kwargs, None))
+            self._cv.notify()
+
+    def async_execute(self, fn: Callable[..., Any], *args: Any,
+                      **kwargs: Any) -> Future:
+        """Run on a helper thread; returns a Future (wait for it from a
+        COMPUTE thread — those work-help; helper threads should not
+        block on their own pool's futures)."""
+        self._ensure_started()
+        st = SharedState()
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError(f"io pool {self.name!r} is stopped")
+            self._q.append((fn, args, kwargs, st))
+            self._cv.notify()
+        return Future(st)
+
+    # -- worker ------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopping:
+                    self._cv.wait()
+                if not self._q:        # stopping and drained
+                    return
+                fn, args, kwargs, st = self._q.popleft()
+            try:
+                out = fn(*args, **kwargs)
+            except BaseException as e:      # noqa: BLE001
+                if st is not None:
+                    st.set_exception(e)
+            else:
+                if st is not None:
+                    st.set_value(out)
+
+
+class _ExternalPool:
+    """Observability shim for pools whose threads live elsewhere (the
+    native epoll thread): named, sized, not submittable."""
+
+    def __init__(self, name: str, threads: int, where: str) -> None:
+        self.name = name
+        self.size = threads
+        self.where = where
+
+    def post(self, *a: Any, **k: Any) -> None:
+        raise RuntimeError(
+            f"pool {self.name!r} is owned by {self.where}; it accepts no "
+            f"Python work")
+
+    async_execute = post
+
+    def pending(self) -> int:
+        return 0
+
+    def stop(self, wait: bool = True) -> None:
+        pass
+
+
+_POOLS: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+_DEFAULT_SIZES = {"io": 2, "timer": 1, "parcel": 1}
+
+
+def get_io_service_pool(name: str = "io",
+                        threads: Optional[int] = None) -> IoServicePool:
+    """Lazily create (or fetch) the named helper pool. Well-known
+    names get reference-matching default sizes; unknown names default
+    to 1 thread."""
+    with _LOCK:
+        pool = _POOLS.get(name)
+        if pool is None:
+            n = threads if threads is not None else _DEFAULT_SIZES.get(
+                name, 1)
+            pool = _POOLS[name] = IoServicePool(name, n)
+        return pool
+
+
+def register_external_pool(name: str, threads: int, where: str) -> None:
+    """Record a helper pool whose threads are owned elsewhere (e.g. the
+    native epoll thread) so io_pool_names() reflects reality."""
+    with _LOCK:
+        _POOLS.setdefault(name, _ExternalPool(name, threads, where))
+
+
+def io_pool_names() -> List[str]:
+    with _LOCK:
+        return sorted(_POOLS)
+
+
+def shutdown_io_pools() -> None:
+    with _LOCK:
+        pools = list(_POOLS.values())
+        _POOLS.clear()
+    for p in pools:
+        p.stop(wait=True)

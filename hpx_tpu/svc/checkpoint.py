@@ -130,8 +130,10 @@ def restore_checkpoint(cp: Checkpoint) -> Tuple:
 def save_checkpoint_to_file(path: Union[str, os.PathLike],
                             *args: Any) -> Future:
     def build() -> Checkpoint:
+        return Checkpoint(serialize(_encode(list(args))))
+
+    def write(cp: Checkpoint) -> Checkpoint:
         import tempfile
-        cp = Checkpoint(serialize(_encode(list(args))))
         d = os.path.dirname(os.path.abspath(path)) or "."
         # unique temp per call: concurrent saves to one path must not
         # interleave into the same tmp file before the atomic publish
@@ -149,7 +151,14 @@ def save_checkpoint_to_file(path: Union[str, os.PathLike],
             raise
         return cp
 
-    return async_(build)
+    # serialize on the compute pool (CPU-bound), write on the "io"
+    # helper pool (blocking syscalls off the scheduler workers — the
+    # reference's io_service_pool split, SURVEY.md §2.1)
+    from ..runtime.io_service import get_io_service_pool
+
+    return async_(build).then(
+        lambda fut: get_io_service_pool("io").async_execute(
+            write, fut.get()))
 
 
 def restore_checkpoint_from_file(path: Union[str, os.PathLike]) -> Tuple:

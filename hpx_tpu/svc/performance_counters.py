@@ -299,6 +299,16 @@ def _register_builtins() -> None:
         CallbackCounter(lambda: pool.stats().get("pending", 0)),
         "pool#default")
 
+    # io_service helper pools (io/timer/parcel + user pools) — queue
+    # length per named pool, like the reference's io_service counters
+    from ..runtime.io_service import _POOLS
+    for pname in list(_POOLS):
+        put("io", "queue/length",
+            CallbackCounter(
+                lambda p=pname: float(
+                    _POOLS[p].pending() if p in _POOLS else 0)),
+            f"pool#{pname}")
+
     # runtime uptime
     name = counter_name("runtime", "uptime", "total", loc)
     with _registry_lock:

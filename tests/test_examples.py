@@ -40,6 +40,7 @@ def run_distributed(name, localities, timeout=240):
     ("ring_attention_demo.py", ["128"]),
     ("checkpointed_stencil.py", ["128", "4", "8"]),
     ("fft_distributed.py", ["12", "14"]),
+    ("pipeline_train.py", ["4"]),
 ])
 def test_example_single(name, args):
     r = run_example(name, *args)

@@ -82,6 +82,23 @@ def test_fft1d_real_signal_spectrum(mesh8):
     assert rest < 1e-2 * n
 
 
+def test_fft_partitioned_vector(mesh8):
+    """Segmented surface: fft(pv) -> pv with the same layout."""
+    from hpx_tpu.containers.partitioned_vector import PartitionedVector
+    from hpx_tpu.dist.distribution_policies import ContainerLayout
+    rng = np.random.default_rng(5)
+    v = (rng.standard_normal(1024) +
+         1j * rng.standard_normal(1024)).astype(np.complex64)
+    lay = ContainerLayout(mesh=mesh8, axis="x")
+    pv = PartitionedVector.from_array(v, layout=lay)
+    out = dfft.fft(pv)
+    assert isinstance(out, PartitionedVector)
+    assert out.layout is lay
+    assert _rel(out.to_numpy(), np.fft.fft(v.astype(np.complex128))) < 1e-4
+    back = dfft.ifft(out)
+    assert _rel(back.to_numpy(), v) < 1e-5
+
+
 def test_fft1d_rejects_unfactorable(mesh8):
     v = jnp.zeros((8 * 17,), jnp.complex64)   # 136 = 8*17: n2 can't
     with pytest.raises(ValueError, match="factor"):

@@ -1,0 +1,161 @@
+"""io_service helper pools (runtime/io_service.py) and execution
+agents (exec/execution_base.py)."""
+
+import threading
+import time
+
+import pytest
+
+from hpx_tpu.exec.execution_base import (agent, suspend, this_task,
+                                         yield_, yield_while)
+from hpx_tpu.runtime.io_service import (IoServicePool, get_io_service_pool,
+                                        io_pool_names,
+                                        register_external_pool)
+from hpx_tpu.runtime.threadpool import default_pool, reset_default_pool
+
+
+# -- io_service pools --------------------------------------------------------
+
+def test_io_pool_runs_and_returns_future():
+    p = IoServicePool("t-basic", threads=2)
+    try:
+        f = p.async_execute(lambda a, b: a + b, 20, 22)
+        assert f.get(timeout=10.0) == 42
+    finally:
+        p.stop()
+
+
+def test_io_pool_propagates_exception():
+    p = IoServicePool("t-exc")
+    try:
+        f = p.async_execute(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            f.get(timeout=10.0)
+    finally:
+        p.stop()
+
+
+def test_io_pool_post_fire_and_forget():
+    p = IoServicePool("t-post")
+    done = threading.Event()
+    try:
+        p.post(done.set)
+        assert done.wait(10.0)
+    finally:
+        p.stop()
+
+
+def test_io_pool_blocking_work_does_not_starve():
+    """Blocking tasks occupy helper threads, not compute workers: a
+    2-thread pool with 2 blockers still finishes queued work after the
+    blockers release."""
+    p = IoServicePool("t-block", threads=2)
+    gate = threading.Event()
+    try:
+        blockers = [p.async_execute(gate.wait, 10.0) for _ in range(2)]
+        f = p.async_execute(lambda: "queued")
+        assert p.pending() >= 1          # queued behind the blockers
+        gate.set()
+        assert f.get(timeout=10.0) == "queued"
+        for b in blockers:
+            assert b.get(timeout=10.0)
+    finally:
+        p.stop()
+
+
+def test_io_pool_submit_from_own_thread():
+    p = IoServicePool("t-reentrant", threads=1)
+    try:
+        f = p.async_execute(
+            lambda: p.async_execute(lambda: "inner"))
+        # future<future<T>> collapses (HPX unwrap semantics)
+        assert f.get(timeout=10.0) == "inner"
+    finally:
+        p.stop()
+
+
+def test_named_registry_and_external_pools():
+    io = get_io_service_pool("io")
+    assert get_io_service_pool("io") is io
+    assert io.size == 2                  # reference default
+    register_external_pool("parcel", 1, "native/net.cpp epoll thread")
+    assert "parcel" in io_pool_names()
+    with pytest.raises(RuntimeError, match="native/net.cpp"):
+        get_io_service_pool("parcel").post(lambda: None)
+
+
+def test_stopped_pool_rejects():
+    p = IoServicePool("t-stopped")
+    p.async_execute(lambda: None).get(timeout=10.0)
+    p.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        p.post(lambda: None)
+
+
+# -- execution agents --------------------------------------------------------
+
+def test_agent_identity_external_vs_worker():
+    assert not agent().in_worker
+    assert agent().description() == "external-thread"
+    pool = default_pool()
+    out = {}
+    done = threading.Event()
+
+    def task():
+        out["agent"] = agent()
+        done.set()
+
+    pool.submit(task)
+    assert done.wait(10.0)
+    assert out["agent"].in_worker
+
+
+def test_yield_runs_queued_work_from_worker():
+    """yield_() on a worker drains one queued task — the cooperative
+    scheduling point the reference's this_thread::yield provides."""
+    reset_default_pool()
+    pool = default_pool()
+    ran = []
+    done = threading.Event()
+
+    def spinner():
+        # queue a second task, then yield until it has run
+        pool.submit(lambda: ran.append("other"))
+        ok = yield_while(lambda: not ran, timeout=10.0)
+        ran.append("spinner-done" if ok else "timeout")
+        done.set()
+
+    pool.submit(spinner)
+    assert done.wait(10.0)
+    assert ran[0] == "other" and ran[-1] == "spinner-done"
+
+
+def test_suspend_waits_at_least_duration():
+    t0 = time.monotonic()
+    suspend(0.05)
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_yield_while_timeout():
+    assert not yield_while(lambda: True, timeout=0.05)
+    assert yield_while(lambda: False, timeout=0.05)
+
+
+def test_this_task_namespace():
+    assert this_task.agent() is not None
+    this_task.yield_()
+
+
+def test_io_pool_counters_discoverable():
+    from hpx_tpu.svc import performance_counters as pc
+    get_io_service_pool("io")            # ensure the pool exists
+    names = pc.discover_counters("/io{*}*")
+    assert any("pool#io" in n and "queue/length" in n for n in names), names
+    val = pc.query_counter([n for n in names if "pool#io" in n][0])
+    assert val.value == 0.0
+
+
+def test_timer_pool_registers_on_first_timer():
+    from hpx_tpu.core.timing import async_after
+    async_after(0.01, lambda: 7).get(timeout=10.0)
+    assert "timer" in io_pool_names()

@@ -100,3 +100,26 @@ def test_pp_rejects_bad_config(devices):
                                 n_experts=2)
     with pytest.raises(NotImplementedError):
         tfm.make_pipelined_train_step(moe, mesh, 2)
+
+
+def test_pp_optax(devices):
+    """Adam via optax in the pipelined step; opt state sharded like
+    the stacked params."""
+    import optax
+    mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "pp"))
+    opt = optax.adam(1e-2)
+    params = tfm.shard_pipeline_params(
+        tfm.stack_pipeline_params(
+            tfm.init_params(CFG, jax.random.PRNGKey(0))), mesh)
+    state = tfm.make_pipelined_opt_state(params, CFG, mesh, opt)
+    step = tfm.make_pipelined_train_step(CFG, mesh, 2, optimizer=opt)
+    toks, tgts = _batch(jax.random.PRNGKey(9))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh, P("dp", None))
+    t, g = jax.device_put(toks, sh), jax.device_put(tgts, sh)
+    losses = []
+    for _ in range(5):
+        params, state, l = step(params, state, t, g)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
