@@ -52,7 +52,9 @@ def sort_sharded(v: Any, mesh, axis: str = "x") -> Any:
     partner keeps the low half) — the classic result that p
     merge-split phases over p locally sorted blocks sort globally.
     Static shapes, compiled exchanges over ICI; O(p) rounds vs the
-    all-gather XLA falls back to for sharded jnp.sort at scale."""
+    all-gather XLA falls back to for sharded jnp.sort at scale. NOT
+    stable (merge-split loses equal-key origin order) — stable_sort
+    keeps the XLA path."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map

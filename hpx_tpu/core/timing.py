@@ -101,8 +101,12 @@ def _ensure_timer_thread() -> None:
         _timer_thread.start()
         # surface it in the io_service registry ("timer" helper pool,
         # SURVEY.md §2.1) so io_pool_names()/counters reflect reality
-        from ..runtime.io_service import register_external_pool
-        register_external_pool("timer", 1, "core/timing deadline thread")
+        try:
+            from ..runtime.io_service import register_external_pool
+            register_external_pool("timer", 1,
+                                   "core/timing deadline thread")
+        except Exception:  # noqa: BLE001 — observability only
+            pass
 
 
 def async_at(deadline_monotonic: float, fn: Callable[..., Any],

@@ -45,7 +45,8 @@ class AgentRef:
 def agent() -> AgentRef:
     pool = current_worker_pool()
     if pool is not None:
-        return AgentRef(pool=type(pool).__name__, in_worker=True)
+        name = getattr(pool, "name", None) or type(pool).__name__
+        return AgentRef(pool=name, in_worker=True)
     return AgentRef(pool=None, in_worker=False)
 
 

@@ -58,6 +58,21 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity: float = 2.0
     moe_aux_weight: float = 0.01
+    # grouped-query attention: 0 < n_kv_heads < n_heads shares each
+    # K/V head across a group of n_heads/n_kv_heads query heads
+    # (GQA; n_kv_heads=1 is MQA). 0 means n_heads (standard MHA).
+    # The KV cache — the serving memory bill — shrinks by the same
+    # factor; the flash kernels read shared tiles via BlockSpec index
+    # remaps, never a materialized repeat.
+    n_kv_heads: int = 0
+    # rematerialize each block in the backward pass (jax.checkpoint):
+    # activation memory drops from O(n_layers * S * D) residuals to one
+    # block's, for one extra forward — the standard long-context trade
+    remat: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 def make_mesh_3d(n_devices: int, devices=None):
@@ -99,10 +114,22 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 
     def layer(k):
         k1, k2, k3, k4 = jax.random.split(k, 4)
+        nkv = cfg.kv_heads
+        if nh % nkv:
+            raise ValueError(f"n_heads={nh} not a multiple of "
+                             f"n_kv_heads={nkv}")
+        if nkv == nh:
+            qkv = {"wqkv": (jax.random.normal(k1, (3, d, nh, hd)) * s
+                            ).astype(cfg.dtype)}
+        else:
+            kq, kkv = jax.random.split(k1)
+            qkv = {"wq": (jax.random.normal(kq, (d, nh, hd)) * s
+                          ).astype(cfg.dtype),
+                   "wkv": (jax.random.normal(kkv, (2, d, nkv, hd)) * s
+                           ).astype(cfg.dtype)}
         out = {
             "ln1": jnp.ones((d,), cfg.dtype),
-            "wqkv": (jax.random.normal(k1, (3, d, nh, hd)) * s
-                     ).astype(cfg.dtype),
+            **qkv,
             "wo": (jax.random.normal(k2, (nh, hd, d)) * s
                    ).astype(cfg.dtype),
             "ln2": jnp.ones((d,), cfg.dtype),
@@ -131,8 +158,13 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
 def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     """PartitionSpecs: heads/ffn over tp; MoE experts over dp (the ep
     layout — see TransformerConfig); everything else replicated."""
+    if cfg.kv_heads == cfg.n_heads:
+        qkv = {"wqkv": P(None, None, "tp", None)}
+    else:
+        qkv = {"wq": P(None, "tp", None),
+               "wkv": P(None, None, "tp", None)}
     layer = {
-        "ln1": P(), "wqkv": P(None, None, "tp", None),
+        "ln1": P(), **qkv,
         "wo": P("tp", None, None), "ln2": P(),
     }
     if cfg.n_experts > 0:
@@ -180,13 +212,26 @@ def _ln(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
 
+def _qkv_proj(h, lp):
+    """Project to (q, k, v); GQA layouts ("wq"+"wkv") give k/v their
+    smaller head count."""
+    if "wqkv" in lp:
+        q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+        return q, k, v
+    q = jnp.einsum("bsd,dnh->bsnh", h, lp["wq"])
+    k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wkv"])
+    return q, k, v
+
+
 def _block(x, lp, cfg: TransformerConfig, sp_size: int, dp_size: int):
     """One decoder block on a [B/dp, S/sp, D] shard; heads already
     tp-local. The Megatron f/g conjugate pair is implicit: with vma
     tracking on, jax transposes the closing psums and reduces the
     mixed replicated/partial cotangents itself. Returns (x, moe_aux)."""
     h = _ln(x, lp["ln1"])
-    q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+    q, k, v = _qkv_proj(h, lp)
+    # GQA layouts pass straight through: ring_attention_sharded
+    # broadcasts grouped K/V itself on the paths that need it
     att = ring_attention_sharded(q, k, v, "sp", sp_size, causal=True)
     o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
     o = jax.lax.psum(o, "tp")              # Megatron row-parallel close
@@ -232,8 +277,12 @@ def _local_loss(params, tokens, targets, cfg: TransformerConfig,
     the caller)."""
     x = params["emb"][tokens]              # [B/dp, S/sp, D]
     aux = jnp.float32(0.0)
+    block = functools.partial(_block, cfg=cfg, sp_size=sp_size,
+                              dp_size=dp_size)
+    if cfg.remat:
+        block = jax.checkpoint(block)
     for lp in params["layers"]:
-        x, a = _block(x, lp, cfg, sp_size, dp_size)
+        x, a = block(x, lp)
         aux = aux + a
     s, n = _nll_head(params, x, targets)
     return s, n, aux
@@ -258,6 +307,11 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer: Any = None):
     """
     sp_size = mesh.shape["sp"]
     dp_size = mesh.shape["dp"]
+    tp_size = mesh.shape["tp"]
+    if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
+        raise ValueError(
+            f"heads (q={cfg.n_heads}, kv={cfg.kv_heads}) must divide by "
+            f"tp={tp_size} (MQA under tp needs n_kv_heads >= tp)")
     pspecs = param_specs(cfg)
     data_spec = P("dp", "sp")
 
@@ -339,14 +393,20 @@ def stack_pipeline_params(params) -> Dict[str, Any]:
             "layers": stacked}
 
 
-def pipelined_param_specs(tp_axis: Optional[str] = None) -> Dict[str, Any]:
+def pipelined_param_specs(tp_axis: Optional[str] = None, *,
+                          gqa: bool = False) -> Dict[str, Any]:
     """Specs for stacked params: layer axis over "pp", heads/ffn over
     tp (when present), embedding/final-norm replicated. (Dense blocks
     only — make_pipelined_train_step rejects MoE configs.)"""
     t = tp_axis
+    if gqa:
+        qkv = {"wq": P("pp", None, t, None),
+               "wkv": P("pp", None, None, t, None)}
+    else:
+        qkv = {"wqkv": P("pp", None, None, t, None)}
     layer = {
         "ln1": P("pp", None),
-        "wqkv": P("pp", None, None, t, None),
+        **qkv,
         "wo": P("pp", t, None, None),
         "ln2": P("pp", None),
         "w1": P("pp", None, t),
@@ -358,7 +418,8 @@ def pipelined_param_specs(tp_axis: Optional[str] = None) -> Dict[str, Any]:
 
 def shard_pipeline_params(stacked, mesh):
     tp_axis = "tp" if "tp" in mesh.axis_names else None
-    return _place(stacked, pipelined_param_specs(tp_axis), mesh)
+    gqa = "wq" in stacked["layers"]
+    return _place(stacked, pipelined_param_specs(tp_axis, gqa=gqa), mesh)
 
 
 def _pp_block(x, lp, cfg: TransformerConfig, tp_axis: Optional[str]):
@@ -367,7 +428,7 @@ def _pp_block(x, lp, cfg: TransformerConfig, tp_axis: Optional[str]):
     TPU; the sp ring belongs to the dp x sp x tp step), heads/ffn
     tp-sharded when a tp axis exists."""
     h = _ln(x, lp["ln1"])
-    q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+    q, k, v = _qkv_proj(h, lp)
     att = auto_attention(q, k, v, causal=True)
     o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
     if tp_axis:
@@ -390,7 +451,8 @@ def _pipelined_opt_state_specs(cfg: TransformerConfig, optimizer: Any,
         lambda: stack_pipeline_params(
             init_params(cfg, jax.random.PRNGKey(0))))
     state_shape = jax.eval_shape(lambda p: optimizer.init(p), stacked)
-    pspecs = pipelined_param_specs(tp_axis)
+    pspecs = pipelined_param_specs(
+        tp_axis, gqa=cfg.kv_heads != cfg.n_heads)
     return optax.tree_map_params(
         optimizer, lambda _leaf, spec: spec, state_shape, pspecs,
         transform_non_params=lambda _leaf: P())
@@ -448,7 +510,8 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"pp={pp}")
     M = n_microbatches
-    pspecs = pipelined_param_specs(tp_axis)
+    pspecs = pipelined_param_specs(
+        tp_axis, gqa=cfg.kv_heads != cfg.n_heads)
     data_spec = P("dp", None)
 
     def loss_of(params, tokens, targets):
@@ -520,17 +583,24 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
     (N = the tp-LOCAL head count under sharded decode); write_at:
     scalar index. With tp_axis set, the wo/w2 contractions close with
     a psum — the same Megatron split the train step uses, so the KV
-    cache shards over heads and never replicates."""
+    cache shards over heads and never replicates. GQA: the cache holds
+    only the kv heads ([B, Smax, Nkv, H] — the n_heads/n_kv_heads
+    serving-memory saving); q heads attend grouped."""
     kc, vc = kv
     h = _ln(x, lp["ln1"])
-    q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+    q, k, v = _qkv_proj(h, lp)
     kc = jax.lax.dynamic_update_slice_in_dim(kc, k, write_at, axis=1)
     vc = jax.lax.dynamic_update_slice_in_dim(vc, v, write_at, axis=1)
-    s = jnp.einsum("bqnh,bknh->bnqk", q, kc) / math.sqrt(q.shape[-1])
+    b, sq, nq, hd = q.shape
+    nkv = kc.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, sq, nkv, g, hd)
+    s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
     pos = jnp.arange(kc.shape[1])
-    s = jnp.where(pos[None, None, None, :] <= write_at, s, -jnp.inf)
+    s = jnp.where(pos[None, None, None, None, :] <= write_at, s,
+                  -jnp.inf)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
-    att = jnp.einsum("bnqk,bknh->bqnh", p, vc)
+    att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, sq, nq, hd)
     o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
     if tp_axis:
         o = jax.lax.psum(o, tp_axis)
@@ -581,8 +651,10 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         if "dp" not in names or "tp" not in names:
             raise ValueError(f"decode mesh needs ('dp','tp'); has {names}")
         dp, tp = mesh.shape["dp"], mesh.shape["tp"]
-        if nh % tp:
-            raise ValueError(f"n_heads={nh} not divisible by tp={tp}")
+        if nh % tp or cfg.kv_heads % tp:
+            raise ValueError(
+                f"heads (q={nh}, kv={cfg.kv_heads}) not divisible by "
+                f"tp={tp}")
         if b % dp:
             raise ValueError(f"batch {b} not divisible by dp={dp}")
         tp_axis = "tp"       # size-1 tp: the psums are no-ops
@@ -614,7 +686,7 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
 
     def run(params, prompt):
         b_local = prompt.shape[0]
-        caches = fresh_cache(b_local, nh // tp)
+        caches = fresh_cache(b_local, cfg.kv_heads // tp)
         carry = (caches, prompt[:, 0])
         # prefill: feed prompt tokens at positions 0..plen-1
         step = functools.partial(step_token, params)

@@ -64,9 +64,27 @@ def _pvary(x: jax.Array, axis) -> jax.Array:
 # reference (materializes the full score matrix — test oracle only)
 # ---------------------------------------------------------------------------
 
+def _expand_kv(q: jax.Array, k: jax.Array, v: jax.Array):
+    """GQA/MQA on the XLA paths: repeat K/V heads up to the q head
+    count (the pallas kernels share tiles via BlockSpec index remaps
+    instead — attention_pallas._kv_row_map — and never materialize the
+    repeat; these XLA formulations are oracles/fallbacks, so the
+    repeat's bandwidth cost is acceptable)."""
+    nq, nkv = q.shape[2], k.shape[2]
+    if nkv == nq:
+        return k, v
+    if nq % nkv:
+        raise ValueError(f"q heads ({nq}) not a multiple of kv heads "
+                         f"({nkv})")
+    r = nq // nkv
+    return (jnp.repeat(k, r, axis=2), jnp.repeat(v, r, axis=2))
+
+
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = False) -> jax.Array:
-    """O(S^2) memory oracle. [B,S,N,H] -> [B,S,N,H]."""
+    """O(S^2) memory oracle. [B,S,N,H] -> [B,S,N,H]; fewer K/V heads
+    (GQA/MQA) broadcast per group."""
+    k, v = _expand_kv(q, k, v)
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     s = jnp.einsum("bqnh,bknh->bnqk", _scale(qf), kf)
     if causal:
@@ -118,7 +136,9 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         block_k: int = 512) -> jax.Array:
     """Flash-style attention: K/V consumed in blocks with an online
     softmax — O(S) memory. The inner loop is a lax.scan, so XLA sees a
-    static program whatever the sequence length."""
+    static program whatever the sequence length. Fewer K/V heads
+    (GQA/MQA) broadcast per group."""
+    k, v = _expand_kv(q, k, v)
     b, sq, n, h = q.shape
     sk = k.shape[1]
     nblk = -(-sk // block_k)
@@ -214,10 +234,16 @@ def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
     if use_flash:
         if nshards == 1:
             # degenerate ring: plain flash (custom_vjp) — skips the
-            # scan/ppermute wrapping and the unnormalized f32 carry
+            # scan/ppermute wrapping and the unnormalized f32 carry;
+            # handles GQA natively (grouped K/V tiles)
             from .attention_pallas import flash_attention
             return flash_attention(qc, kc, vc, causal)
+        # the ring-chunk kernel folds matching head counts only:
+        # broadcast grouped K/V before the ring (grouped tiles still
+        # pay off on the nshards==1 path and in decode caches)
+        kc, vc = _expand_kv(qc, kc, vc)
         return _ring_flash(qc, kc, vc, axis, nshards, causal)
+    kc, vc = _expand_kv(qc, kc, vc)     # GQA on the XLA ring path
     b, sq, n, h = qc.shape
     idx = jax.lax.axis_index(axis)
     q_pos = idx * sq + jnp.arange(sq)              # global positions
@@ -399,6 +425,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Any,
     if n % nshards:
         raise ValueError(f"heads ({n}) not divisible by mesh axis "
                          f"({nshards}) — use ring_attention")
+    if k.shape[2] % nshards:
+        # GQA with fewer kv heads than ring shards: broadcast up front
+        # (the head all_to_all needs every axis to split evenly)
+        k, v = _expand_kv(q, k, v)
     flash = (jax.default_backend() == "tpu" if use_flash is None
              else use_flash)
     spec = P(None, axis, None, None)

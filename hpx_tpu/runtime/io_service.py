@@ -118,6 +118,11 @@ class IoServicePool:
             except BaseException as e:      # noqa: BLE001
                 if st is not None:
                     st.set_exception(e)
+                else:
+                    # fire-and-forget failures must not vanish (same
+                    # policy as the compute pool's _run_task)
+                    import traceback
+                    traceback.print_exc()
             else:
                 if st is not None:
                     st.set_value(out)
@@ -162,6 +167,10 @@ def get_io_service_pool(name: str = "io",
             n = threads if threads is not None else _DEFAULT_SIZES.get(
                 name, 1)
             pool = _POOLS[name] = IoServicePool(name, n)
+        elif threads is not None and threads != pool.size:
+            raise ValueError(
+                f"io pool {name!r} already exists with {pool.size} "
+                f"thread(s); asked for {threads}")
         return pool
 
 
