@@ -69,6 +69,14 @@ class TransformerConfig:
     # activation memory drops from O(n_layers * S * D) residuals to one
     # block's, for one extra forward — the standard long-context trade
     remat: bool = False
+    # rotary position embeddings (RoPE, GPT-NeoX rotate-half form)
+    # applied to q/k before attention. Off by default (the original
+    # position-free model stays the baseline); under sequence
+    # parallelism each shard rotates by its GLOBAL positions
+    # (axis_index * S_local offset), so the ring sees one coherent
+    # position space.
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @property
     def kv_heads(self) -> int:
@@ -223,6 +231,23 @@ def _qkv_proj(h, lp):
     return q, k, v
 
 
+def _rope(x, pos, cfg: TransformerConfig):
+    """Rotate q/k by position (GPT-NeoX rotate-half). x: [B, S, N, H]
+    (or S=1 decode); pos: [S] int positions (global under sp)."""
+    hd = x.shape[-1]
+    if hd % 2:
+        raise ValueError(f"rope needs an even head_dim; got {hd}")
+    half = hd // 2
+    freq = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32)
+                              / half)
+    ang = pos.astype(jnp.float32)[:, None] * freq[None, :]   # [S, half]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
 def _block(x, lp, cfg: TransformerConfig, sp_size: int, dp_size: int):
     """One decoder block on a [B/dp, S/sp, D] shard; heads already
     tp-local. The Megatron f/g conjugate pair is implicit: with vma
@@ -230,6 +255,12 @@ def _block(x, lp, cfg: TransformerConfig, sp_size: int, dp_size: int):
     mixed replicated/partial cotangents itself. Returns (x, moe_aux)."""
     h = _ln(x, lp["ln1"])
     q, k, v = _qkv_proj(h, lp)
+    if cfg.rope:
+        # GLOBAL positions: this shard owns tokens
+        # [idx*S_local, (idx+1)*S_local) of the ring's position space
+        s_local = q.shape[1]
+        pos = jax.lax.axis_index("sp") * s_local + jnp.arange(s_local)
+        q, k = _rope(q, pos, cfg), _rope(k, pos, cfg)
     # GQA layouts pass straight through: ring_attention_sharded
     # broadcasts grouped K/V itself on the paths that need it
     att = ring_attention_sharded(q, k, v, "sp", sp_size, causal=True)
@@ -429,6 +460,9 @@ def _pp_block(x, lp, cfg: TransformerConfig, tp_axis: Optional[str]):
     tp-sharded when a tp axis exists."""
     h = _ln(x, lp["ln1"])
     q, k, v = _qkv_proj(h, lp)
+    if cfg.rope:
+        pos = jnp.arange(q.shape[1])    # sequence is pp-local in full
+        q, k = _rope(q, pos, cfg), _rope(k, pos, cfg)
     att = auto_attention(q, k, v, causal=True)
     o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
     if tp_axis:
@@ -509,6 +543,12 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"pp={pp}")
+    if tp_axis:
+        tp_size = mesh.shape["tp"]
+        if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
+            raise ValueError(
+                f"heads (q={cfg.n_heads}, kv={cfg.kv_heads}) must "
+                f"divide by tp={tp_size}")
     M = n_microbatches
     pspecs = pipelined_param_specs(
         tp_axis, gqa=cfg.kv_heads != cfg.n_heads)
@@ -589,6 +629,11 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
     kc, vc = kv
     h = _ln(x, lp["ln1"])
     q, k, v = _qkv_proj(h, lp)
+    if cfg.rope:
+        # rotate at the write position; the cache stores POST-rope k,
+        # so cached entries never need re-rotation
+        pos = jnp.atleast_1d(jnp.asarray(write_at))
+        q, k = _rope(q, pos, cfg), _rope(k, pos, cfg)
     kc = jax.lax.dynamic_update_slice_in_dim(kc, k, write_at, axis=1)
     vc = jax.lax.dynamic_update_slice_in_dim(vc, v, write_at, axis=1)
     b, sq, nq, hd = q.shape

@@ -238,12 +238,16 @@ def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
             # handles GQA natively (grouped K/V tiles)
             from .attention_pallas import flash_attention
             return flash_attention(qc, kc, vc, causal)
-        # the ring-chunk kernel folds matching head counts only:
-        # broadcast grouped K/V before the ring (grouped tiles still
-        # pay off on the nshards==1 path and in decode caches)
+        # the ring-chunk kernel (and its custom_vjp backward, which
+        # rotates dK/dV partials with their chunks) folds matching head
+        # counts only, so the FLASH ring pre-broadcasts grouped K/V and
+        # pays the expanded ppermute volume. Teaching the chunk+bwd
+        # kernels grouped tiles (as plain flash_attention has) would
+        # recover the wire saving; until then long-ring GQA trades ICI
+        # bytes for kernel speed here, while the XLA branch below keeps
+        # chunks grouped on the wire.
         kc, vc = _expand_kv(qc, kc, vc)
         return _ring_flash(qc, kc, vc, axis, nshards, causal)
-    kc, vc = _expand_kv(qc, kc, vc)     # GQA on the XLA ring path
     b, sq, n, h = qc.shape
     idx = jax.lax.axis_index(axis)
     q_pos = idx * sq + jnp.arange(sq)              # global positions
@@ -268,7 +272,12 @@ def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
                              0.0, -jnp.inf)
         else:
             bias = jnp.zeros((sq, sq), jnp.float32)
-        acc, m, l = _online_block(qc, kc, vc, acc, m, l, bias)
+        # GQA: the ring circulates the GROUPED [B,S/P,Nkv,H] chunks —
+        # every ppermute hop moves only the kv heads — and broadcasts
+        # per group locally just for this step's fold (AD transposes
+        # the repeat to a group-sum, so dK/dV stay grouped on the wire)
+        ke, ve = _expand_kv(qc, kc, vc)
+        acc, m, l = _online_block(qc, ke, ve, acc, m, l, bias)
         # rotate AFTER folding; ppermute rides the ICI ring
         kc = jax.lax.ppermute(kc, axis, perm)
         vc = jax.lax.ppermute(vc, axis, perm)
