@@ -55,7 +55,7 @@ from .futures.task_group import TaskGroup, task_group  # noqa: F401
 from . import lcos  # noqa: F401
 from .synchronization import (  # noqa: F401
     Barrier, ConditionVariable, CountingSemaphore, Event, Latch, Mutex,
-    SlidingSemaphore, Spinlock, StopSource, StopToken,
+    SharedMutex, SlidingSemaphore, Spinlock, StopSource, StopToken,
     enable_lock_verification,
 )
 

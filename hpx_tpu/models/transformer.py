@@ -36,7 +36,8 @@ __all__ = ["TransformerConfig", "init_params", "make_train_step",
            "make_mesh_3d", "shard_params", "shard_batch", "sample_batch",
            "make_opt_state", "generate", "make_pipelined_train_step",
            "stack_pipeline_params", "shard_pipeline_params",
-           "pipelined_param_specs"]
+           "pipelined_param_specs", "interleave_pipeline_params",
+           "deinterleave_pipeline_params", "prepare_pipeline_params"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -424,6 +425,50 @@ def stack_pipeline_params(params) -> Dict[str, Any]:
             "layers": stacked}
 
 
+def _interleave_order(n_layers: int, pp: int, v: int):
+    """Layer permutation for the interleaved schedule: device d's
+    contiguous pp-slab holds its round-robin stage chunks
+    [d, d+pp, d+2*pp, ...] (stage s = chunk*pp + d, chunk-major within
+    the slab)."""
+    if v < 1 or n_layers % (pp * v):
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pp*interleave="
+            f"{pp}*{v}")
+    ls = n_layers // (pp * v)
+    order = []
+    for d in range(pp):
+        for chunk in range(v):
+            s = chunk * pp + d
+            order.extend(range(s * ls, (s + 1) * ls))
+    return order
+
+
+def interleave_pipeline_params(stacked, pp: int, v: int):
+    """Reorder the stacked layer axis for make_pipelined_train_step's
+    interleave=v schedule (identity when v == 1)."""
+    if v == 1:
+        return stacked
+    order = jnp.asarray(_interleave_order(
+        jax.tree.leaves(stacked["layers"])[0].shape[0], pp, v))
+    return {**stacked,
+            "layers": jax.tree.map(lambda a: a[order],
+                                   stacked["layers"])}
+
+
+def deinterleave_pipeline_params(stacked, pp: int, v: int):
+    """Inverse of interleave_pipeline_params (back to layer order)."""
+    if v == 1:
+        return stacked
+    n = jax.tree.leaves(stacked["layers"])[0].shape[0]
+    order = _interleave_order(n, pp, v)
+    inv = [0] * n
+    for i, o in enumerate(order):
+        inv[o] = i
+    inv = jnp.asarray(inv)
+    return {**stacked,
+            "layers": jax.tree.map(lambda a: a[inv], stacked["layers"])}
+
+
 def pipelined_param_specs(tp_axis: Optional[str] = None, *,
                           gqa: bool = False) -> Dict[str, Any]:
     """Specs for stacked params: layer axis over "pp", heads/ffn over
@@ -451,6 +496,20 @@ def shard_pipeline_params(stacked, mesh):
     tp_axis = "tp" if "tp" in mesh.axis_names else None
     gqa = "wq" in stacked["layers"]
     return _place(stacked, pipelined_param_specs(tp_axis, gqa=gqa), mesh)
+
+
+def prepare_pipeline_params(params, mesh, interleave: int = 1):
+    """One-stop: stack the per-layer list, apply the interleaved layer
+    permutation when interleave > 1, and place on the mesh. Use this
+    with make_pipelined_train_step(..., interleave=V) — the layer
+    LAYOUT must match the step's interleave or training silently runs
+    a layer-permuted network (nothing in the arrays records the
+    layout, so the pairing is the API's job; this helper makes the
+    pairing a single argument)."""
+    pp = mesh.shape["pp"]
+    stacked = interleave_pipeline_params(
+        stack_pipeline_params(params), pp, interleave)
+    return shard_pipeline_params(stacked, mesh)
 
 
 def _pp_block(x, lp, cfg: TransformerConfig, tp_axis: Optional[str]):
@@ -507,7 +566,8 @@ def make_pipelined_opt_state(stacked, cfg: TransformerConfig, mesh,
 
 def make_pipelined_train_step(cfg: TransformerConfig, mesh,
                               n_microbatches: int,
-                              optimizer: Any = None):
+                              optimizer: Any = None,
+                              interleave: int = 1):
     """Train step with pipeline parallelism INSIDE the jitted program:
     layers shard over the mesh's "pp" axis (stacked leading dim),
     microbatches hand off stage-to-stage via one lax.ppermute hop per
@@ -527,12 +587,22 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
     step instead (expert all_to_all inside a pipeline stage would
     deadlock against the pp ppermute schedule if capacity buffers
     ever shard over dp x pp jointly).
+
+    interleave=V > 1 runs the INTERLEAVED schedule (virtual stages,
+    pipeline_run_interleaved): pp*V stages round-robin over devices,
+    each scan step computing one 1/(pp*V) layer chunk — bubble
+    (pp-1)/(M*V + pp-1) instead of (pp-1)/(M + pp-1); M must divide by
+    pp. Params must be in the MATCHING interleaved layout — build them
+    with prepare_pipeline_params(params, mesh, interleave=V) (updates
+    come back in that layout; invert with
+    deinterleave_pipeline_params).
     """
     if cfg.n_experts > 0:
         raise NotImplementedError(
             "pipeline-parallel MoE is not supported; use make_train_step "
             "with the dp/ep layout")
-    from ..parallel.pipeline_spmd import pipeline_run
+    from ..parallel.pipeline_spmd import (pipeline_run,
+                                          pipeline_run_interleaved)
     from ..ops.attention import _pvary
 
     axes = mesh.axis_names
@@ -540,9 +610,10 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
         raise ValueError(f"mesh must carry ('dp', 'pp'); has {axes}")
     tp_axis = "tp" if "tp" in axes else None
     pp, dp = mesh.shape["pp"], mesh.shape["dp"]
-    if cfg.n_layers % pp:
+    V = interleave
+    if V < 1 or cfg.n_layers % (pp * V):
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
-                         f"pp={pp}")
+                         f"pp*interleave={pp}*{V}")
     if tp_axis:
         tp_size = mesh.shape["tp"]
         if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
@@ -563,30 +634,63 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
         toks = tokens.reshape(M, mb, s)
         tgts = targets.reshape(M, mb, s)
 
-        def stage_fn(x):
-            block = jax.checkpoint(
-                lambda x, lp: _pp_block(x, lp, cfg, tp_axis))
+        block = jax.checkpoint(
+            lambda x, lp: _pp_block(x, lp, cfg, tp_axis))
+
+        def chunk_apply(lg, x):
             x, _ = jax.lax.scan(
-                lambda x, lp: (block(x, lp), None), x, params["layers"])
+                lambda x, lp: (block(x, lp), None), x, lg)
             return x
 
         def feed(t):
             return params["emb"][toks[t]].astype(cfg.dtype)
 
-        def collect(acc, y, t_out, valid):
-            ls, cnt = acc
-            ssum, n = _nll_head(params, y, tgts[t_out])
-            w = valid.astype(jnp.float32)
-            return (ls + w * ssum, cnt + w * jnp.float32(n))
+        # collect STASHES the final-stage outputs into an [M, ...]
+        # buffer; the loss head (a full [*, vocab] matmul + logsumexp)
+        # then runs ONCE per device after the scan instead of at every
+        # schedule step — in-scan heads would multiply dead masked
+        # work by the step count (x V^2 relative to useful compute on
+        # the interleaved schedule)
+        def collect(buf, y, t_out, valid):
+            upd = jax.lax.dynamic_update_index_in_dim(
+                buf, y.astype(buf.dtype), t_out, 0)
+            return jnp.where(valid, upd, buf)
 
         vary = ("dp", "pp")
-        x0 = _pvary(jnp.zeros((mb, s, cfg.d_model), cfg.dtype), vary)
-        acc0 = (_pvary(jnp.float32(0.0), vary),
-                _pvary(jnp.float32(0.0), vary))
-        ls, cnt = pipeline_run("pp", pp, M, stage_fn, feed, collect,
-                               acc0, x0)
-        return jax.lax.psum(ls, ("dp", "pp")) / jax.lax.psum(
-            cnt, ("dp", "pp"))
+        buf0 = _pvary(jnp.zeros((M, mb, s, cfg.d_model), cfg.dtype),
+                      vary)
+        if V == 1:
+            x0 = _pvary(jnp.zeros((mb, s, cfg.d_model), cfg.dtype), vary)
+            buf = pipeline_run(
+                "pp", pp, M, lambda x: chunk_apply(params["layers"], x),
+                feed, collect, buf0, x0)
+        else:
+            ls_per = cfg.n_layers // (pp * V)
+            lgroups = jax.tree.map(
+                lambda a: a.reshape((V, ls_per) + a.shape[1:]),
+                params["layers"])
+
+            def stage_fn(v, x):
+                # v is a traced per-device chunk index: dynamic_index
+                # (not lax.switch — SPMD would run all V branches)
+                lg = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, v, 0, keepdims=False), lgroups)
+                return chunk_apply(lg, x)
+
+            x0 = _pvary(jnp.zeros((V, mb, s, cfg.d_model), cfg.dtype),
+                        vary)
+            buf = pipeline_run_interleaved(
+                "pp", pp, V, M, stage_fn, feed, collect, buf0, x0)
+        ssum, n = _nll_head(params, buf.reshape(M * mb, s, cfg.d_model),
+                            tgts.reshape(M * mb, s))
+        w = (jax.lax.axis_index("pp") == pp - 1).astype(jnp.float32)
+        # n is a static size: w*n varies over pp only — add the missing
+        # dp variance before the joint psum (w*ssum already has both:
+        # ssum derives from the dp-sharded targets)
+        cnt = _pvary(w * jnp.float32(n), ("dp",))
+        return jax.lax.psum(w * ssum, ("dp", "pp")) \
+            / jax.lax.psum(cnt, ("dp", "pp"))
 
     if optimizer is None:
         def step(params, tokens, targets):

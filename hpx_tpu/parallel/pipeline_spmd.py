@@ -38,7 +38,7 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pipeline_run"]
+__all__ = ["pipeline_run", "pipeline_run_interleaved"]
 
 
 def pipeline_run(axis: str, n_stages: int, n_microbatches: int,
@@ -85,4 +85,92 @@ def pipeline_run(axis: str, n_stages: int, n_microbatches: int,
         return (x_send, acc), None
 
     (_, acc), _ = jax.lax.scan(step, (x0, acc0), jnp.arange(M + P - 1))
+    return acc
+
+
+def pipeline_run_interleaved(axis: str, n_stages: int, n_virtual: int,
+                             n_microbatches: int,
+                             stage_fn: Callable[[jax.Array, Any], Any],
+                             feed: Callable[[jax.Array], Any],
+                             collect: Callable[[Any, Any, jax.Array,
+                                                jax.Array], Any],
+                             acc0: Any, x0_stack: Any) -> Any:
+    """Interleaved (virtual-stage) pipeline, Megatron schedule: P*V
+    stages assigned round-robin (stage s = v*P + d lives on device
+    d = s % P as its chunk v = s // P). Each scan step a device
+    computes ONE virtual chunk — 1/(P*V) of the layers — so the scan
+    runs M*V + P - 1 steps of 1/V-slice cost: bubble fraction
+    (P-1)/(M*V + P-1) versus plain GPipe's (P-1)/(M+P-1).
+
+    The slot order per device (local slot u' = step - d) is Megatron's
+    forward order — P microbatches through chunk 0, the same P through
+    chunk 1, ... then the next P:
+
+        chunk(u') = (u' % (P*V)) // P
+        mb(u')    = (u' // (P*V)) * P + (u' % P)      [needs P | M]
+
+    With every device skewed by d steps, a unit's producer always ran
+    exactly one step earlier (also across the P-1 -> 0 chunk wrap), so
+    one in-flight buffer per chunk suffices and the hop stays ONE
+    static ppermute over the full ring. Chunk selection is per-device
+    (a traced dynamic_index into the [V, ...] buffer and into the
+    caller's layer groups) — NOT a lax.switch, which SPMD would
+    execute V-fold, forfeiting the schedule's whole point.
+
+    stage_fn(v, x) applies this device's chunk v (a traced scalar —
+    dynamic_index your stacked layer groups with it). Backward is AD
+    through the scan. x0_stack: zeros_like the [V, ...] buffer,
+    pvaried to the carry's vma. collect sees stage P*V-1's outputs.
+    """
+    P, V, M = n_stages, n_virtual, n_microbatches
+    if M % P:
+        raise ValueError(
+            f"interleaved schedule needs n_microbatches ({M}) divisible "
+            f"by the stage count ({P})")
+    PV = P * V
+    idx = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % P) for i in range(P)]   # full ring, wraps
+
+    def slot(u_local):
+        v = (u_local % PV) // P
+        m = (u_local // PV) * P + (u_local % P)
+        return v, m
+
+    def upd(xs, v, val):
+        return jax.tree.map(
+            lambda b, y: jax.lax.dynamic_update_index_in_dim(
+                b, y, v, 0), xs, val)
+
+    def step(carry, u):
+        xs, acc = carry
+        ul = u - idx                       # this device's local slot
+        live = jnp.logical_and(ul >= 0, ul < M * V)
+        v, m = slot(jnp.clip(ul, 0, M * V - 1))
+        x_in = jax.tree.map(
+            lambda b: jax.lax.dynamic_index_in_dim(b, v, 0,
+                                                   keepdims=False), xs)
+        x_feed = feed(jnp.clip(m, 0, M - 1))
+        first = jnp.logical_and(idx == 0, v == 0)
+        x_in = jax.tree.map(
+            lambda f, x: jnp.where(first, f, x), x_feed, x_in)
+        y = stage_fn(v, x_in)
+        valid_out = live & (idx == P - 1) & (v == V - 1)
+        acc = collect(acc, y, m, valid_out)
+        recv = jax.tree.map(lambda a: jax.lax.ppermute(a, axis, perm), y)
+        # fold the arrival: the sender (left ring neighbor) computed its
+        # own slot at this same step; consumers use it NEXT step
+        s_idx = (idx - 1) % P
+        us = u - s_idx
+        s_live = jnp.logical_and(us >= 0, us < M * V)
+        sv, _sm = slot(jnp.clip(us, 0, M * V - 1))
+        # same chunk for d>0; the P-1 -> 0 wrap advances the chunk
+        rv = jnp.where(idx == 0, sv + 1, sv)
+        arrival = s_live & (rv <= V - 1)
+        xs_upd = upd(xs, jnp.clip(rv, 0, V - 1), recv)
+        xs = jax.tree.map(
+            lambda a, b: jnp.where(arrival, a, b), xs_upd, xs)
+        return (xs, acc), None
+
+    (_, acc), _ = jax.lax.scan(step, (x0_stack, acc0),
+                               jnp.arange(M * V + P - 1))
     return acc

@@ -77,6 +77,94 @@ class Mutex:
 Spinlock = Mutex  # host-side: same substrate; kept for API parity
 
 
+class SharedMutex:
+    """hpx::shared_mutex: many readers / one writer, writer-preferring
+    (waiting writers block NEW readers so writers can't starve), with
+    lock-verification registration on both modes."""
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    # -- exclusive ---------------------------------------------------------
+    def lock(self) -> None:
+        with self._cv:
+            self._writers_waiting += 1
+            try:
+                # finally: an async exception (KeyboardInterrupt) in
+                # the wait must not leave the waiting count raised —
+                # readers gate on it, so a leak blocks them forever
+                self._cv.wait_for(lambda: not self._writer
+                                  and self._readers == 0)
+                self._writer = True
+            finally:
+                self._writers_waiting -= 1
+        _held().append(self)
+
+    def try_lock(self) -> bool:
+        with self._cv:
+            if self._writer or self._readers:
+                return False
+            self._writer = True
+        _held().append(self)
+        return True
+
+    def unlock(self) -> None:
+        _held().remove(self)
+        with self._cv:
+            self._writer = False
+            self._cv.notify_all()
+
+    # -- shared ------------------------------------------------------------
+    def lock_shared(self) -> None:
+        with self._cv:
+            self._cv.wait_for(lambda: not self._writer
+                              and self._writers_waiting == 0)
+            self._readers += 1
+        _held().append(self)
+
+    def try_lock_shared(self) -> bool:
+        with self._cv:
+            if self._writer or self._writers_waiting:
+                return False
+            self._readers += 1
+        _held().append(self)
+        return True
+
+    def unlock_shared(self) -> None:
+        _held().remove(self)
+        with self._cv:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cv.notify_all()
+
+    def __enter__(self) -> "SharedMutex":
+        self.lock()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.unlock()
+
+    class _SharedView:
+        __slots__ = ("_m",)
+
+        def __init__(self, m: "SharedMutex") -> None:
+            self._m = m
+
+        def __enter__(self):
+            self._m.lock_shared()
+            return self._m
+
+        def __exit__(self, *exc: Any) -> None:
+            self._m.unlock_shared()
+
+    def shared(self) -> "_SharedView":
+        """`with m.shared():` — std::shared_lock analog."""
+        return SharedMutex._SharedView(self)
+
+
 class ConditionVariable:
     def __init__(self) -> None:
         self._cv = threading.Condition()

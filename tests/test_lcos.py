@@ -272,3 +272,66 @@ def test_run_guarded_concurrent_multiguard_no_deadlock():
     t1.start(); t2.start(); t1.join(); t2.join()
     hpx.wait_all(fs, timeout=10.0)
     assert all(f.is_ready() for f in fs)
+
+
+class TestSharedMutex:
+    def test_readers_share_writer_excludes(self):
+        import threading
+        m = hpx.SharedMutex()
+        m.lock_shared()
+        assert m.try_lock_shared()       # second reader enters
+        assert not m.try_lock()          # writer excluded
+        m.unlock_shared()
+        m.unlock_shared()
+        assert m.try_lock()              # now exclusive
+        assert not m.try_lock_shared()   # reader excluded
+        m.unlock()
+
+    def test_writer_preference_blocks_new_readers(self):
+        import threading
+        import time
+        m = hpx.SharedMutex()
+        m.lock_shared()
+        got_write = threading.Event()
+
+        def writer():
+            m.lock()                     # waits on the reader
+            got_write.set()
+            m.unlock()
+
+        t = threading.Thread(target=writer)
+        t.start()
+        time.sleep(0.05)                 # writer now queued
+        assert not m.try_lock_shared()   # new readers yield to writer
+        m.unlock_shared()
+        assert got_write.wait(5.0)
+        t.join(5.0)
+        with m.shared():                 # readers flow again
+            pass
+
+    def test_concurrent_reader_writer_consistency(self):
+        import threading
+        m = hpx.SharedMutex()
+        state = {"v": 0}
+        seen_torn = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                with m.shared():
+                    a = state["v"]
+                    b = state["v"]
+                    if a != b:
+                        seen_torn.append((a, b))
+
+        ts = [threading.Thread(target=reader) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for i in range(200):
+            with m:
+                state["v"] = i
+                state["v"] = i           # readers must never see a torn pair
+        stop.set()
+        for t in ts:
+            t.join(5.0)
+        assert not seen_torn

@@ -7,6 +7,7 @@ float addition), so pipelined loss and updated params must match to
 float tolerance, for any microbatch count.
 """
 
+import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -123,3 +124,71 @@ def test_pp_optax(devices):
         losses.append(float(l))
     assert losses[-1] < losses[0]
     assert all(np.isfinite(losses))
+
+
+CFG8 = tfm.TransformerConfig(vocab=32, d_model=16, n_heads=2, head_dim=8,
+                             n_layers=8, d_ff=32, lr=0.05)
+
+
+@pytest.mark.parametrize("n_microbatches", [2, 4])
+def test_interleaved_matches_unpipelined(devices, n_microbatches):
+    """interleave=2: pp*V=4 virtual stages round-robin over pp=2
+    devices must reproduce the unpipelined loss and updates exactly
+    (M must divide by pp — Megatron slot grouping)."""
+    toks, tgts = tfm.sample_batch(CFG8, 2 * n_microbatches, 8,
+                                  jax.random.PRNGKey(1))
+    mesh1 = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                 ("dp", "sp", "tp"))
+    params = tfm.init_params(CFG8, jax.random.PRNGKey(0))
+    ref_step = tfm.make_train_step(CFG8, mesh1)
+    t1, g1 = tfm.shard_batch(toks, tgts, mesh1)
+    ref_params, ref_loss = ref_step(
+        tfm.shard_params(params, CFG8, mesh1), t1, g1)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "pp"))
+    V = 2
+    stacked = tfm.prepare_pipeline_params(params, mesh, interleave=V)
+    step = tfm.make_pipelined_train_step(CFG8, mesh, n_microbatches,
+                                         interleave=V)
+    sh = NamedSharding(mesh, P("dp", None))
+    t, g = jax.device_put(toks, sh), jax.device_put(tgts, sh)
+    new_stacked, loss = step(stacked, t, g)
+    assert float(loss) == pytest.approx(float(ref_loss), abs=1e-5)
+
+    got = tfm.deinterleave_pipeline_params(
+        jax.device_get(new_stacked), 2, V)
+    want = tfm.stack_pipeline_params(jax.device_get(ref_params))
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_interleave_order_roundtrip():
+    stacked = tfm.stack_pipeline_params(
+        tfm.init_params(CFG8, jax.random.PRNGKey(2)))
+    inter = tfm.interleave_pipeline_params(stacked, 2, 2)
+    back = tfm.deinterleave_pipeline_params(inter, 2, 2)
+    for a, b in zip(jax.tree.leaves(stacked), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the permutation actually moves layers (device 0: stages 0,2 ->
+    # layers [0,1] and [4,5])
+    l0 = np.asarray(jax.tree.leaves(stacked["layers"])[0])
+    li = np.asarray(jax.tree.leaves(inter["layers"])[0])
+    np.testing.assert_array_equal(li[2], l0[4])
+
+
+def test_interleaved_rejects_bad_layer_count(devices):
+    mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "pp"))
+    with pytest.raises(ValueError, match="divisible"):
+        tfm.make_pipelined_train_step(CFG, mesh, 2, interleave=3)
+
+
+def test_interleave_params_rejects_indivisible():
+    stacked = tfm.stack_pipeline_params(
+        tfm.init_params(dataclasses.replace(CFG8, n_layers=6),
+                        jax.random.PRNGKey(0)))
+    with pytest.raises(ValueError, match="divisible"):
+        tfm.interleave_pipeline_params(stacked, 2, 2)
+    with pytest.raises(ValueError, match="divisible"):
+        tfm.deinterleave_pipeline_params(stacked, 2, 2)
