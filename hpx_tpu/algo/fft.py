@@ -37,7 +37,8 @@ import math
 from typing import Any, Optional, Tuple
 
 __all__ = ["fft", "ifft", "fft2_sharded", "ifft2_sharded", "fft_sharded",
-           "ifft_sharded", "fft2_body", "fft1d_body"]
+           "ifft_sharded", "fft2_sharded_2d", "ifft2_sharded_2d",
+           "fft2_body", "fft1d_body"]
 
 
 # ---------------------------------------------------------------------------
@@ -113,11 +114,12 @@ def _program(key, build):
     return prog
 
 
-def _shard_prog(mesh, axis, body):
+def _shard_prog(mesh, spec, body):
     import jax
     from jax import shard_map
-    from jax.sharding import PartitionSpec as P
-    spec = P(axis)
+    if isinstance(spec, str):
+        from jax.sharding import PartitionSpec as P
+        spec = P(spec)
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
                              out_specs=spec))
 
@@ -142,6 +144,53 @@ def fft2_sharded(x: Any, mesh, axis: str = "x", inverse: bool = False):
 
 def ifft2_sharded(x: Any, mesh, axis: str = "x"):
     return fft2_sharded(x, mesh, axis, inverse=True)
+
+
+def fft2_sharded_2d(x: Any, mesh, axes: Tuple[str, str] = ("x", "y"),
+                    inverse: bool = False):
+    """2-D FFT of an [N0, N1] array sharded over BOTH dims on a 2-D
+    mesh (dim 0 over axes[0], dim 1 over axes[1]) — the layout real
+    pods use (2-D ICI torus). Pencil schedule, one jitted program:
+
+        a2a over axes[1] (rows whole)  -> row FFTs   -> a2a back
+        a2a over axes[0] (cols whole)  -> column FFTs -> a2a back
+
+    Each transpose stays INSIDE one mesh axis, so every exchange rides
+    that axis's ICI ring; the other axis's sharding is untouched.
+    Per-device extents must tile: Px*Py | N0/Px-side splits, i.e.
+    N0 % (Px*Py) == 0 and N1 % (Px*Py) == 0.
+    """
+    ax0, ax1 = axes
+    px, py = mesh.shape[ax0], mesh.shape[ax1]
+    n0, n1 = x.shape
+    if n0 % (px * py) or n1 % (px * py):
+        raise ValueError(
+            f"shape {x.shape} not tileable by Px*Py = {px}*{py} on both "
+            f"dims (the intra-axis transposes re-split each dim)")
+
+    def build():
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        def body(a):                      # [N0/Px, N1/Py]
+            f = jnp.fft.ifft if inverse else jnp.fft.fft
+            # rows whole: redistribute dim 0 over the y axis too
+            t = _a2a(a, ax1, split=0, concat=1)   # [N0/(PxPy), N1]
+            t = f(t, axis=1)
+            a = _a2a(t, ax1, split=1, concat=0)   # [N0/Px, N1/Py]
+            # columns whole: redistribute dim 1 over the x axis
+            t = _a2a(a, ax0, split=1, concat=0)   # [N0, N1/(PxPy)]
+            t = f(t, axis=0)
+            return _a2a(t, ax0, split=0, concat=1)
+
+        return _shard_prog(mesh, P(ax0, ax1), body)
+
+    return _program(("fft2_2d", mesh, axes, x.shape, x.dtype.name,
+                     inverse), build)(x)
+
+
+def ifft2_sharded_2d(x: Any, mesh, axes: Tuple[str, str] = ("x", "y")):
+    return fft2_sharded_2d(x, mesh, axes, inverse=True)
 
 
 def _split_n(n: int, p: int) -> Tuple[int, int]:

@@ -580,13 +580,13 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
     (params, loss) with plain-SGD update, matching make_train_step's
     optimizer=None contract.
 
-    The loss head runs on every stage every step with non-last stages
-    masked to zero — wasted V x D FLOPs on P-1 stages that a
-    production run would hoist behind a pp-uniform lax.cond; kept
-    branch-free here for AD robustness. MoE configs take the dp/ep
-    step instead (expert all_to_all inside a pipeline stage would
-    deadlock against the pp ppermute schedule if capacity buffers
-    ever shard over dp x pp jointly).
+    The schedule stashes final-stage outputs into an [M, ...] buffer
+    and runs the loss head ONCE per device after the scan; the only
+    dead head work is that single post-scan pass on the pp-1 non-last
+    devices (their buffers are zeros, masked out of the psum). MoE
+    configs take the dp/ep step instead (expert all_to_all inside a
+    pipeline stage would deadlock against the pp ppermute schedule if
+    capacity buffers ever shard over dp x pp jointly).
 
     interleave=V > 1 runs the INTERLEAVED schedule (virtual stages,
     pipeline_run_interleaved): pp*V stages round-robin over devices,

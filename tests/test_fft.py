@@ -126,3 +126,27 @@ def test_fft2_gradients_flow(mesh8):
     e[3, 5] = eps
     fd = (loss_np(a + e) - loss_np(a - e)) / (2 * eps)
     assert np.real(np.asarray(g)[3, 5]) == pytest.approx(fd, rel=5e-2)
+
+
+def test_fft2_2d_mesh_matches_numpy(devices):
+    """Both dims sharded over a 2x4 mesh; intra-axis pencil transposes."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("x", "y"))
+    rng = np.random.default_rng(7)
+    a = (rng.standard_normal((32, 64)) +
+         1j * rng.standard_normal((32, 64))).astype(np.complex64)
+    x = jax.device_put(jnp.asarray(a),
+                       NamedSharding(mesh, P("x", "y")))
+    got = dfft.fft2_sharded_2d(x, mesh)
+    assert _rel(got, np.fft.fft2(a.astype(np.complex128))) < 1e-4
+    back = dfft.ifft2_sharded_2d(got, mesh)
+    assert _rel(back, a) < 1e-5
+
+
+def test_fft2_2d_rejects_untileable(devices):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("x", "y"))
+    a = jnp.zeros((12, 64), jnp.complex64)    # 12 % 8 != 0
+    x = jax.device_put(a, NamedSharding(mesh, P("x", "y")))
+    with pytest.raises(ValueError, match="tileable"):
+        dfft.fft2_sharded_2d(x, mesh)

@@ -299,9 +299,14 @@ class TestSharedMutex:
             got_write.set()
             m.unlock()
 
-        t = threading.Thread(target=writer)
+        t = threading.Thread(target=writer, daemon=True)
         t.start()
-        time.sleep(0.05)                 # writer now queued
+        # poll until the writer is actually queued (a bare sleep races
+        # thread scheduling on a loaded host)
+        deadline = time.monotonic() + 10.0
+        while m._writers_waiting == 0:
+            assert time.monotonic() < deadline, "writer never queued"
+            time.sleep(0.005)
         assert not m.try_lock_shared()   # new readers yield to writer
         m.unlock_shared()
         assert got_write.wait(5.0)
