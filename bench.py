@@ -11,6 +11,11 @@ Metrics (each with a DEFENSIBLE roofline as its vs_baseline):
   * flash_attention_mfu   — pallas kernel, bf16 B2/S4096/N8/H128 causal.
                             Roof: 197 bf16 TFLOP/s (v5e MXU peak);
                             value = TFLOP/s, vs_baseline = MFU.
+  * fft_1d_gflops         — 1-D complex64 FFT (2^22 pts) through
+                            algo/fft's four-step program (the
+                            distributed code path on a 1-chip mesh).
+                            vs_baseline: HBM traffic model (~6 passes
+                            of 8 B/pt) over measured time.
   * transformer_step_ms   — single-chip fwd+bwd+sgd on a 4-layer
                             d512/S1024 model; vs_baseline = achieved
                             model FLOP/s over MXU peak (MFU).
@@ -309,6 +314,51 @@ def _probe_device(timeout_s: float = 180.0) -> bool:
         return False
 
 
+def bench_fft(jax, jnp):
+    """Single-chip 1-D FFT through algo/fft's four-step program (the
+    degenerate 1-device mesh exercises the same code path the
+    distributed transform compiles). FLOP model: 5*n*log2(n). The
+    vs_baseline roof is an HBM traffic model — the transform is
+    bandwidth-bound at this size: ~3 read+write passes of 8 B/point
+    (stage FFTs + twiddle fold; the on-device transpose copies are
+    layout changes XLA mostly fuses)."""
+    import math as _m
+
+    from jax.sharding import Mesh
+    from hpx_tpu.algo import fft as dfft
+
+    n = 1 << 22                     # 32 MiB complex64
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    rng = np.random.default_rng(0)
+    v = jnp.asarray((rng.standard_normal(n) + 1j * rng.standard_normal(n)
+                     ).astype(np.complex64))
+
+    norm = jax.jit(lambda x: jnp.float32(
+        jnp.sum(jnp.abs(x).astype(jnp.float32))))
+    y = dfft.fft_sharded(v, mesh)
+    _ = float(norm(y))
+    state = [y]
+
+    def chain(k):
+        x = state[0]
+        t0 = time.perf_counter()
+        for _ in range(k):
+            # alternate directions so chained dispatches stay dependent
+            # without the values blowing up
+            x = dfft.ifft_sharded(dfft.fft_sharded(x, mesh), mesh)
+        _ = float(norm(x))
+        state[0] = x
+        return time.perf_counter() - t0
+
+    per2, spread = robust(lambda: slope_time(chain, 8, 40))
+    per = per2 / 2.0                 # one transform
+    gflops = 5 * n * _m.log2(n) / per / 1e9
+    roof_time = 6 * n * 8 / (HBM_PEAK_GBS * 1e9)
+    emit("fft_1d_gflops", gflops, "GFLOP/s", roof_time / per,
+         n=n, spread=round(spread, 3))
+    return gflops
+
+
 def main() -> None:
     if not _probe_device():
         print(json.dumps({
@@ -332,6 +382,7 @@ def main() -> None:
     bench_stencil_unfused(jax, jnp, heat_step_best)
     bench_attention(jax, jnp)
     bench_transformer(jax, jnp)
+    bench_fft(jax, jnp)
 
     vpu_rate = bench_vpu_rate(jax, jnp)
     cells_per_s, hbm_roof, spread = bench_stencil_fused(jax, jnp,
