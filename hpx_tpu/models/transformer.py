@@ -775,10 +775,19 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
 
 
 def generate(params, cfg: TransformerConfig, prompt: jax.Array,
-             max_new: int = 32, mesh=None) -> jax.Array:
-    """Greedy decode: prefill the prompt token-by-token into KV caches,
-    then emit max_new argmax tokens. Static shapes throughout (lax.scan
-    over cache positions) — one compile per (prompt_len, max_new).
+             max_new: int = 32, mesh=None, temperature: float = 0.0,
+             top_k: int = 0, eos_id: Optional[int] = None,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Decode: prefill the prompt token-by-token into KV caches, then
+    emit max_new tokens. Static shapes throughout (lax.scan over cache
+    positions) — one compile per (prompt_len, max_new).
+
+    temperature=0 (default): greedy argmax. temperature>0: sample from
+    softmax(logits/temperature), truncated to the top_k logits when
+    top_k>0 (pass `key`). Sampling keys fold in the GLOBAL batch row
+    and position, so sharded and single-device runs draw identical
+    tokens. eos_id: rows that emit it keep emitting it (done rows
+    still compute — static shapes — but their output is pinned).
 
     mesh=None: single device. Otherwise a Mesh with axes ("dp", "tp")
     (either size may be 1) runs SHARDED serving as one program: batch
@@ -786,6 +795,12 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
     — caches never replicate), params placed by shard_params, prompt
     sharded [dp, None]. Dense models only (MoE decode is the drop-free
     single-device path)."""
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature > 0 needs a PRNG key")
+    if temperature <= 0.0 and (top_k > 0 or key is not None):
+        raise ValueError(
+            "top_k/key have no effect at temperature=0 (greedy); pass "
+            "temperature > 0 to sample")
     b, plen = prompt.shape
     smax = plen + max_new
     nh, hd = cfg.n_heads, cfg.head_dim
@@ -820,9 +835,23 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
                                   caches)
         return caches
 
-    def step_token(params, carry, inp):
-        caches, _prev = carry
-        tok, pos = inp
+    def select(logits, pos, b_local):
+        """Next token from [B_local, V] logits at position `pos`."""
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            thr = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < thr, -jnp.inf, scaled)
+        # keys fold in (position, GLOBAL row): sharded == single-device
+        base = (jax.lax.axis_index("dp") * b_local if mesh is not None
+                else 0)
+        kp = jax.random.fold_in(key, pos)
+        keys = jax.vmap(lambda r: jax.random.fold_in(kp, r))(
+            base + jnp.arange(b_local))
+        return jax.vmap(jax.random.categorical)(keys, scaled)
+
+    def forward_token(params, caches, tok, pos):
         x = params["emb"][tok][:, None, :]            # [B, 1, D]
         new_caches = []
         for lp, kv in zip(params["layers"], caches):
@@ -830,29 +859,59 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
             new_caches.append(kv)
         x = _ln(x, params["ln_f"])
         logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-        nxt = jnp.argmax(logits[:, 0, :], axis=-1)
-        return (new_caches, nxt), nxt
+        return new_caches, logits[:, 0, :]
+
+    def step_token(params, carry, inp):
+        caches, _prev = carry
+        tok, pos = inp
+        caches, logits = forward_token(params, caches, tok, pos)
+        nxt = select(logits, pos, tok.shape[0])
+        return (caches, nxt), nxt
 
     def run(params, prompt):
         b_local = prompt.shape[0]
         caches = fresh_cache(b_local, cfg.kv_heads // tp)
-        carry = (caches, prompt[:, 0])
-        # prefill: feed prompt tokens at positions 0..plen-1
+        # prefill: feed prompt tokens at positions 0..plen-1; the scan
+        # carries raw LOGITS and selection happens once afterwards —
+        # per-position sampling work inside the prefill scan would be
+        # computed and discarded for all but the last position
+        logits0 = jnp.zeros((b_local, cfg.vocab), jnp.float32)
+        if mesh is not None:
+            from ..ops.attention import _pvary
+            logits0 = _pvary(logits0, ("dp",))
+
+        def prefill(carry, inp):
+            caches, _ = carry
+            tok, pos = inp
+            caches, logits = forward_token(params, caches, tok, pos)
+            return (caches, logits.astype(jnp.float32)), None
+
+        (caches, last_logits), _ = jax.lax.scan(
+            prefill, (caches, logits0), (prompt.T, jnp.arange(plen)))
+        # t0 = the prediction following the last prompt token, drawn at
+        # position plen-1 (same key fold the in-scan path would use)
+        tok0 = select(last_logits, plen - 1, b_local)
         step = functools.partial(step_token, params)
-        carry, _ = jax.lax.scan(
-            step, carry, (prompt.T, jnp.arange(plen)))
-        # decode: feed back the argmax token. After prefill the carry
-        # already holds t0 (the prediction following the last prompt
-        # token), so each step emits the token it FEEDS — emitting the
-        # step's own prediction instead would drop t0 and shift the
-        # whole output by one.
+        # decode: feed back the selected token; each step emits the
+        # token it FEEDS — emitting the step's own prediction instead
+        # would drop t0 and shift the whole output by one.
+        done0 = jnp.zeros((b_local,), jnp.bool_)
+        if mesh is not None:
+            from ..ops.attention import _pvary
+            done0 = _pvary(done0, ("dp",))
+
         def gen(carry, pos):
-            caches, tok = carry
+            caches, tok, done = carry
+            if eos_id is not None:
+                tok = jnp.where(done, jnp.int32(eos_id),
+                                tok.astype(jnp.int32))
             (caches, nxt), _ = step((caches, tok), (tok, pos))
-            return (caches, nxt), tok
+            if eos_id is not None:
+                done = jnp.logical_or(done, tok == eos_id)
+            return (caches, nxt, done), tok
 
         _carry, toks = jax.lax.scan(
-            gen, carry, jnp.arange(plen, smax))
+            gen, (caches, tok0, done0), jnp.arange(plen, smax))
         return toks.T                                  # [B_local, max_new]
 
     if mesh is None:
