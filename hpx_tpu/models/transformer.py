@@ -221,14 +221,21 @@ def _ln(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
 
+def _dq(w, like):
+    """Dequantize int8 serving weights at use (models/quant.QTensor);
+    dense weights pass through untouched."""
+    from .quant import dequant
+    return dequant(w, like.dtype)
+
+
 def _qkv_proj(h, lp):
     """Project to (q, k, v); GQA layouts ("wq"+"wkv") give k/v their
     smaller head count."""
     if "wqkv" in lp:
-        q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wqkv"])
+        q, k, v = jnp.einsum("bsd,cdnh->cbsnh", h, _dq(lp["wqkv"], h))
         return q, k, v
-    q = jnp.einsum("bsd,dnh->bsnh", h, lp["wq"])
-    k, v = jnp.einsum("bsd,cdnh->cbsnh", h, lp["wkv"])
+    q = jnp.einsum("bsd,dnh->bsnh", h, _dq(lp["wq"], h))
+    k, v = jnp.einsum("bsd,cdnh->cbsnh", h, _dq(lp["wkv"], h))
     return q, k, v
 
 
@@ -750,7 +757,7 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
                   -jnp.inf)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
     att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, sq, nq, hd)
-    o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
+    o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
     if tp_axis:
         o = jax.lax.psum(o, tp_axis)
     x = x + o
@@ -768,7 +775,7 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
                                    capacity_factor=float(cfg.n_experts))
         out, _aux = moe_ffn(h.reshape(b * s, d), lp["moe"], mcfg)
         return x + out.reshape(b, s, d), (kc, vc)
-    h = jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"]
+    h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
     if tp_axis:
         h = jax.lax.psum(h, tp_axis)
     return x + h, (kc, vc)
@@ -801,6 +808,15 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         raise ValueError(
             "top_k/key have no effect at temperature=0 (greedy); pass "
             "temperature > 0 to sample")
+    if mesh is not None:
+        from .quant import QTensor
+        if any(isinstance(x, QTensor) for x in jax.tree.leaves(
+                params, is_leaf=lambda x: isinstance(x, QTensor))):
+            raise NotImplementedError(
+                "quantized sharded decode is not wired; serve int8 "
+                "weights single-device (models/quant.py)")
+    from ..ops.attention import _pvary
+
     b, plen = prompt.shape
     smax = plen + max_new
     nh, hd = cfg.n_heads, cfg.head_dim
@@ -830,7 +846,6 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         if mesh is not None:
             # zeros are axis-invariant; the scanned k/v updates vary
             # over dp (batch) and tp (heads) — match the carry's vma
-            from ..ops.attention import _pvary
             caches = jax.tree.map(lambda z: _pvary(z, ("dp", "tp")),
                                   caches)
         return caches
@@ -877,7 +892,6 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         # computed and discarded for all but the last position
         logits0 = jnp.zeros((b_local, cfg.vocab), jnp.float32)
         if mesh is not None:
-            from ..ops.attention import _pvary
             logits0 = _pvary(logits0, ("dp",))
 
         def prefill(carry, inp):
@@ -897,7 +911,6 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         # would drop t0 and shift the whole output by one.
         done0 = jnp.zeros((b_local,), jnp.bool_)
         if mesh is not None:
-            from ..ops.attention import _pvary
             done0 = _pvary(done0, ("dp",))
 
         def gen(carry, pos):

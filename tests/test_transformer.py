@@ -588,3 +588,82 @@ class TestSamplingDecode:
         with pytest.raises(ValueError, match="PRNG key"):
             tfm.generate(params, CFG, jnp.ones((1, 3), jnp.int32),
                          max_new=2, temperature=1.0)
+
+
+class TestQuantizedServing:
+    def test_quantized_decode_runs_and_logits_close(self):
+        from hpx_tpu.models import quant
+        cfg = tfm.TransformerConfig(vocab=64, d_model=64, n_heads=4,
+                                    head_dim=16, n_layers=2, d_ff=128)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(40))
+        qp = quant.quantize_params(params)
+        prompt = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        dense = tfm.generate(params, cfg, prompt, max_new=6)
+        q = tfm.generate(qp, cfg, prompt, max_new=6)
+        assert q.shape == dense.shape
+        assert (np.asarray(q) >= 0).all() and \
+            (np.asarray(q) < cfg.vocab).all()
+        # real closeness check: full-sequence logits through the two
+        # weight sets (a wrong scale axis would blow this up)
+        from hpx_tpu.models.transformer import _ln, _qkv_proj, _dq
+        from hpx_tpu.ops.attention import blockwise_attention
+
+        def fwd(p, toks):
+            x = p["emb"][toks]
+            for lp in p["layers"]:
+                h = _ln(x, lp["ln1"])
+                qh, kh, vh = _qkv_proj(h, lp)
+                att = blockwise_attention(qh, kh, vh, causal=True)
+                x = x + jnp.einsum("bsnh,nhd->bsd", att,
+                                   _dq(lp["wo"], att))
+                h = _ln(x, lp["ln2"])
+                x = x + jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) \
+                    @ _dq(lp["w2"], h)
+            return jnp.einsum("bsd,vd->bsv", _ln(x, p["ln_f"]), p["emb"])
+
+        ld = np.asarray(fwd(params, prompt), np.float32)
+        lq = np.asarray(fwd(qp, prompt), np.float32)
+        rel = np.linalg.norm(ld - lq) / np.linalg.norm(ld)
+        assert rel < 0.02, rel
+
+    def test_quantization_error_bounded(self):
+        """Per-channel int8 roundtrip error on each weight < 1%."""
+        from hpx_tpu.models import quant
+        cfg = tfm.TransformerConfig(vocab=32, d_model=64, n_heads=4,
+                                    head_dim=16, n_layers=1, d_ff=128)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(41))
+        qp = quant.quantize_params(params)
+        for name in ("wqkv", "wo", "w1", "w2"):
+            w = np.asarray(params["layers"][0][name], np.float32)
+            wq = np.asarray(quant.dequant(qp["layers"][0][name],
+                                          jnp.float32))
+            rel = np.linalg.norm(w - wq) / np.linalg.norm(w)
+            assert rel < 0.01, (name, rel)
+
+    def test_memory_shrinks_4x(self):
+        from hpx_tpu.models import quant
+        cfg = tfm.TransformerConfig(vocab=32, d_model=128, n_heads=4,
+                                    head_dim=32, n_layers=2, d_ff=512)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(42))
+        dense_bytes = quant.quantized_bytes(params["layers"])
+        q_bytes = quant.quantized_bytes(
+            quant.quantize_params(params)["layers"])
+        assert q_bytes < dense_bytes * 0.3       # f32 -> int8 + scales
+
+    def test_gqa_quantized(self):
+        from hpx_tpu.models import quant
+        qp = quant.quantize_params(
+            tfm.init_params(GQA_CFG, jax.random.PRNGKey(43)))
+        out = tfm.generate(qp, GQA_CFG, jnp.array([[1, 2]], jnp.int32),
+                           max_new=4)
+        assert out.shape == (1, 4)
+
+    def test_sharded_quantized_rejected(self, devices):
+        from jax.sharding import Mesh
+        from hpx_tpu.models import quant
+        mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "tp"))
+        qp = quant.quantize_params(
+            tfm.init_params(CFG, jax.random.PRNGKey(44)))
+        with pytest.raises(NotImplementedError, match="quantized"):
+            tfm.generate(qp, CFG, jnp.ones((2, 3), jnp.int32),
+                         max_new=2, mesh=mesh)
