@@ -37,7 +37,8 @@ __all__ = ["TransformerConfig", "init_params", "make_train_step",
            "make_opt_state", "generate", "make_pipelined_train_step",
            "stack_pipeline_params", "shard_pipeline_params",
            "pipelined_param_specs", "interleave_pipeline_params",
-           "deinterleave_pipeline_params", "prepare_pipeline_params"]
+           "deinterleave_pipeline_params", "prepare_pipeline_params",
+           "beam_search"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -781,6 +782,22 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
     return x + h, (kc, vc)
 
 
+def _decode_forward(params, caches, tok, pos, cfg, tp_axis=None):
+    """One decode token through every block: embed -> cached blocks ->
+    final ln -> tied-embedding logits. Returns (caches, f32 logits
+    [B, V]) — f32 so scan carries are dtype-stable whatever the model
+    dtype. Shared by generate() and beam_search(): any change to the
+    per-token forward lands in both decoders."""
+    x = params["emb"][tok][:, None, :]
+    new_caches = []
+    for lp, kv in zip(params["layers"], caches):
+        x, kv = _block_decode(x, lp, kv, pos, cfg, tp_axis=tp_axis)
+        new_caches.append(kv)
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+    return new_caches, logits[:, 0, :].astype(jnp.float32)
+
+
 def generate(params, cfg: TransformerConfig, prompt: jax.Array,
              max_new: int = 32, mesh=None, temperature: float = 0.0,
              top_k: int = 0, eos_id: Optional[int] = None,
@@ -867,14 +884,8 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         return jax.vmap(jax.random.categorical)(keys, scaled)
 
     def forward_token(params, caches, tok, pos):
-        x = params["emb"][tok][:, None, :]            # [B, 1, D]
-        new_caches = []
-        for lp, kv in zip(params["layers"], caches):
-            x, kv = _block_decode(x, lp, kv, pos, cfg, tp_axis=tp_axis)
-            new_caches.append(kv)
-        x = _ln(x, params["ln_f"])
-        logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-        return new_caches, logits[:, 0, :]
+        return _decode_forward(params, caches, tok, pos, cfg,
+                               tp_axis=tp_axis)
 
     def step_token(params, carry, inp):
         caches, _prev = carry
@@ -898,7 +909,7 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
             caches, _ = carry
             tok, pos = inp
             caches, logits = forward_token(params, caches, tok, pos)
-            return (caches, logits.astype(jnp.float32)), None
+            return (caches, logits), None
 
         (caches, last_logits), _ = jax.lax.scan(
             prefill, (caches, logits0), (prompt.T, jnp.arange(plen)))
@@ -939,6 +950,83 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         out_specs=data_spec))
     prompt = jax.device_put(prompt, NamedSharding(mesh, data_spec))
     return prog(params, prompt)
+
+
+def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,
+                max_new: int = 32, beam_width: int = 4,
+                return_all: bool = False):
+    """Beam-search decode (single device): keep the beam_width highest
+    total-log-probability continuations per row. Static shapes: the
+    prompt prefills once at batch B, then beams run flat at B*W with
+    per-step cache reordering (gather by surviving parent). Returns
+    the best [B, max_new] sequences, or (tokens [B, W, max_new],
+    scores [B, W]) sorted best-first when return_all.
+
+    beam_width=1 reproduces greedy decode exactly. No eos handling —
+    beams run to max_new (finished-hypothesis freezing composes with
+    this scheme but is not wired)."""
+    if beam_width < 1:
+        raise ValueError("beam_width >= 1")
+    b, plen = prompt.shape
+    w = beam_width
+    smax = plen + max_new
+    hd = cfg.head_dim
+
+    @jax.jit
+    def run(params, prompt):
+        nkv = cfg.kv_heads
+        caches = [(jnp.zeros((b, smax, nkv, hd), cfg.dtype),
+                   jnp.zeros((b, smax, nkv, hd), cfg.dtype))
+                  for _ in range(cfg.n_layers)]
+
+        def prefill(carry, inp):
+            caches, _ = carry
+            tok, pos = inp
+            caches, logits = _decode_forward(params, caches, tok, pos,
+                                             cfg)
+            return (caches, logits), None
+
+        (caches, logits), _ = jax.lax.scan(
+            prefill,
+            (caches, jnp.zeros((b, cfg.vocab), jnp.float32)),
+            (prompt.T, jnp.arange(plen)))
+
+        # tile beams: all start identical; only beam 0 is live so the
+        # duplicates can't multiply into the topk
+        caches = jax.tree.map(lambda a: jnp.repeat(a, w, axis=0), caches)
+        scores = jnp.full((b, w), -jnp.inf).at[:, 0].set(0.0)
+        logits = jnp.repeat(logits, w, axis=0)          # [B*W, V]
+        hist = jnp.zeros((b, w, max_new), jnp.int32)
+
+        def step(carry, t):
+            caches, scores, hist, logits = carry
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32)).reshape(b, w, cfg.vocab)
+            cand = scores[:, :, None] + logp            # [B, W, V]
+            top, idx = jax.lax.top_k(cand.reshape(b, -1), w)
+            parent = idx // cfg.vocab                   # [B, W]
+            tok = (idx % cfg.vocab).astype(jnp.int32)
+            flat_parent = (jnp.arange(b)[:, None] * w + parent
+                           ).reshape(-1)
+            caches = jax.tree.map(lambda a: a[flat_parent], caches)
+            hist = jnp.take_along_axis(hist, parent[..., None], axis=1)
+            hist = jax.lax.dynamic_update_index_in_dim(
+                hist, tok, t, axis=2)
+            caches, logits = _decode_forward(
+                params, caches, tok.reshape(-1), plen + t, cfg)
+            return (caches, top, hist, logits), None
+
+        (caches, scores, hist, _), _ = jax.lax.scan(
+            step, (caches, scores, hist, logits), jnp.arange(max_new))
+        order = jnp.argsort(-scores, axis=1)
+        hist = jnp.take_along_axis(hist, order[..., None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        return hist, scores
+
+    hist, scores = run(params, prompt)
+    if return_all:
+        return hist, scores
+    return hist[:, 0, :]
 
 
 def make_opt_state(params, cfg: TransformerConfig, mesh, optimizer: Any):

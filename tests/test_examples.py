@@ -41,6 +41,7 @@ def run_distributed(name, localities, timeout=240):
     ("checkpointed_stencil.py", ["128", "4", "8"]),
     ("fft_distributed.py", ["12", "14"]),
     ("pipeline_train.py", ["4"]),
+    ("serving_demo.py", []),
 ])
 def test_example_single(name, args):
     r = run_example(name, *args)

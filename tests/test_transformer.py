@@ -667,3 +667,84 @@ class TestQuantizedServing:
         with pytest.raises(NotImplementedError, match="quantized"):
             tfm.generate(qp, CFG, jnp.ones((2, 3), jnp.int32),
                          max_new=2, mesh=mesh)
+
+
+class TestBeamSearch:
+    def _trained(self, seed=50):
+        mesh1 = tfm.make_mesh_3d(1)
+        params = tfm.shard_params(
+            tfm.init_params(CFG, jax.random.PRNGKey(seed)), CFG, mesh1)
+        step = tfm.make_train_step(CFG, mesh1)
+        toks, tgts = tfm.sample_batch(CFG, batch=4, seq=16,
+                                      key=jax.random.PRNGKey(seed + 1))
+        toks, tgts = tfm.shard_batch(toks, tgts, mesh1)
+        for _ in range(25):
+            params, _ = step(params, toks, tgts)
+        return jax.device_get(params)
+
+    def test_beam_one_equals_greedy(self):
+        params = self._trained()
+        prompt = jnp.array([[3, 1, 4], [2, 7, 1]], jnp.int32)
+        greedy = tfm.generate(params, CFG, prompt, max_new=8)
+        beam1 = tfm.beam_search(params, CFG, prompt, max_new=8,
+                                beam_width=1)
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(beam1))
+
+    def test_beam_score_at_least_greedy(self):
+        """The best beam's total logprob must be >= the greedy
+        sequence's (greedy is in the search space of width >= 1)."""
+        params = self._trained(seed=60)
+        prompt = jnp.array([[1, 2, 3]], jnp.int32)
+        max_new = 8
+        greedy = np.asarray(tfm.generate(params, CFG, prompt,
+                                         max_new=max_new))
+        beams, scores = tfm.beam_search(params, CFG, prompt,
+                                        max_new=max_new, beam_width=4,
+                                        return_all=True)
+
+        def seq_logprob(tokens):
+            # teacher-force through generate's own blocks
+            from hpx_tpu.models.transformer import (_block_decode, _ln)
+            caches = [(jnp.zeros((1, 3 + max_new, CFG.kv_heads,
+                                  CFG.head_dim), CFG.dtype),) * 2
+                      for _ in range(CFG.n_layers)]
+            total, seq = 0.0, [1, 2, 3] + list(tokens)
+            for pos in range(len(seq) - 1):
+                x = params["emb"][jnp.array([seq[pos]])][:, None, :]
+                new_c = []
+                for lp, kv in zip(params["layers"], caches):
+                    x, kv = _block_decode(x, lp, kv, pos, CFG)
+                    new_c.append(kv)
+                caches = new_c
+                x = _ln(x, params["ln_f"])
+                logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+                lp_ = jax.nn.log_softmax(
+                    logits[0, 0].astype(jnp.float32))
+                if pos >= 2:            # predictions beyond the prompt
+                    total += float(lp_[seq[pos + 1]])
+            return total
+
+        g = seq_logprob(greedy[0].tolist())
+        b = seq_logprob(np.asarray(beams)[0, 0].tolist())
+        assert b >= g - 1e-4
+        assert float(scores[0, 0]) == pytest.approx(b, abs=1e-3)
+
+    def test_beam_shapes_and_sorted(self):
+        params = tfm.init_params(CFG, jax.random.PRNGKey(70))
+        prompt = jnp.array([[1, 2], [3, 4], [5, 6]], jnp.int32)
+        beams, scores = tfm.beam_search(params, CFG, prompt, max_new=5,
+                                        beam_width=3, return_all=True)
+        assert beams.shape == (3, 3, 5) and scores.shape == (3, 3)
+        s = np.asarray(scores)
+        assert (s[:, :-1] >= s[:, 1:] - 1e-6).all()
+
+    def test_beam_bf16_model(self):
+        """Regression: the logits scan carry must stay f32 whatever the
+        model dtype (bf16 once crashed the carry-type check)."""
+        cfg = dataclasses.replace(CFG, dtype=jnp.bfloat16)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(80))
+        out = tfm.beam_search(params, cfg,
+                              jnp.array([[1, 2, 3]], jnp.int32),
+                              max_new=4, beam_width=3)
+        assert out.shape == (1, 4)
