@@ -798,6 +798,25 @@ def _decode_forward(params, caches, tok, pos, cfg, tp_axis=None):
     return new_caches, logits[:, 0, :].astype(jnp.float32)
 
 
+def _prefill_scan(params, cfg, caches, prompt, logits0, tp_axis=None):
+    """Feed the prompt token-by-token into the caches; returns
+    (caches, logits after the LAST prompt token). Selection happens
+    outside — per-position sampling inside the scan would be computed
+    and discarded for all but the last position. Shared by generate()
+    and beam_search()."""
+    def prefill(carry, inp):
+        caches, _ = carry
+        tok, pos = inp
+        caches, logits = _decode_forward(params, caches, tok, pos, cfg,
+                                         tp_axis=tp_axis)
+        return (caches, logits), None
+
+    (caches, last), _ = jax.lax.scan(
+        prefill, (caches, logits0),
+        (prompt.T, jnp.arange(prompt.shape[1])))
+    return caches, last
+
+
 def generate(params, cfg: TransformerConfig, prompt: jax.Array,
              max_new: int = 32, mesh=None, temperature: float = 0.0,
              top_k: int = 0, eos_id: Optional[int] = None,
@@ -904,15 +923,8 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         logits0 = jnp.zeros((b_local, cfg.vocab), jnp.float32)
         if mesh is not None:
             logits0 = _pvary(logits0, ("dp",))
-
-        def prefill(carry, inp):
-            caches, _ = carry
-            tok, pos = inp
-            caches, logits = forward_token(params, caches, tok, pos)
-            return (caches, logits), None
-
-        (caches, last_logits), _ = jax.lax.scan(
-            prefill, (caches, logits0), (prompt.T, jnp.arange(plen)))
+        caches, last_logits = _prefill_scan(params, cfg, caches, prompt,
+                                            logits0, tp_axis=tp_axis)
         # t0 = the prediction following the last prompt token, drawn at
         # position plen-1 (same key fold the in-scan path would use)
         tok0 = select(last_logits, plen - 1, b_local)
@@ -979,17 +991,9 @@ def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,
                    jnp.zeros((b, smax, nkv, hd), cfg.dtype))
                   for _ in range(cfg.n_layers)]
 
-        def prefill(carry, inp):
-            caches, _ = carry
-            tok, pos = inp
-            caches, logits = _decode_forward(params, caches, tok, pos,
-                                             cfg)
-            return (caches, logits), None
-
-        (caches, logits), _ = jax.lax.scan(
-            prefill,
-            (caches, jnp.zeros((b, cfg.vocab), jnp.float32)),
-            (prompt.T, jnp.arange(plen)))
+        caches, logits = _prefill_scan(
+            params, cfg, caches, prompt,
+            jnp.zeros((b, cfg.vocab), jnp.float32))
 
         # tile beams: all start identical; only beam 0 is live so the
         # duplicates can't multiply into the topk

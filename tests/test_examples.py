@@ -20,7 +20,9 @@ def run_example(name, *args, timeout=240):
         cwd=REPO, capture_output=True, text=True, timeout=timeout)
 
 
-def run_distributed(name, localities, timeout=240):
+def run_distributed(name, localities, timeout=420):
+    # generous: the full suite serializes everything onto one sandbox
+    # core, and each locality is a fresh interpreter + jax import
     return subprocess.run(
         [sys.executable, "-m", "hpx_tpu.run", "-l", str(localities),
          "--timeout", str(timeout - 20),

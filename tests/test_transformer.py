@@ -704,23 +704,16 @@ class TestBeamSearch:
                                         return_all=True)
 
         def seq_logprob(tokens):
-            # teacher-force through generate's own blocks
-            from hpx_tpu.models.transformer import (_block_decode, _ln)
+            # teacher-force through THE decoder's own per-token forward
+            from hpx_tpu.models.transformer import _decode_forward
             caches = [(jnp.zeros((1, 3 + max_new, CFG.kv_heads,
                                   CFG.head_dim), CFG.dtype),) * 2
                       for _ in range(CFG.n_layers)]
             total, seq = 0.0, [1, 2, 3] + list(tokens)
             for pos in range(len(seq) - 1):
-                x = params["emb"][jnp.array([seq[pos]])][:, None, :]
-                new_c = []
-                for lp, kv in zip(params["layers"], caches):
-                    x, kv = _block_decode(x, lp, kv, pos, CFG)
-                    new_c.append(kv)
-                caches = new_c
-                x = _ln(x, params["ln_f"])
-                logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-                lp_ = jax.nn.log_softmax(
-                    logits[0, 0].astype(jnp.float32))
+                caches, logits = _decode_forward(
+                    params, caches, jnp.array([seq[pos]]), pos, CFG)
+                lp_ = jax.nn.log_softmax(logits[0])
                 if pos >= 2:            # predictions beyond the prompt
                     total += float(lp_[seq[pos + 1]])
             return total
