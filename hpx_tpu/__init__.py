@@ -40,6 +40,7 @@ from .runtime.resource import (  # noqa: F401
     Pool, ResourcePartitioner, get_partitioner,
 )
 from .runtime import batch_environments  # noqa: F401
+from .runtime.dataloader import DeviceLoader, device_loader  # noqa: F401
 
 __version__ = full_version_as_string()
 

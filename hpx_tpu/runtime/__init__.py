@@ -1,2 +1,3 @@
 from .threadpool import WorkStealingPool, default_pool, reset_default_pool  # noqa: F401
 from .io_service import IoServicePool, get_io_service_pool, io_pool_names  # noqa: F401
+from .dataloader import DeviceLoader, device_loader  # noqa: F401

@@ -1,0 +1,147 @@
+"""Prefetching device input pipeline — the data-loader component.
+
+Reference analog: HPX ships no ML data loader; the driver's native
+inventory names one anyway (SURVEY.md §2.8 table: runtime components
+around the compute path). The TPU-native shape: training steps must
+never wait on host work, so batches are produced by a HOST iterator
+(user code: file reads, tokenization, augmentation) running on
+io_service helper threads, staged onto the device (or a sharded mesh
+placement) AHEAD of consumption, and handed to the step as
+already-resident jax.Arrays. jax's async dispatch then overlaps step k
+with the device_put of batch k+1 and the host production of k+2 — a
+three-stage pipeline from one `for batch in loader:` loop.
+
+Design points:
+  * the producer runs on a dedicated IoServicePool thread ("data" by
+    default), NOT the compute pool — it may block on IO;
+  * a bounded queue provides backpressure (prefetch_depth batches
+    resident at once — device memory is the budget);
+  * device placement happens on the producer side via device_put with
+    an optional NamedSharding, so consumption is a queue pop;
+  * exceptions in the producer surface at the consumer's next pop,
+    carrying the original traceback; StopIteration ends the stream;
+  * `loader.stop()` (or breaking out and letting it be GC'd) shuts the
+    producer down without draining the source.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from .io_service import get_io_service_pool
+
+__all__ = ["DeviceLoader", "device_loader"]
+
+_STOP = object()
+
+
+def _bounded_put(q: queue.Queue, stop: threading.Event, item: Any) -> bool:
+    """Put with backpressure that stays responsive to stop(); returns
+    False if the stream was abandoned."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _produce(q: queue.Queue, stop: threading.Event, source: Iterable[Any],
+             transform: Optional[Callable[[Any], Any]],
+             sharding: Any) -> None:
+    """Producer body. Takes every piece of state BY VALUE — it must
+    hold no reference to the DeviceLoader, so an abandoned loader is
+    garbage-collectable and its __del__ can stop this loop."""
+    import jax
+    try:
+        for item in source:
+            if stop.is_set():
+                return
+            if transform is not None:
+                item = transform(item)
+            # device_put traverses pytrees natively (one batched call)
+            item = (jax.device_put(item, sharding) if sharding is not None
+                    else jax.device_put(item))
+            if not _bounded_put(q, stop, item):
+                return
+    except BaseException as e:  # noqa: BLE001 — surfaces at the pop
+        _bounded_put(q, stop, ("__error__", e))
+        return
+    _bounded_put(q, stop, _STOP)
+
+
+class DeviceLoader:
+    """Wrap a host batch iterable; iterate device-resident batches.
+
+        loader = DeviceLoader(batches, sharding=NamedSharding(mesh, P("dp")))
+        for x in loader:          # x already on device / sharded
+            params, loss = step(params, x)
+
+    SINGLE-PASS, like a generator: construct a fresh loader per epoch
+    (a second iteration raises). Break out early with `stop()` (or
+    just drop the loader — the producer holds no reference to it, so
+    garbage collection stops the stream).
+    """
+
+    def __init__(self, source: Iterable[Any],
+                 sharding: Any = None,
+                 prefetch_depth: int = 2,
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 pool_name: str = "data") -> None:
+        if prefetch_depth < 1:
+            raise ValueError("prefetch_depth >= 1")
+        self._source = source
+        self._sharding = sharding
+        self._transform = transform
+        self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._pool = get_io_service_pool(pool_name)
+        self._started = False
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        if self._started:
+            raise RuntimeError(
+                "DeviceLoader is single-pass (its source was already "
+                "consumed); construct a new loader per epoch")
+        self._started = True
+        self._pool.post(_produce, self._q, self._stop, self._source,
+                        self._transform, self._sharding)
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return             # stop() raced an empty queue
+                continue
+            if item is _STOP:
+                return
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] == "__error__"):
+                self._stop.set()
+                raise item[1]
+            yield item
+
+    def stop(self) -> None:
+        """Abandon the stream; the producer exits at its next check and
+        a consumer blocked on the queue wakes and returns."""
+        self._stop.set()
+        # unblock a producer stuck on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self) -> None:  # best-effort
+        try:
+            self._stop.set()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def device_loader(source: Iterable[Any], **kwargs: Any) -> DeviceLoader:
+    return DeviceLoader(source, **kwargs)
