@@ -4,24 +4,32 @@ Reference analog: HPX ships no ML data loader; the driver's native
 inventory names one anyway (SURVEY.md §2.8 table: runtime components
 around the compute path). The TPU-native shape: training steps must
 never wait on host work, so batches are produced by a HOST iterator
-(user code: file reads, tokenization, augmentation) running on
-io_service helper threads, staged onto the device (or a sharded mesh
+(user code: file reads, tokenization, augmentation) running on its
+own producer thread, staged onto the device (or a sharded mesh
 placement) AHEAD of consumption, and handed to the step as
 already-resident jax.Arrays. jax's async dispatch then overlaps step k
 with the device_put of batch k+1 and the host production of k+2 — a
 three-stage pipeline from one `for batch in loader:` loop.
 
 Design points:
-  * the producer runs on a dedicated IoServicePool thread ("data" by
-    default), NOT the compute pool — it may block on IO;
+  * the producer runs on a DEDICATED daemon thread per loader — a
+    streaming loop must not time-share a fire-and-forget helper-pool
+    slot (two concurrent loaders on a 1-thread pool would deadlock:
+    the first holds the thread for its whole lifetime), and loader
+    lifetime is governed by the loader, not pool shutdown;
   * a bounded queue provides backpressure (prefetch_depth batches
     resident at once — device memory is the budget);
   * device placement happens on the producer side via device_put with
     an optional NamedSharding, so consumption is a queue pop;
   * exceptions in the producer surface at the consumer's next pop,
     carrying the original traceback; StopIteration ends the stream;
-  * `loader.stop()` (or breaking out and letting it be GC'd) shuts the
-    producer down without draining the source.
+  * leaving iteration EARLY — break, an exception in the loop body,
+    `stop()`, or dropping the loader — shuts the producer down at its
+    next between-items check without draining the source. A source
+    whose own __next__ BLOCKS indefinitely cannot be preempted
+    (Python offers no way to interrupt it); its daemon thread lingers
+    until the source yields or the process exits — bound your
+    source's reads if that matters.
 """
 
 from __future__ import annotations
@@ -29,8 +37,6 @@ from __future__ import annotations
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
-
-from .io_service import get_io_service_pool
 
 __all__ = ["DeviceLoader", "device_loader"]
 
@@ -89,8 +95,7 @@ class DeviceLoader:
     def __init__(self, source: Iterable[Any],
                  sharding: Any = None,
                  prefetch_depth: int = 2,
-                 transform: Optional[Callable[[Any], Any]] = None,
-                 pool_name: str = "data") -> None:
+                 transform: Optional[Callable[[Any], Any]] = None) -> None:
         if prefetch_depth < 1:
             raise ValueError("prefetch_depth >= 1")
         self._source = source
@@ -98,7 +103,6 @@ class DeviceLoader:
         self._transform = transform
         self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
-        self._pool = get_io_service_pool(pool_name)
         self._started = False
 
     # -- consumer ----------------------------------------------------------
@@ -108,22 +112,29 @@ class DeviceLoader:
                 "DeviceLoader is single-pass (its source was already "
                 "consumed); construct a new loader per epoch")
         self._started = True
-        self._pool.post(_produce, self._q, self._stop, self._source,
-                        self._transform, self._sharding)
-        while True:
-            try:
-                item = self._q.get(timeout=0.1)
-            except queue.Empty:
-                if self._stop.is_set():
-                    return             # stop() raced an empty queue
-                continue
-            if item is _STOP:
-                return
-            if (isinstance(item, tuple) and len(item) == 2
-                    and item[0] == "__error__"):
-                self._stop.set()
-                raise item[1]
-            yield item
+        threading.Thread(
+            target=_produce,
+            args=(self._q, self._stop, self._source, self._transform,
+                  self._sharding),
+            daemon=True, name="hpx-data-loader").start()
+        try:
+            while True:
+                try:
+                    item = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return         # stop() raced an empty queue
+                    continue
+                if item is _STOP:
+                    return
+                if (isinstance(item, tuple) and len(item) == 2
+                        and item[0] == "__error__"):
+                    raise item[1]
+                yield item
+        finally:
+            # generator close (break / exception in the consumer loop)
+            # behaves like stop(): the producer exits at its next check
+            self._stop.set()
 
     def stop(self) -> None:
         """Abandon the stream; the producer exits at its next check and

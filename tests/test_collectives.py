@@ -244,7 +244,7 @@ def test_multiprocess_collectives_3_localities():
     repo = os.path.join(os.path.dirname(__file__), "..")
     rc = launch(os.path.join(repo, "tests", "mp_scripts",
                              "collectives_smoke.py"),
-                [], localities=3, timeout=240.0)
+                [], localities=3, timeout=420.0)
     assert rc == 0
 
 

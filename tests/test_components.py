@@ -182,5 +182,5 @@ def test_multiprocess_components():
     from hpx_tpu.run import launch
     rc = launch(os.path.join(REPO, "tests", "mp_scripts",
                              "components_smoke.py"),
-                [], localities=3, timeout=240.0)
+                [], localities=3, timeout=420.0)
     assert rc == 0

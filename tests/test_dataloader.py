@@ -160,3 +160,33 @@ def test_stop_wakes_blocked_consumer():
     loader.stop()
     t.join(5.0)
     assert not t.is_alive()
+
+
+def test_two_concurrent_loaders():
+    """Streaming loops get dedicated threads: two live loaders must
+    interleave (a shared 1-thread pool would deadlock the second)."""
+    a = DeviceLoader([np.float32(i) for i in range(4)])
+    b = DeviceLoader([np.float32(10 + i) for i in range(4)])
+    pairs = list(zip(iter(a), iter(b)))
+    assert [(float(x), float(y)) for x, y in pairs] == \
+        [(0.0, 10.0), (1.0, 11.0), (2.0, 12.0), (3.0, 13.0)]
+
+
+def test_break_stops_the_producer():
+    """Leaving the loop early behaves like stop(): the producer exits
+    (and releases its queue slots) without stop() being called."""
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield np.float32(i)
+
+    loader = DeviceLoader(gen(), prefetch_depth=1)
+    for x in loader:
+        break                          # generator close -> finally
+    time.sleep(0.4)
+    n = len(produced)
+    time.sleep(0.3)
+    assert len(produced) == n          # production stopped
+    assert loader._stop.is_set()

@@ -118,14 +118,14 @@ def test_agas_rendezvous_wait():
 def test_multiprocess_smoke_2_localities():
     from hpx_tpu.run import launch
     rc = launch(os.path.join(REPO, "tests", "mp_scripts", "dist_smoke.py"),
-                [], localities=2, timeout=240.0)
+                [], localities=2, timeout=420.0)
     assert rc == 0
 
 
 def test_multiprocess_smoke_4_localities():
     from hpx_tpu.run import launch
     rc = launch(os.path.join(REPO, "tests", "mp_scripts", "dist_smoke.py"),
-                [], localities=4, timeout=240.0)
+                [], localities=4, timeout=420.0)
     assert rc == 0
 
 

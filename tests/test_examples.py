@@ -13,7 +13,7 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-def run_example(name, *args, timeout=240):
+def run_example(name, *args, timeout=420):
     return subprocess.run(
         [sys.executable, os.path.join("examples", name),
          *args, "--cpu-mesh", "8"],
@@ -65,7 +65,7 @@ def test_future_overhead_benchmark():
     r = subprocess.run(
         [sys.executable, os.path.join("benchmarks", "future_overhead.py"),
          "2000"],
-        cwd=REPO, capture_output=True, text=True, timeout=240)
+        cwd=REPO, capture_output=True, text=True, timeout=420)
     assert r.returncode == 0, r.stderr
     import json
     rows = [json.loads(line) for line in r.stdout.splitlines() if line]

@@ -142,7 +142,7 @@ def test_multiprocess_compressed_coalesced():
     try:
         rc = launch(os.path.join(REPO, "tests", "mp_scripts",
                                  "dist_smoke.py"),
-                    [], localities=2, timeout=240.0)
+                    [], localities=2, timeout=420.0)
     finally:
         for k, v in old.items():
             if v is None:

@@ -204,7 +204,7 @@ def test_late_join_attach():
     repo = os.path.join(os.path.dirname(__file__), "..")
     rc = launch(os.path.join(repo, "tests", "mp_scripts",
                              "late_join_smoke.py"),
-                [], localities=2, timeout=240.0)
+                [], localities=2, timeout=420.0)
     assert rc == 0
 
 

@@ -268,5 +268,5 @@ def test_multiprocess_services():
     from hpx_tpu.run import launch
     rc = launch(os.path.join(REPO, "tests", "mp_scripts",
                              "services_smoke.py"),
-                [], localities=2, timeout=240.0)
+                [], localities=2, timeout=420.0)
     assert rc == 0

@@ -115,5 +115,5 @@ def test_multiprocess_unordered_map():
     from hpx_tpu.run import launch
     rc = launch(os.path.join(REPO, "tests", "mp_scripts",
                              "unordered_smoke.py"),
-                [], localities=3, timeout=240.0)
+                [], localities=3, timeout=420.0)
     assert rc == 0
