@@ -133,8 +133,9 @@ class DeviceLoader:
                 yield item
         finally:
             # generator close (break / exception in the consumer loop)
-            # behaves like stop(): the producer exits at its next check
-            self._stop.set()
+            # IS stop(): producer exits at its next check AND queued
+            # device batches are dropped so HBM frees immediately
+            self.stop()
 
     def stop(self) -> None:
         """Abandon the stream; the producer exits at its next check and

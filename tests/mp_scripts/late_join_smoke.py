@@ -64,8 +64,16 @@ def main() -> int:
         with _done_cv:
             HPX_TEST(_done_cv.wait_for(lambda: _done_n[0] >= 2, T),
                      "incumbents never reached the joiner")
-        rt._stopped = True
-        rt._endpoint.close()
+        # reverse handshake: tell each incumbent we are finished so it
+        # can close — without this, an incumbent that finished its own
+        # half early closes its endpoint while our echo to it is still
+        # in flight (observed as "send to peer failed" under load)
+        HPX_TEST_EQ(async_action("lj.done", 0).get(timeout=T), True)
+        HPX_TEST_EQ(async_action("lj.done", 1).get(timeout=T), True)
+        # orderly shutdown: finalize() barriers all three localities
+        # and drains in-flight replies before any endpoint closes, so
+        # no reply frame is stranded by an early close
+        hpx.finalize()
         return report_errors()
 
     me = hpx.find_here()
@@ -80,10 +88,14 @@ def main() -> int:
     HPX_TEST_EQ(async_action("lj.echo", 2, "to-joiner", me
                              ).get(timeout=T), ("to-joiner", me, 2))
     HPX_TEST_EQ(async_action("lj.done", 2).get(timeout=T), True)
+    # wait for the joiner's reverse handshake before closing (it may
+    # still be mid-exchange with us or the other incumbent)
+    with _done_cv:
+        HPX_TEST(_done_cv.wait_for(lambda: _done_n[0] >= 1, T),
+                 "joiner never signaled completion")
+    hpx.finalize()
     if child is not None:
         HPX_TEST_EQ(child.wait(timeout=T), 0)
-    rt._stopped = True
-    rt._endpoint.close()
     return report_errors()
 
 
