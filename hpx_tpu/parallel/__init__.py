@@ -7,3 +7,4 @@ from .halo import (  # noqa: F401
 )
 from .spmd import SpmdBlock, define_spmd_block, device_spmd_block  # noqa: F401
 from .pipeline import Pipeline, PipelineStage  # noqa: F401
+from . import multihost  # noqa: F401

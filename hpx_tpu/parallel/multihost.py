@@ -1,0 +1,137 @@
+"""Multi-host device plane: jax.distributed wiring from the batch env.
+
+Reference analog: SURVEY.md §5.8 — the reference's parcelports
+bootstrap from PMI/mpirun; the TPU-native device plane bootstraps from
+`jax.distributed` (gRPC over DCN), after which `jax.devices()` spans
+every host and one `Mesh` covers the pod. The HOST plane
+(dist/runtime.py parcels/actions) is independent and stays per-process.
+
+This module closes the loop with runtime/batch_environments: the same
+SLURM/PBS/OpenMPI/TPU-pod detection that configures host localities
+also resolves (coordinator, num_processes, process_id) for
+jax.distributed, so a pod job needs no explicit flags:
+
+    from hpx_tpu.parallel import multihost
+    multihost.init()                     # no-op single-host
+    mesh = multihost.global_mesh((None, 8), ("dp", "tp"))
+
+On TPU pods jax.distributed can usually self-configure from the
+metadata server; `init()` passes through whatever is resolved and
+lets jax fill gaps. Single-process (no batch env, one host) is an
+explicit no-op — everything keeps working on local devices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+__all__ = ["resolve", "init", "global_mesh", "is_initialized"]
+
+_DEFAULT_PORT = 8476     # jax.distributed's conventional default
+_initialized = False
+
+
+def resolve(environ=None) -> Optional[Tuple[Optional[str],
+                                            Optional[int],
+                                            Optional[int]]]:
+    """(coordinator_address, num_processes, process_id) from the batch
+    environment, or None when this is a single-process run. Explicit
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID env
+    vars win over scheduler detection."""
+    env = os.environ if environ is None else environ
+    exp_coord = env.get("JAX_COORDINATOR_ADDRESS")
+    exp_nproc = env.get("JAX_NUM_PROCESSES")
+    exp_pid = env.get("JAX_PROCESS_ID")
+
+    from ..runtime.batch_environments import detect
+    be = detect(env if environ is not None else None)
+
+    det = None
+    if be.name == "tpu":
+        # TPU pods: jax.distributed self-configures from the metadata
+        # server, so a detected pod worker resolves even when the env
+        # lacks hostnames/world size — initialize() fills the gaps
+        det = (f"{be.node_list[0]}:{_DEFAULT_PORT}" if be.node_list
+               else None, be.num_localities, be.this_locality)
+    elif (be.found() and be.num_localities not in (None, 1)
+          and be.this_locality is not None):
+        det = (f"{be.node_list[0]}:{_DEFAULT_PORT}" if be.node_list
+               else None, be.num_localities, be.this_locality)
+
+    if exp_coord or exp_nproc or exp_pid:
+        # explicit JAX_* values override field-by-field; scheduler
+        # detection fills what the user left unset (a PBS user pinning
+        # only the coordinator port must not lose rank/world size)
+        d = det or (None, None, None)
+        return (exp_coord or d[0],
+                int(exp_nproc) if exp_nproc else d[1],
+                int(exp_pid) if exp_pid else d[2])
+    return det
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None,
+         environ=None) -> bool:
+    """Initialize jax.distributed when this is (or is forced to be) a
+    multi-process run; returns True if initialization happened.
+    Explicit arguments override resolution; with no resolution and no
+    arguments this is a no-op (single host)."""
+    global _initialized
+    if _initialized:
+        return True
+    if (coordinator_address is None and num_processes is None
+            and process_id is None):
+        r = resolve(environ)
+        if r is None:
+            return False
+        coordinator_address, num_processes, process_id = r
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    except RuntimeError as e:
+        # the user may have initialized jax.distributed directly —
+        # that's the state init() exists to reach, not an error
+        if "already" not in str(e).lower():
+            raise
+    _initialized = True
+    return True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def global_mesh(shape: Optional[Sequence[Optional[int]]] = None,
+                axes: Sequence[str] = ("dp",),
+                devices: Optional[Sequence[Any]] = None):
+    """Mesh over ALL devices jax sees (every host's, once init() ran).
+    `shape` may contain one None to infer that axis (numpy -1 style);
+    shape=None puts everything on the first axis. Construction goes
+    through parallel.mesh.make_mesh so all-device meshes share its
+    cache (jit caches keyed on meshes hit across callers)."""
+    import numpy as np
+    import jax
+
+    from .mesh import make_mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if shape is None:
+        shape = [n] + [1] * (len(axes) - 1)
+    shape = [(-1 if s is None else s) for s in shape]
+    if shape.count(-1) > 1:
+        raise ValueError("at most one axis may be inferred (None)")
+    known = int(np.prod([s for s in shape if s != -1])) or 1
+    if -1 in shape:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        shape[shape.index(-1)] = n // known
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {tuple(shape)} != {n} devices")
+    return make_mesh(tuple(shape), tuple(axes),
+                     devices if devices is not None else None)

@@ -104,6 +104,7 @@ class DeviceLoader:
         self._q: queue.Queue = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._started = False
+        self._thread: Optional[threading.Thread] = None
 
     # -- consumer ----------------------------------------------------------
     def __iter__(self) -> Iterator[Any]:
@@ -112,11 +113,12 @@ class DeviceLoader:
                 "DeviceLoader is single-pass (its source was already "
                 "consumed); construct a new loader per epoch")
         self._started = True
-        threading.Thread(
+        self._thread = threading.Thread(
             target=_produce,
             args=(self._q, self._stop, self._source, self._transform,
                   self._sharding),
-            daemon=True, name="hpx-data-loader").start()
+            daemon=True, name="hpx-data-loader")
+        self._thread.start()
         try:
             while True:
                 try:
@@ -141,7 +143,15 @@ class DeviceLoader:
         """Abandon the stream; the producer exits at its next check and
         a consumer blocked on the queue wakes and returns."""
         self._stop.set()
-        # unblock a producer stuck on a full queue
+        # unblock a producer stuck on a full queue, then drain AFTER
+        # it exits — draining first races a put already past the stop
+        # check, which would re-pin one device batch post-drain. The
+        # join times out only if the producer is blocked inside the
+        # source's own __next__, and the between-items stop check
+        # guarantees no further put can follow in that case.
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
         try:
             while True:
                 self._q.get_nowait()

@@ -39,17 +39,15 @@ def done():
 
 
 def wait_members(n, timeout=T):
-    from hpx_tpu.dist.runtime import get_runtime
     deadline = time.monotonic() + timeout
     while hpx.get_num_localities() < n:
         HPX_TEST(time.monotonic() < deadline,
                  f"membership never reached {n}")
         time.sleep(0.05)
-    return get_runtime()
 
 
 def main() -> int:
-    rt = hpx.init()
+    hpx.init()
     if os.environ.get("HPX_TPU_CONNECT") == "1":
         # ---- the late joiner --------------------------------------------
         me = hpx.find_here()
@@ -83,7 +81,7 @@ def main() -> int:
         env["HPX_TPU_CONNECT"] = "1"
         env.pop("HPX_TPU_LOCALITY", None)
         child = subprocess.Popen([sys.executable, __file__], env=env)
-    rt = wait_members(3)
+    wait_members(3)
     # incumbents -> joiner (route forms from the joiner's IDENT dial)
     HPX_TEST_EQ(async_action("lj.echo", 2, "to-joiner", me
                              ).get(timeout=T), ("to-joiner", me, 2))
