@@ -1,0 +1,104 @@
+"""bench.py resilience: a dead device tunnel must never leave a round
+evidence-free again (the round-4 lesson — BENCH_r04.json was empty).
+
+These tests drive the fallback machinery without any accelerator: the
+probe/fallback paths never import jax in the parent process by design
+(SURVEY.md §6: the baseline must be *measured*; when it can't be, the
+most recent builder-session record is re-emitted, clearly labeled).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _load_bench(tmp_path, lines):
+    spec = importlib.util.spec_from_file_location("benchmod", BENCH)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    m._FALLBACK_SEED = str(tmp_path / "bench_fallback.json")
+    m._FALLBACK_LOCAL = str(tmp_path / "bench_fallback.local.json")
+    if lines is not None:
+        with open(m._FALLBACK_SEED, "w") as f:
+            json.dump({"measured_at": "2026-01-01T00:00:00+00:00",
+                       "lines": lines}, f)
+    return m
+
+
+def test_emit_fallback_labels_provenance(tmp_path, capsys):
+    m = _load_bench(tmp_path, [
+        {"metric": "stream_triad_gbs", "value": 700.0, "unit": "GB/s",
+         "vs_baseline": 0.85},
+        {"metric": "1d_stencil_cell_updates", "value": 98000.0,
+         "unit": "Mcells/s", "vs_baseline": 0.75},
+    ])
+    assert m._emit_fallback()
+    out = [json.loads(ln) for ln in
+           capsys.readouterr().out.strip().splitlines()]
+    assert len(out) == 2
+    for line in out:
+        assert line["provenance"] == "builder-session"
+        assert line["measured_at"] == "2026-01-01T00:00:00+00:00"
+    # emission order preserved: the headline metric stays LAST so the
+    # driver's last-line parser picks it up
+    assert out[-1]["metric"] == "1d_stencil_cell_updates"
+
+
+def test_emit_fallback_without_record(tmp_path):
+    m = _load_bench(tmp_path, None)
+    assert not m._emit_fallback()
+
+
+def test_save_fallback_roundtrip(tmp_path, capsys):
+    m = _load_bench(tmp_path, None)
+    m.emit("x_metric", 1.234, "u", 0.5, spread=0.01)
+    m._save_fallback()
+    capsys.readouterr()
+    m._EMITTED.clear()
+    assert m._emit_fallback()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["metric"] == "x_metric" and line["value"] == 1.234
+    assert line["provenance"] == "builder-session"
+
+
+def test_probe_budget_env_bounds_retries(tmp_path, monkeypatch):
+    m = _load_bench(tmp_path, None)
+    monkeypatch.setenv("HPX_BENCH_PROBE_BUDGET", "1")
+    calls = []
+
+    def fake_once(timeout_s):
+        calls.append(timeout_s)
+        return False
+    m._probe_device_once = fake_once
+    import time as _t
+    t0 = _t.monotonic()
+    assert not m._probe_device()
+    assert _t.monotonic() - t0 < 30      # budget respected, no 20-min wait
+    assert calls                          # at least one bounded attempt
+
+
+@pytest.mark.slow
+def test_cli_dead_tunnel_emits_labeled_fallback(tmp_path):
+    """End-to-end: bench.py with an unreachable device must exit 0 and
+    print bench_unavailable followed by labeled builder-session lines."""
+    env = dict(os.environ)
+    # a zero probe budget fails the probe DETERMINISTICALLY without
+    # touching the device tunnel at all (the sandbox sitecustomize
+    # overrides JAX_PLATFORMS in fresh interpreters, so pointing jax at
+    # a bogus platform would not reliably fail)
+    env["HPX_BENCH_PROBE_BUDGET"] = "0"
+    proc = subprocess.run([sys.executable, BENCH], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=300)
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    assert lines[0]["metric"] == "bench_unavailable"
+    rest = lines[1:]
+    assert rest, proc.stdout
+    assert all(ln.get("provenance") == "builder-session" for ln in rest)
+    assert proc.returncode == 0
