@@ -9,6 +9,7 @@ jnp.sort/argsort — the compiler's sort IS the parallel sort.
 
 from __future__ import annotations
 
+import functools
 import operator
 from typing import Any, Callable, Optional
 
@@ -45,16 +46,12 @@ def _sharded_axis(a) -> Optional[tuple]:
         return None
 
 
-def sort_sharded(v: Any, mesh, axis: str = "x") -> Any:
-    """Globally sort a 1-D array sharded over `axis` WITHOUT gathering:
-    odd-even transposition on blocks. Each device sorts its chunk, then
-    p rounds of pairwise ppermute exchange + merge-split (lower-index
-    partner keeps the low half) — the classic result that p
-    merge-split phases over p locally sorted blocks sort globally.
-    Static shapes, compiled exchanges over ICI; O(p) rounds vs the
-    all-gather XLA falls back to for sharded jnp.sort at scale. NOT
-    stable (merge-split loses equal-key origin order) — stable_sort
-    keeps the XLA path."""
+def _build_odd_even(mesh, axis: str):
+    """Odd-even transposition on blocks: p rounds of pairwise ppermute
+    exchange + merge-split (lower-index partner keeps the low half) —
+    the classic result that p merge-split phases over p locally sorted
+    blocks sort globally. O(p) collective rounds: right shape at small
+    p (cheap rounds, no capacity padding), wrong shape at pod scale."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -62,39 +59,220 @@ def sort_sharded(v: Any, mesh, axis: str = "x") -> Any:
 
     p = mesh.shape[axis]
 
-    def build():
-        def body(chunk):
-            local = jnp.sort(chunk)
-            idx = jax.lax.axis_index(axis)
-            for r in range(p):
-                # round parity picks the pairing: (0,1)(2,3)… then
-                # (1,2)(3,4)…; partner = idx±1 by idx parity
-                if r % 2 == 0:
-                    pairs = [(i, i + 1) for i in range(0, p - 1, 2)]
-                else:
-                    pairs = [(i, i + 1) for i in range(1, p - 1, 2)]
-                perm = [(a, b) for a, b in pairs] + \
-                       [(b, a) for a, b in pairs]
-                paired = jnp.zeros((), jnp.bool_)
-                lower = jnp.zeros((), jnp.bool_)
-                for a, b in pairs:
-                    paired = paired | (idx == a) | (idx == b)
-                    lower = lower | (idx == a)
-                recv = jax.lax.ppermute(local, axis, perm)
-                both = jnp.sort(jnp.concatenate([local, recv]))
-                m = local.shape[0]
-                keep = jnp.where(lower, both[:m], both[m:])
-                local = jnp.where(paired, keep, local)
-            return local
+    def body(chunk):
+        local = jnp.sort(chunk)
+        idx = jax.lax.axis_index(axis)
+        for r in range(p):
+            # round parity picks the pairing: (0,1)(2,3)… then
+            # (1,2)(3,4)…; partner = idx±1 by idx parity
+            if r % 2 == 0:
+                pairs = [(i, i + 1) for i in range(0, p - 1, 2)]
+            else:
+                pairs = [(i, i + 1) for i in range(1, p - 1, 2)]
+            perm = [(a, b) for a, b in pairs] + \
+                   [(b, a) for a, b in pairs]
+            paired = jnp.zeros((), jnp.bool_)
+            lower = jnp.zeros((), jnp.bool_)
+            for a, b in pairs:
+                paired = paired | (idx == a) | (idx == b)
+                lower = lower | (idx == a)
+            recv = jax.lax.ppermute(local, axis, perm)
+            both = jnp.sort(jnp.concatenate([local, recv]))
+            m = local.shape[0]
+            keep = jnp.where(lower, both[:m], both[m:])
+            local = jnp.where(paired, keep, local)
+        return local
 
-        return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axis),),
-                                 out_specs=P(axis)))
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(axis)))
 
-    # one jit object per (mesh, axis): jit's own cache handles shapes
-    key_ = ("oet", mesh, axis)
+
+def _sort_key_fns(dt):
+    """(to_key, from_key, key_dtype): a TOTAL-ORDER integer key per
+    value dtype, so the sample sort's comparisons/padding never meet
+    IEEE partial order. Floats use the classic sign-flip bitcast
+    (negatives bit-inverted, positives sign-bit-set → unsigned order
+    == numeric order), with every NaN forced to the key-space max so
+    NaNs sort last exactly like jnp.sort/np.sort (payloads collapse to
+    one canonical NaN on the way back). Ints/bools are their own key."""
+    import jax
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(dt, jnp.integer):
+        return (lambda v: v), (lambda k: k), dt
+    if dt == jnp.bool_:
+        return (lambda v: v.astype(jnp.uint8)), \
+               (lambda k: k.astype(jnp.bool_)), jnp.dtype(jnp.uint8)
+    if not jnp.issubdtype(dt, jnp.floating):
+        raise TypeError(f"sort_sharded: unsupported dtype {dt}")
+    nbits = jnp.dtype(dt).itemsize * 8
+    ui = jnp.dtype(f"uint{nbits}")
+    sign = ui.type(1 << (nbits - 1))
+    allbits = ui.type((1 << nbits) - 1)
+
+    def to_key(v):
+        u = jax.lax.bitcast_convert_type(v, ui)
+        k = jnp.where((u & sign) != 0, ~u, u | sign)
+        return jnp.where(jnp.isnan(v), allbits, k)
+
+    def from_key(k):
+        u = jnp.where((k & sign) != 0, k ^ sign, ~k)
+        return jax.lax.bitcast_convert_type(u.astype(ui), dt)
+
+    return to_key, from_key, ui
+
+
+def _build_sample_sort(mesh, axis: str):
+    """One-shot sample sort (PSRS — parallel sorting by regular
+    sampling): local sort → rank-stripe all_to_all → regular-sample
+    splitters via all_gather → ONE bucket all_to_all → local merge →
+    exact-rank rebalance all_to_all. O(1) collective steps regardless
+    of p (vs odd-even's p rounds) — the pod-scale shape.
+
+    Correctness under duplicates and static shapes, the two things XLA
+    makes hard:
+
+    * Every element carries a lexicographic key (value, global_id), so
+      keys are DISTINCT and the PSRS bucket bound B_j < 2M (M = padded
+      chunk length) is a theorem, not a hope — all-equal inputs
+      bucket by id and stay balanced.
+    * The rank-stripe pre-exchange (element of local sorted rank r
+      moves to device r mod p) makes each device's chunk a union of
+      p regular subsamples of sorted chunks. A bucket is a contiguous
+      key interval, and a stride-p subsample of a contiguous run of
+      length L contains at most L/p + 1 elements, so the per-pair
+      send in the bucket exchange is <= B_j/p + p < 2M/p + p — a
+      STATIC capacity, so the all_to_all buffer is (p, 2M/p + p + 2)
+      instead of the worst-case (p, M) a one-shot exchange would
+      otherwise need.
+    * Buckets land whole on their device with sizes b_j != m, so a
+      final exchange places every element at its exact global rank g
+      (device g//m, slot g%m; ranks from an all_gather of bucket
+      sizes): output is exactly m per device, same sharding in as out.
+
+    Values travel as total-order integer keys (_sort_key_fns: floats
+    sign-flip-bitcast so unsigned order == numeric order with NaN
+    forced last like np.sort; ints/bools are their own key), which
+    also makes padding trivial: (key-space max, id >= n) sorts after
+    every real key, takes ranks >= n, and is dropped by the final
+    scatter's mode='drop'. NOT stable (equal values reorder by global
+    id, which for distributed duplicates is original-position order —
+    but the public contract stays "unstable"; stable_sort keeps the
+    XLA path). NaN payloads collapse to one canonical NaN.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    p = mesh.shape[axis]
+
+    def body(chunk):
+        m = chunk.shape[0]
+        n = m * p
+        to_key, from_key, kdt = _sort_key_fns(chunk.dtype)
+        kmax = jnp.iinfo(kdt).max
+        i = jax.lax.axis_index(axis)
+
+        mp_ = -(-m // p)               # ceil(m/p)
+        M = mp_ * p
+        pad = M - m
+        gid = i * m + jnp.arange(m, dtype=jnp.int32)
+        v = to_key(chunk)              # total-order integer keys
+        if pad:
+            v = jnp.concatenate([v, jnp.full((pad,), kmax, kdt)])
+            gid = jnp.concatenate(
+                [gid, n + i * pad + jnp.arange(pad, dtype=jnp.int32)])
+
+        def lexsorted(vv, gg):
+            order = jnp.lexsort((gg, vv))
+            return vv[order], gg[order]
+
+        # ---- phase A: local sort + rank stripe (balances bucket
+        # composition across sources; per-pair volume exactly M/p)
+        v, gid = lexsorted(v, gid)
+        a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
+                                split_axis=0, concat_axis=0, tiled=True)
+        v = a2a(v.reshape(mp_, p).T.reshape(p, mp_)).reshape(M)
+        gid = a2a(gid.reshape(mp_, p).T.reshape(p, mp_)).reshape(M)
+        v, gid = lexsorted(v, gid)
+
+        # ---- phase B: p regular samples/device -> p^2 gathered ->
+        # splitters at every p-th (p-1 of them)
+        sv = jax.lax.all_gather(v[0::mp_][:p], axis).reshape(-1)
+        sg = jax.lax.all_gather(gid[0::mp_][:p], axis).reshape(-1)
+        sv, sg = lexsorted(sv, sg)
+        sv, sg = sv[p::p][:p - 1], sg[p::p][:p - 1]
+
+        # ---- phase C: bucket by splitter count (lexicographic), ONE
+        # capacity-bounded all_to_all
+        less = (sv[None, :] < v[:, None]) | (
+            (sv[None, :] == v[:, None]) & (sg[None, :] <= gid[:, None]))
+        dest = less.sum(axis=1).astype(jnp.int32)          # (M,) in [0,p)
+        counts = jnp.bincount(dest, length=p).astype(jnp.int32)
+        cum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(counts)[:-1]])
+        off = jnp.arange(M, dtype=jnp.int32) - cum[dest]   # dest is sorted
+        cap = 2 * mp_ + p + 2                              # PSRS bound + slack
+        bv = jnp.zeros((p, cap), kdt).at[dest, off].set(v, mode="drop")
+        bg = jnp.full((p, cap), jnp.iinfo(jnp.int32).max,
+                      jnp.int32).at[dest, off].set(gid, mode="drop")
+        rv = a2a(bv).reshape(-1)
+        rg = a2a(bg).reshape(-1)
+        rc = a2a(counts.reshape(p, 1)).reshape(p)          # per-src counts
+
+        # ---- local merge of my bucket (invalid slots sort last)
+        invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                   >= rc[:, None]).reshape(-1)
+        order = jnp.lexsort((rg, rv, invalid))
+        rv, rg, invalid = rv[order], rg[order], invalid[order]
+        b_mine = rc.sum()
+
+        # ---- phase D: exact global rank -> (device, slot) scatter.
+        # bucket sizes all_gather'd; padding keys rank >= n and invalid
+        # slots get dest p — both dropped by mode='drop'.
+        sizes = jax.lax.all_gather(b_mine, axis)           # (p,)
+        base = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(sizes)[:-1]])[i]
+        pos = jnp.arange(p * cap, dtype=jnp.int32)
+        grank = base + pos
+        d2 = jnp.where((pos < b_mine) & (grank < n), grank // m, p)
+        o2 = grank % m
+        out = jnp.zeros((p, m), kdt).at[d2, o2].set(rv, mode="drop")
+        # exactly one source owns each global rank, empty slots are 0
+        return from_key(a2a(out).sum(axis=0))
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                             out_specs=P(axis)))
+
+
+def sort_sharded(v: Any, mesh, axis: str = "x",
+                 method: Optional[str] = None) -> Any:
+    """Globally sort a 1-D array sharded over `axis` WITHOUT gathering.
+
+    Two compiled strategies (reference analog: the segmented sort over
+    partitioned data, SURVEY.md §2.4 segmented_algorithms):
+
+    * ``sample``  — one-shot PSRS sample sort: O(1) all_to_all steps
+      independent of mesh size (see _build_sample_sort). Default for
+      p > 4: at pod scale, collective-step count is what matters.
+    * ``odd_even`` — p rounds of neighbor merge-split. Default for
+      p <= 4 where its simplicity and lack of capacity padding win.
+
+    Both are fully compiled (static shapes, XLA collectives over ICI)
+    and NOT stable; stable_sort keeps the XLA gather path."""
+    p = mesh.shape[axis]
+    if method is None:
+        method = "odd_even" if p <= 4 else "sample"
+    elif method not in ("sample", "odd_even"):
+        raise ValueError(f"sort_sharded: unknown method {method!r} "
+                         "(expected 'sample' or 'odd_even')")
+    key_ = (method, mesh, axis)
     prog = _SHARDED_SORT_PROGRAMS.get(key_)
     if prog is None:
-        prog = _SHARDED_SORT_PROGRAMS[key_] = build()
+        build = (_build_sample_sort if method == "sample"
+                 else _build_odd_even)
+        prog = _SHARDED_SORT_PROGRAMS[key_] = build(mesh, axis)
     return prog(v)
 
 

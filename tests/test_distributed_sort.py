@@ -1,5 +1,6 @@
-"""Distributed sort (algo/sorting.sort_sharded): odd-even transposition
-on blocks over ppermute — the segmented sort."""
+"""Distributed sort (algo/sorting.sort_sharded): one-shot PSRS sample
+sort (default p>4) and odd-even transposition fallback (p<=4) — the
+segmented sort over ppermute/all_to_all."""
 
 import numpy as np
 import pytest
@@ -7,7 +8,12 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from hpx_tpu.algo.sorting import sort_sharded, _sharded_axis
+from hpx_tpu.algo.sorting import (
+    _build_odd_even,
+    _build_sample_sort,
+    _sharded_axis,
+    sort_sharded,
+)
 
 
 def _mesh(devices, n):
@@ -20,23 +26,129 @@ def _put(x, mesh):
     return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("x")))
 
 
+@pytest.mark.parametrize("method", ["sample", "odd_even"])
 @pytest.mark.parametrize("p,n", [(8, 1024), (5, 200), (2, 64), (1, 32)])
-def test_sort_sharded_matches_numpy(devices, p, n):
+def test_sort_sharded_matches_numpy(devices, p, n, method):
     if p == 1:
         pytest.skip("mesh.size <= 1 routes to plain jnp.sort")
     rng = np.random.default_rng(p)
     v = rng.standard_normal(n).astype(np.float32)
     mesh = _mesh(devices, p)
-    got = sort_sharded(_put(v, mesh), mesh)
+    got = sort_sharded(_put(v, mesh), mesh, method=method)
     np.testing.assert_array_equal(np.asarray(got), np.sort(v))
 
 
-def test_sort_sharded_int_and_duplicates(devices):
+@pytest.mark.parametrize("method", ["sample", "odd_even"])
+def test_sort_sharded_int_and_duplicates(devices, method):
     mesh = _mesh(devices, 8)
     rng = np.random.default_rng(0)
     v = rng.integers(0, 16, size=512).astype(np.int32)
-    got = sort_sharded(_put(v, mesh), mesh)
+    got = sort_sharded(_put(v, mesh), mesh, method=method)
     np.testing.assert_array_equal(np.asarray(got), np.sort(v))
+
+
+@pytest.mark.parametrize("case", ["all_equal", "presorted", "reversed",
+                                  "two_values", "max_vals"])
+def test_sample_sort_adversarial(devices, case):
+    """Inputs that stress the PSRS capacity bound: duplicate-heavy and
+    pre-structured data must not overflow the static bucket capacity
+    (they bucket by global id thanks to the lexicographic tiebreak)."""
+    mesh = _mesh(devices, 8)
+    n = 512
+    if case == "all_equal":
+        v = np.full(n, 3.5, np.float32)
+    elif case == "presorted":
+        v = np.arange(n, dtype=np.float32)
+    elif case == "reversed":
+        v = np.arange(n, dtype=np.float32)[::-1].copy()
+    elif case == "two_values":
+        v = np.where(np.arange(n) % 7 == 0, 1.0, -1.0).astype(np.float32)
+    else:                                 # max_vals: collide with padding
+        v = np.full(n, np.finfo(np.float32).max, np.float32)
+        v[: n // 2] = -1.0
+    got = sort_sharded(_put(v, mesh), mesh, method="sample")
+    np.testing.assert_array_equal(np.asarray(got), np.sort(v))
+
+
+def test_sample_sort_nan(devices):
+    """NaNs must sort last like np.sort/jnp.sort — the IEEE partial
+    order must not corrupt bucketing (total-order key regression)."""
+    mesh = _mesh(devices, 8)
+    rng = np.random.default_rng(1)
+    v = rng.standard_normal(256).astype(np.float32)
+    v[::17] = np.nan
+    v[5] = -np.nan
+    got = np.asarray(sort_sharded(_put(v, mesh), mesh, method="sample"))
+    want = np.sort(v)                      # NaNs last
+    assert np.array_equal(got, want, equal_nan=True), (got, want)
+
+
+def test_sample_sort_negzero_inf(devices):
+    mesh = _mesh(devices, 8)
+    v = np.array([0.0, -0.0, np.inf, -np.inf] * 16, np.float32)
+    got = np.asarray(sort_sharded(_put(v, mesh), mesh, method="sample"))
+    np.testing.assert_array_equal(got, np.sort(v))
+
+
+def test_sample_sort_bool_and_bf16(devices):
+    mesh = _mesh(devices, 8)
+    b = (np.arange(64) % 3 == 0)
+    got = np.asarray(sort_sharded(_put(b, mesh), mesh, method="sample"))
+    np.testing.assert_array_equal(got, np.sort(b))
+    h = jnp.asarray(np.random.default_rng(2).standard_normal(128),
+                    jnp.bfloat16)
+    goth = np.asarray(sort_sharded(_put(np.asarray(h), mesh), mesh,
+                                   method="sample").astype(jnp.float32))
+    np.testing.assert_array_equal(
+        goth, np.sort(np.asarray(h.astype(jnp.float32))))
+
+
+def test_sort_sharded_rejects_unknown_method(devices):
+    mesh = _mesh(devices, 8)
+    v = _put(np.zeros(64, np.float32), mesh)
+    with pytest.raises(ValueError, match="unknown method"):
+        sort_sharded(v, mesh, method="samples")
+
+
+@pytest.mark.parametrize("p,n", [(8, 72), (8, 24), (5, 35), (6, 42)])
+def test_sample_sort_ragged_chunks(devices, p, n):
+    """m = n/p not divisible by p: the padded-key path (dtype max,
+    id >= n keys rank past n and get dropped by the final scatter)."""
+    rng = np.random.default_rng(n)
+    v = rng.standard_normal(n).astype(np.float32)
+    mesh = _mesh(devices, p)
+    got = sort_sharded(_put(v, mesh), mesh, method="sample")
+    np.testing.assert_array_equal(np.asarray(got), np.sort(v))
+
+
+def test_sample_sort_hlo_o1_exchanges(devices):
+    """The whole point vs odd-even: the compiled collective count must
+    not grow with mesh size. Compile at p=4 and p=8 and assert the
+    all-to-all count is equal (and small); odd-even at p=8 by contrast
+    carries >= p collective-permutes."""
+    def count(hlo, op):
+        # StableHLO op lines look like `%N = "stablehlo.all_to_all"(...`
+        # or `%N = stablehlo.all_to_all(...`; count op applications,
+        # not type/attribute mentions
+        return sum(1 for ln in hlo.splitlines()
+                   if op in ln and "=" in ln and "stablehlo" in ln)
+
+    hlos = {}
+    for p in (4, 8):
+        mesh = _mesh(devices, p)
+        v = _put(np.zeros(64, np.float32), mesh)
+        prog = _build_sample_sort(mesh, "x")
+        hlos[p] = prog.lower(v).as_text()
+    a2a4 = count(hlos[4], "all_to_all")
+    a2a8 = count(hlos[8], "all_to_all")
+    assert a2a4 == a2a8, (a2a4, a2a8)
+    assert 1 <= a2a8 <= 8, a2a8
+    # the only all_gathers are the tiny sample/bucket-size ones
+    assert count(hlos[8], "all_gather") <= 4
+    mesh8 = _mesh(devices, 8)
+    oe = _build_odd_even(mesh8, "x").lower(
+        _put(np.zeros(64, np.float32), mesh8)).as_text()
+    assert count(oe, "collective_permute") >= 8
 
 
 def test_sharded_axis_detection(devices):
