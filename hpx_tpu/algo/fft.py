@@ -85,10 +85,13 @@ def fft1d_body(a, axis: str, n_shards: int, n: int,
     k1 = jnp.arange(n1)[:, None]
     n2g = idx * n2_loc + jnp.arange(n2_loc)[None, :]
     sign = 2.0 if inverse else -2.0
-    # k1*n2 < N1*N2 = N: exact in f32 up to N ~ 16M; f64 when x64 is on
+    # k1*n2g < N1*N2 = N: cast BEFORE the product — an int32 multiply
+    # silently wraps for N >= 2^31 and would corrupt the spectrum, while
+    # the float product merely loses ulps (f32 exact to N ~ 16M; f64
+    # when x64 is on)
     ftype = jnp.float64 if b.dtype == jnp.complex128 else jnp.float32
-    tw = jnp.exp((sign * jnp.pi / n) * 1j * (k1 * n2g).astype(ftype)
-                 ).astype(b.dtype)
+    tw = jnp.exp((sign * jnp.pi / n) * 1j
+                 * (k1.astype(ftype) * n2g.astype(ftype))).astype(b.dtype)
     c = b * tw
     d = f(_a2a(c, axis, split=0, concat=1), axis=1)   # [N1/P, N2]
     # ifft normalizes each local transform by its length; the composed

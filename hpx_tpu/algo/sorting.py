@@ -177,12 +177,23 @@ def _build_sample_sort(mesh, axis: str):
         mp_ = -(-m // p)               # ceil(m/p)
         M = mp_ * p
         pad = M - m
-        gid = i * m + jnp.arange(m, dtype=jnp.int32)
+        # ids/ranks span [0, n + p*pad): int32 until ~2^31 elements,
+        # int64 beyond (needs x64; wrapped ids would break the
+        # distinct-(key,gid) property the capacity bound rests on)
+        if n + p * pad < 2 ** 31:
+            idt = jnp.int32
+        elif jnp.dtype(jnp.int64).itemsize == 8:     # x64 enabled
+            idt = jnp.int64
+        else:
+            raise ValueError(
+                f"sort_sharded(sample): n={n} needs 64-bit ids; "
+                "enable jax x64 or use method='odd_even'")
+        gid = i * m + jnp.arange(m, dtype=idt)
         v = to_key(chunk)              # total-order integer keys
         if pad:
             v = jnp.concatenate([v, jnp.full((pad,), kmax, kdt)])
             gid = jnp.concatenate(
-                [gid, n + i * pad + jnp.arange(pad, dtype=jnp.int32)])
+                [gid, n + i * pad + jnp.arange(pad, dtype=idt)])
 
         def lexsorted(vv, gg):
             order = jnp.lexsort((gg, vv))
@@ -215,8 +226,8 @@ def _build_sample_sort(mesh, axis: str):
         off = jnp.arange(M, dtype=jnp.int32) - cum[dest]   # dest is sorted
         cap = 2 * mp_ + p + 2                              # PSRS bound + slack
         bv = jnp.zeros((p, cap), kdt).at[dest, off].set(v, mode="drop")
-        bg = jnp.full((p, cap), jnp.iinfo(jnp.int32).max,
-                      jnp.int32).at[dest, off].set(gid, mode="drop")
+        bg = jnp.full((p, cap), jnp.iinfo(idt).max,
+                      idt).at[dest, off].set(gid, mode="drop")
         rv = a2a(bv).reshape(-1)
         rg = a2a(bg).reshape(-1)
         rc = a2a(counts.reshape(p, 1)).reshape(p)          # per-src counts
@@ -231,10 +242,10 @@ def _build_sample_sort(mesh, axis: str):
         # ---- phase D: exact global rank -> (device, slot) scatter.
         # bucket sizes all_gather'd; padding keys rank >= n and invalid
         # slots get dest p — both dropped by mode='drop'.
-        sizes = jax.lax.all_gather(b_mine, axis)           # (p,)
-        base = jnp.concatenate([jnp.zeros(1, jnp.int32),
+        sizes = jax.lax.all_gather(b_mine, axis).astype(idt)   # (p,)
+        base = jnp.concatenate([jnp.zeros(1, idt),
                                 jnp.cumsum(sizes)[:-1]])[i]
-        pos = jnp.arange(p * cap, dtype=jnp.int32)
+        pos = jnp.arange(p * cap, dtype=idt)
         grank = base + pos
         d2 = jnp.where((pos < b_mine) & (grank < n), grank // m, p)
         o2 = grank % m

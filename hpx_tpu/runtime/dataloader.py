@@ -43,6 +43,16 @@ __all__ = ["DeviceLoader", "device_loader"]
 _STOP = object()
 
 
+class _Error:
+    """Private in-band error envelope: detected by isinstance, so a
+    user batch that happens to be a 2-tuple (or an array whose __eq__
+    broadcasts) can never be mistaken for a producer failure."""
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+
 def _bounded_put(q: queue.Queue, stop: threading.Event, item: Any) -> bool:
     """Put with backpressure that stays responsive to stop(); returns
     False if the stream was abandoned."""
@@ -74,7 +84,7 @@ def _produce(q: queue.Queue, stop: threading.Event, source: Iterable[Any],
             if not _bounded_put(q, stop, item):
                 return
     except BaseException as e:  # noqa: BLE001 — surfaces at the pop
-        _bounded_put(q, stop, ("__error__", e))
+        _bounded_put(q, stop, _Error(e))
         return
     _bounded_put(q, stop, _STOP)
 
@@ -129,9 +139,8 @@ class DeviceLoader:
                     continue
                 if item is _STOP:
                     return
-                if (isinstance(item, tuple) and len(item) == 2
-                        and item[0] == "__error__"):
-                    raise item[1]
+                if isinstance(item, _Error):
+                    raise item.exc
                 yield item
         finally:
             # generator close (break / exception in the consumer loop)

@@ -186,6 +186,15 @@ def io_pool_names() -> List[str]:
         return sorted(_POOLS)
 
 
+def io_pool_pending(name: str) -> int:
+    """Queue length of a named pool, 0 when absent/shut down. The
+    locked lookup makes this safe to call from perf-counter callbacks
+    racing shutdown_io_pools()."""
+    with _LOCK:
+        pool = _POOLS.get(name)
+    return int(pool.pending()) if pool is not None else 0
+
+
 def shutdown_io_pools() -> None:
     with _LOCK:
         pools = list(_POOLS.values())

@@ -300,13 +300,16 @@ def _register_builtins() -> None:
         "pool#default")
 
     # io_service helper pools (io/timer/parcel + user pools) — queue
-    # length per named pool, like the reference's io_service counters
-    from ..runtime.io_service import _POOLS
-    for pname in list(_POOLS):
+    # length per named pool, like the reference's io_service counters.
+    # Discovery happens at registration/refresh time (pools created
+    # later appear on the next refresh hook run); the callback itself
+    # reads through the locked accessor so it can race
+    # shutdown_io_pools() safely.
+    from ..runtime.io_service import io_pool_names, io_pool_pending
+    for pname in io_pool_names():
         put("io", "queue/length",
             CallbackCounter(
-                lambda p=pname: float(
-                    _POOLS[p].pending() if p in _POOLS else 0)),
+                lambda p=pname: float(io_pool_pending(p))),
             f"pool#{pname}")
 
     # runtime uptime
