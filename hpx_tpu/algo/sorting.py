@@ -182,18 +182,21 @@ def _build_sample_sort(mesh, axis: str):
         # distinct-(key,gid) property the capacity bound rests on)
         if n + p * pad < 2 ** 31:
             idt = jnp.int32
-        elif jnp.dtype(jnp.int64).itemsize == 8:     # x64 enabled
+        elif jax.config.jax_enable_x64:
             idt = jnp.int64
         else:
             raise ValueError(
                 f"sort_sharded(sample): n={n} needs 64-bit ids; "
                 "enable jax x64 or use method='odd_even'")
-        gid = i * m + jnp.arange(m, dtype=idt)
+        # widen the device index BEFORE the product: i*m in int32 wraps
+        # at the very scale the int64 path exists for
+        gid = i.astype(idt) * m + jnp.arange(m, dtype=idt)
         v = to_key(chunk)              # total-order integer keys
         if pad:
             v = jnp.concatenate([v, jnp.full((pad,), kmax, kdt)])
             gid = jnp.concatenate(
-                [gid, n + i * pad + jnp.arange(pad, dtype=idt)])
+                [gid, jnp.asarray(n, idt) + i.astype(idt) * pad
+                 + jnp.arange(pad, dtype=idt)])
 
         def lexsorted(vv, gg):
             order = jnp.lexsort((gg, vv))

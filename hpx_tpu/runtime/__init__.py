@@ -1,3 +1,8 @@
 from .threadpool import WorkStealingPool, default_pool, reset_default_pool  # noqa: F401
-from .io_service import IoServicePool, get_io_service_pool, io_pool_names  # noqa: F401
+from .io_service import (  # noqa: F401
+    IoServicePool,
+    get_io_service_pool,
+    io_pool_names,
+    io_pool_pending,
+)
 from .dataloader import DeviceLoader, device_loader  # noqa: F401

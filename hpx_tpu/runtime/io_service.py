@@ -34,7 +34,8 @@ from typing import Any, Callable, Dict, List, Optional
 from ..futures.future import Future, SharedState
 
 __all__ = ["IoServicePool", "get_io_service_pool", "io_pool_names",
-           "register_external_pool", "shutdown_io_pools"]
+           "io_pool_pending", "register_external_pool",
+           "shutdown_io_pools"]
 
 
 class IoServicePool:

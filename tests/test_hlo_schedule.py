@@ -1,0 +1,184 @@
+"""Collective-schedule assertions on the compiled multi-chip programs.
+
+MULTICHIP_r*.json proves the sharded train steps run and converge; these
+tests pin down WHAT the compiler was given — the collective schedule —
+so a refactor that silently starts all-gathering sharded params, doubles
+the ring hops, or breaks the pipeline schedule fails here instead of
+only showing up as a pod-scale perf cliff (SURVEY.md §5.8: the data
+plane must ride explicit XLA collectives, not accidental reshards).
+
+Two layers of assertion:
+
+* jaxpr walk (platform-independent, structural): counts of the
+  collective primitives our shard_map bodies emit — psum / ppermute /
+  all_to_all / all_gather — and the scan trip counts that encode the
+  ring and pipeline schedules.
+* compiled HLO (CPU backend, 8 virtual devices): no all-gather ops at
+  all in the dense train step (sharded params must never be
+  materialized), and the all-reduce count stays O(#param leaves) — the
+  per-leaf grad psums plus a handful of scalar loss/count reductions.
+  (The TPU backend's AllReduceCombiner then fuses those into one or
+  two fused reduces; the CPU pipeline doesn't run it, so fusion itself
+  is not asserted here.)
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hpx_tpu.models import transformer as tfm
+
+
+def _subjaxprs(v):
+    out = []
+    if hasattr(v, "eqns"):
+        out.append(v)
+    elif hasattr(v, "jaxpr"):
+        out.append(v.jaxpr)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            out.extend(_subjaxprs(x))
+    return out
+
+
+def collective_counts(fn, *args):
+    """(Counter of primitive names, list of scan trip counts), walking
+    nested jaxprs (shard_map / scan / cond bodies)."""
+    counts: Counter = Counter()
+    scans = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            counts[eqn.primitive.name] += 1
+            if eqn.primitive.name == "scan":
+                scans.append(eqn.params.get("length"))
+            for v in eqn.params.values():
+                for sj in _subjaxprs(v):
+                    walk(sj)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return counts, scans
+
+
+def _psums(counts):
+    return sum(v for k, v in counts.items() if k.startswith("psum"))
+
+
+def _all_gathers(counts):
+    return sum(v for k, v in counts.items() if k.startswith("all_gather"))
+
+
+def _dense_setup(mesh, n_layers):
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                head_dim=8, n_layers=n_layers, d_ff=32,
+                                lr=0.05)
+    params = tfm.shard_params(
+        tfm.init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
+    step = tfm.make_train_step(cfg, mesh)
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    toks, tgts = tfm.sample_batch(cfg, batch=2 * dp, seq=8 * sp,
+                                  key=jax.random.PRNGKey(1))
+    toks, tgts = tfm.shard_batch(toks, tgts, mesh)
+    return cfg, params, step, toks, tgts
+
+
+def test_dense_dp_sp_tp_schedule(devices):
+    """dp2 x sp2 x tp2: ring-attention ppermutes scale with layers and
+    nothing ever all-gathers or all-to-alls."""
+    mesh = tfm.make_mesh_3d(8)
+    sp = mesh.shape["sp"]
+    per_layer = {}
+    for n_layers in (2, 4):
+        _, params, step, toks, tgts = _dense_setup(mesh, n_layers)
+        counts, scans = collective_counts(step, params, toks, tgts)
+        assert _all_gathers(counts) == 0, counts
+        assert counts.get("all_to_all", 0) == 0, counts
+        assert _psums(counts) > 0
+        # every ring scan walks exactly the sp chunks
+        ring_scans = [s for s in scans if s == sp]
+        assert ring_scans, scans
+        per_layer[n_layers] = counts.get("ppermute", 0)
+    # ppermute sites come from the per-layer ring attention (fwd+bwd);
+    # doubling layers must exactly double them — anything more means a
+    # second unintended exchange crept in
+    assert per_layer[4] == 2 * per_layer[2], per_layer
+    assert per_layer[2] > 0
+
+
+def test_moe_expert_all_to_all_schedule(devices):
+    """dp/ep MoE: exactly one dispatch + one combine all_to_all per MoE
+    layer per direction (fwd, bwd) — the GShard shape."""
+    mesh = tfm.make_mesh_3d(8)
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    for n_layers in (2, 4):
+        cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                    head_dim=8, n_layers=n_layers,
+                                    d_ff=32, lr=0.05, n_experts=4,
+                                    moe_top_k=2, moe_capacity=4.0)
+        params = tfm.shard_params(
+            tfm.init_params(cfg, jax.random.PRNGKey(2)), cfg, mesh)
+        step = tfm.make_train_step(cfg, mesh)
+        toks, tgts = tfm.sample_batch(cfg, batch=2 * dp, seq=8 * sp,
+                                      key=jax.random.PRNGKey(3))
+        toks, tgts = tfm.shard_batch(toks, tgts, mesh)
+        counts, _ = collective_counts(step, params, toks, tgts)
+        assert counts.get("all_to_all", 0) == 4 * n_layers, (
+            n_layers, counts)
+        assert _all_gathers(counts) == 0, counts
+
+
+@pytest.mark.parametrize("interleave,n_micro", [(1, 4), (2, 4)])
+def test_pipeline_schedule_length(devices, interleave, n_micro):
+    """The pipeline scan trip count IS the schedule: M*V + P - 1 steps
+    (GPipe at V=1, Megatron interleaved at V=2), once forward and once
+    in the AD-reversed backward, with the stage handoff as ppermute
+    sites (one static site per direction, executed per step)."""
+    pp = 4
+    mesh = Mesh(np.array(jax.devices()).reshape(2, pp, 1),
+                ("dp", "pp", "tp"))
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                head_dim=8, n_layers=2 * pp, d_ff=32,
+                                lr=0.05)
+    stacked = tfm.prepare_pipeline_params(
+        tfm.init_params(cfg, jax.random.PRNGKey(4)), mesh,
+        interleave=interleave)
+    step = tfm.make_pipelined_train_step(cfg, mesh,
+                                         n_microbatches=n_micro,
+                                         interleave=interleave)
+    toks, tgts = tfm.sample_batch(cfg, batch=2 * 2 * n_micro, seq=8,
+                                  key=jax.random.PRNGKey(5))
+    sh = NamedSharding(mesh, P("dp", None))
+    toks, tgts = jax.device_put(toks, sh), jax.device_put(tgts, sh)
+    counts, scans = collective_counts(step, stacked, toks, tgts)
+    sched = n_micro * interleave + pp - 1
+    assert scans.count(sched) == 2, (sched, scans)   # fwd + bwd scans
+    assert counts.get("ppermute", 0) == 2, counts    # handoff + transpose
+    assert _all_gathers(counts) == 0, counts
+    assert counts.get("all_to_all", 0) == 0, counts
+
+
+@pytest.mark.slow
+def test_compiled_dp_grads_no_gather_bounded_reduces(devices):
+    """Compiled (SPMD-partitioned) HLO of the dp-only train step: zero
+    all-gather ops — sharded activations/params are never materialized
+    — and the all-reduce count stays O(#param leaves): the per-leaf dp
+    grad psums plus a few scalar loss/count reductions. A structural
+    regression (e.g. a jit boundary resharding params) would show up
+    here as all-gathers or a blow-up in reduce count."""
+    mesh = Mesh(np.array(jax.devices()).reshape(8, 1, 1),
+                ("dp", "sp", "tp"))
+    _, params, step, toks, tgts = _dense_setup(mesh, 2)
+    txt = jax.jit(step).lower(params, toks, tgts).compile().as_text()
+    lines = txt.splitlines()
+    n_ar = sum(1 for ln in lines
+               if "all-reduce(" in ln or "all-reduce-start(" in ln)
+    n_ag = sum(1 for ln in lines
+               if "all-gather(" in ln or "all-gather-start(" in ln)
+    n_leaves = len(jax.tree.leaves(params))
+    assert n_ag == 0, n_ag
+    assert 1 <= n_ar <= n_leaves + 6, (n_ar, n_leaves)
