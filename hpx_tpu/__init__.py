@@ -124,7 +124,8 @@ from .containers import (  # noqa: F401
     PartitionedVector, PartitionedVectorView, Segment, UnorderedMap,
 )
 from .dist.distribution_policies import (  # noqa: F401
-    ContainerLayout, container_layout, default_layout, target_layout,
+    Binpacked, Colocated, ContainerLayout, PlacementPolicy, binpacked,
+    colocated, container_layout, default_layout, target_layout,
 )
 
 # the HPX spelling

@@ -121,17 +121,41 @@ class UnorderedMap:
     """
 
     def __init__(self, localities: Optional[Sequence[int]] = None,
-                 _parts: Optional[List[Client]] = None) -> None:
+                 _parts: Optional[List[Client]] = None,
+                 placement: Optional[Any] = None,
+                 num_partitions: Optional[int] = None) -> None:
         if _parts is not None:
             self._parts = _parts
             return
-        if localities is None:
+        if placement is not None:
+            # binpacked()/colocated(...) choose the partition hosts —
+            # the reference's binpacking_distribution_policy applied to
+            # a partitioned container
+            if localities is not None:
+                raise HpxError(
+                    Error.bad_parameter,
+                    "pass candidate localities to the policy itself "
+                    "(binpacked(localities=...)), not both placement= "
+                    "and localities=")
+            if num_partitions is not None and int(num_partitions) < 1:
+                raise HpxError(Error.bad_parameter,
+                               f"num_partitions={num_partitions} < 1")
+            if num_partitions is None:
+                from ..dist.runtime import get_num_localities
+                n = get_num_localities()
+            else:
+                n = int(num_partitions)
+            locs = placement.resolve(
+                n, _MapPartition.__dict__.get("_component_type_name"))
+        elif localities is None:
             from ..dist.runtime import find_all_localities
-            localities = find_all_localities()
-        if not localities:
+            locs = find_all_localities()
+        else:
+            locs = list(localities)
+        if not locs:
             raise HpxError(Error.bad_parameter, "no localities given")
-        self._parts = [new_(_MapPartition, loc).get(timeout=30.0)
-                       for loc in localities]
+        futs = [new_(_MapPartition, loc) for loc in locs]
+        self._parts = [f.get(timeout=30.0) for f in futs]
 
     # -- routing ------------------------------------------------------------
     def _part(self, key: Any) -> Client:

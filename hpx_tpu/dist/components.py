@@ -425,6 +425,19 @@ def _where(gid: IdType) -> int:
     return _current_locality(gid)
 
 
+@plain_action(name="components.count")
+def _component_count(type_name: Optional[str] = None) -> int:
+    """Live component instances on this locality (optionally one type) —
+    the load feed for binpacked placement (the reference's
+    /runtime/count/component@type counter)."""
+    with _inst_lock:
+        if type_name is None:
+            return len(_instances)
+        return sum(1 for e in _instances.values()
+                   if getattr(type(e.inst), "_component_type_name", None)
+                   == type_name)
+
+
 # ---------------------------------------------------------------------------
 # client_base
 # ---------------------------------------------------------------------------
@@ -513,7 +526,11 @@ class Client:
 
 def new_(cls_or_name: Any, locality: Optional[int] = None,
          *args: Any, **kwargs: Any) -> Future:
-    """hpx::new_<T>(locality, args...) analog → future<Client>."""
+    """hpx::new_<T>(locality, args...) analog → future<Client>.
+
+    `locality` may be an int, None (here), or a PlacementPolicy
+    (`binpacked()` / `colocated(client)` from dist.distribution_policies
+    — the reference's binpacking_/colocating_distribution_policy)."""
     if isinstance(cls_or_name, str):
         type_name = cls_or_name
         _resolve_type(type_name)          # fail fast on unknown types
@@ -526,7 +543,11 @@ def new_(cls_or_name: Any, locality: Optional[int] = None,
             raise HpxError(Error.bad_component_type,
                            f"not a registered component type: {cls_or_name} "
                            "(register_component_type first)")
-    loc = find_here() if locality is None else int(locality)
+    from .distribution_policies import PlacementPolicy
+    if isinstance(locality, PlacementPolicy):
+        loc = locality.resolve(1, type_name)[0]
+    else:
+        loc = find_here() if locality is None else int(locality)
     return async_action(_create, loc, type_name, args, kwargs).then(
         lambda f: Client(f.get()))
 

@@ -1,17 +1,29 @@
-"""Distribution policies: where partitioned data lives on the mesh.
+"""Distribution policies: where partitioned data and components live.
 
 Reference analog: libs/full/distribution_policies — `hpx::container_layout
 (num_partitions, localities)`, `default_layout`, `binpacking_distribution_
-policy`, `target_distribution_policy`. TPU-first reinterpretation: a
-"locality" for data placement is a mesh position; a layout names the mesh
-axis a container is sharded over and how many partitions it has. XLA/GSPMD
-then owns the actual byte placement — the policy only fixes the sharding
-spec, which is the whole game on TPU (SURVEY.md §7 design stance).
+policy`, `colocating_distribution_policy`, `target_distribution_policy`.
+
+TPU-first split into two planes (SURVEY.md §7 design stance):
+
+* DEVICE plane (bulk arrays): a "locality" for data placement is a mesh
+  position; ContainerLayout names the mesh axis a container is sharded
+  over, and XLA/GSPMD owns the actual byte placement — the policy only
+  fixes the sharding spec. Load-based placement makes no sense here
+  (SPMD arrays are uniform by construction), so binpacking does not
+  apply to ContainerLayout.
+* LOCALITY plane (components, control state): PlacementPolicy picks
+  host processes for `new_`-created components and for component-backed
+  containers (UnorderedMap partitions). `binpacked()` places on the
+  least-loaded locality (per-type component count by default, any
+  performance counter optionally — the reference's
+  binpacking_distribution_policy counter semantics); `colocated(c)`
+  places next to an existing component, following migrations.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 
 class ContainerLayout:
@@ -77,3 +89,104 @@ def default_layout(mesh: Any = None) -> ContainerLayout:
 def target_layout(targets: Sequence[Any]) -> ContainerLayout:
     """target_distribution_policy analog: place over explicit targets."""
     return ContainerLayout(targets=targets)
+
+
+# ---------------------------------------------------------------------------
+# component placement policies (locality plane)
+# ---------------------------------------------------------------------------
+
+class PlacementPolicy:
+    """Chooses host localities for components. Accepted wherever new_
+    takes a locality; container constructors that place partition
+    components (UnorderedMap) take one for all partitions at once."""
+
+    def resolve(self, count: int = 1,
+                type_name: Optional[str] = None) -> List[int]:
+        raise NotImplementedError
+
+
+class Binpacked(PlacementPolicy):
+    """binpacking_distribution_policy analog: place on the localities
+    with the smallest load.
+
+    Load is, per candidate locality, either the component count (of the
+    type being created when known — the reference's default
+    `/runtime/count/component@type` semantics — else all types), or any
+    performance counter: pass `counter=(object, name[, instance])` and
+    it is queried remotely on each candidate through the counter
+    registry (all queries issued concurrently).
+
+    Multi-placement (count > 1) water-fills: each pick lands on the
+    current argmin and then weighs 1.0 there. That is exact when the
+    load is in object-count units (the default); with an arbitrary
+    counter the weight of one new component in counter units is
+    unknowable, so picks repeat the argmin until its counter value is
+    overtaken — which IS binpacking, not round-robin: a deeply idle
+    locality should absorb the whole batch.
+    """
+
+    def __init__(self, localities: Optional[Sequence[int]] = None,
+                 counter: Optional[Sequence[str]] = None) -> None:
+        self.localities = (None if localities is None
+                           else [int(x) for x in localities])
+        if counter is not None and not 2 <= len(counter) <= 3:
+            raise ValueError(
+                "counter must be (object, name) or (object, name, "
+                f"instance), got {counter!r}")
+        self.counter = None if counter is None else tuple(counter)
+
+    def _loads(self, locs: Sequence[int],
+               type_name: Optional[str]) -> List[float]:
+        from .actions import async_action
+        from .components import _component_count
+        if self.counter is None:
+            futs = [async_action(_component_count, loc, type_name)
+                    for loc in locs]
+            return [float(f.get()) for f in futs]
+        from ..svc.performance_counters import (counter_name,
+                                                query_counter_async)
+        obj, cname = self.counter[0], self.counter[1]
+        inst = self.counter[2] if len(self.counter) > 2 else "total"
+        futs = [query_counter_async(counter_name(obj, cname, inst, loc))
+                for loc in locs]
+        return [f.get().value for f in futs]
+
+    def resolve(self, count: int = 1,
+                type_name: Optional[str] = None) -> List[int]:
+        from .runtime import get_num_localities
+        locs = (list(range(get_num_localities()))
+                if self.localities is None else list(self.localities))
+        if not locs:
+            raise ValueError("binpacked: no candidate localities")
+        loads = self._loads(locs, type_name)
+        out = []
+        for _ in range(count):
+            k = min(range(len(locs)), key=lambda j: (loads[j], locs[j]))
+            out.append(locs[k])
+            loads[k] += 1.0
+        return out
+
+
+class Colocated(PlacementPolicy):
+    """colocating_distribution_policy analog: place on whatever
+    locality currently hosts `client`'s component (follows
+    migrations — resolution happens at create time)."""
+
+    def __init__(self, client: Any) -> None:
+        self.client = client
+
+    def resolve(self, count: int = 1,
+                type_name: Optional[str] = None) -> List[int]:
+        from .components import _current_locality
+        return [_current_locality(self.client.gid)] * count
+
+
+def binpacked(localities: Optional[Sequence[int]] = None,
+              counter: Optional[Sequence[str]] = None) -> Binpacked:
+    """hpx::binpacked analog (see Binpacked)."""
+    return Binpacked(localities, counter)
+
+
+def colocated(client: Any) -> Colocated:
+    """hpx::colocated analog (see Colocated)."""
+    return Colocated(client)

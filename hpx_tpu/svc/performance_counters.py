@@ -228,6 +228,21 @@ def query_counter(name: str, reset: bool = False,
     return c.get_value(reset)
 
 
+def query_counter_async(name: str, reset: bool = False):
+    """query_counter returning a Future — remote queries dispatch
+    without blocking, so callers can fan out over localities (the
+    binpacked placement policy queries every candidate concurrently)."""
+    from ..futures.future import make_ready_future
+    path = parse_counter_name(name)
+    from ..dist.runtime import find_here
+    if path.locality != "*" and int(path.locality) != find_here():
+        from ..dist.actions import async_action
+        return async_action(_query_action, int(path.locality),
+                            name, reset).then(
+            lambda f: CounterValue(*f.get()))
+    return make_ready_future(query_counter(name, reset))
+
+
 def query_counters(pattern: str = "*", reset: bool = False
                    ) -> Dict[str, CounterValue]:
     # discover_counters already ran the refresh hooks once for this call

@@ -1,0 +1,72 @@
+"""Placement policies (dist/distribution_policies): binpacked /
+colocated — the reference's binpacking_/colocating_distribution_policy
+(SURVEY.md §2.4) on the locality plane."""
+
+import os
+
+import pytest
+
+import hpx_tpu as hpx
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@hpx.register_component_type
+class Gadget(hpx.Component):
+    def __init__(self, tag: str = "") -> None:
+        self.tag = tag
+
+    def where_am_i(self) -> int:
+        return hpx.find_here()
+
+
+class TestSingleLocality:
+    def test_binpacked_resolves_here(self):
+        assert hpx.binpacked().resolve(1) == [0]
+        assert hpx.binpacked().resolve(3) == [0, 0, 0]
+
+    def test_new_with_binpacked(self):
+        c = hpx.new_(Gadget, hpx.binpacked(), "a").get()
+        HPX_TEST_EQ(c.sync("where_am_i"), 0)
+        c.free().get()
+
+    def test_colocated_follows_client(self):
+        a = hpx.new_sync(Gadget, None, "anchor")
+        c = hpx.new_(Gadget, hpx.colocated(a), "next").get()
+        HPX_TEST_EQ(c.sync("where_am_i"), 0)
+        a.free().get()
+        c.free().get()
+
+    def test_counter_based_load(self):
+        pol = hpx.binpacked(counter=("runtime", "uptime"))
+        assert pol.resolve(1) == [0]
+
+    def test_counter_spec_validated(self):
+        with pytest.raises(ValueError):
+            hpx.binpacked(counter=("only-object",))
+
+    def test_empty_candidates_rejected(self):
+        with pytest.raises(ValueError):
+            hpx.binpacked(localities=[]).resolve(1)
+
+    def test_component_count_by_type(self):
+        from hpx_tpu.dist.components import _component_count
+        before = _component_count(
+            Gadget.__dict__["_component_type_name"])
+        cs = [hpx.new_sync(Gadget, None) for _ in range(3)]
+        HPX_TEST_EQ(_component_count(
+            Gadget.__dict__["_component_type_name"]), before + 3)
+        HPX_TEST(_component_count() >= before + 3)
+        for c in cs:
+            c.free().get()
+
+
+@pytest.mark.slow
+def test_multiprocess_binpacking():
+    """Skewed-load rebalancing + colocation across 4 real processes."""
+    from hpx_tpu.run import launch
+    rc = launch(os.path.join(REPO, "tests", "mp_scripts",
+                             "binpacking_smoke.py"),
+                [], localities=4, timeout=420.0)
+    assert rc == 0
