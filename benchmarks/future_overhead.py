@@ -28,13 +28,13 @@ def null_fn() -> None:
     pass
 
 
-def bench(name: str, n: int, fn) -> dict:
+def bench(name: str, n: int, fn, executor: str = "default-pool") -> dict:
     t0 = time.perf_counter()
     fn(n)
     dt = time.perf_counter() - t0
     row = {
         "name": name,
-        "executor": "default-pool",
+        "executor": executor,
         "tasks": n,
         "seconds": round(dt, 6),
         "tasks_per_s": round(n / dt, 1),
@@ -59,9 +59,58 @@ def case_post_latch(n: int) -> None:
     latch.arrive_and_wait()
 
 
+def case_post_many_latch(n: int) -> None:
+    """Batched fan-out: ONE submit_many crossing for all n tasks (the
+    C-ABI amortization path — hpxrt_pool_submit_many)."""
+    latch = hpx.Latch(n + 1)
+
+    def hit() -> None:
+        latch.count_down(1)
+
+    hpx.post_many(hit, [()] * n)
+    latch.arrive_and_wait()
+
+
+def case_async_many_wait_all(n: int) -> None:
+    hpx.wait_all(hpx.async_many(null_fn, [()] * n))
+
+
 def case_sync_floor(n: int) -> None:
     for _ in range(n):
         null_fn()
+
+
+def _native_cases(n: int) -> None:
+    """Same spawn patterns straight on the C++ pool (the scheduler the
+    reference's future_overhead exercises): per-task submits cross the
+    C ABI n times; submit_many crosses ONCE."""
+    try:
+        import os
+        from hpx_tpu.native.loader import NativePool
+        # size to the host: every task re-enters the interpreter, so
+        # extra C++ workers on few cores just fight over the GIL
+        pool = NativePool(max(1, min(4, os.cpu_count() or 1)), "bench")
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"name": "native pool unavailable",
+                          "error": str(e)}))
+        return
+    try:
+        def post_each(k):
+            latch = hpx.Latch(k + 1)
+            for _ in range(k):
+                pool.submit(latch.count_down, 1)
+            latch.arrive_and_wait()
+
+        def post_batch(k):
+            latch = hpx.Latch(k + 1)
+            pool.submit_many([(latch.count_down, (1,), {})] * k)
+            latch.arrive_and_wait()
+
+        post_each(1000)                       # warm
+        bench("post+latch", n, post_each, "native-pool")
+        bench("post_many+latch (batched)", n, post_batch, "native-pool")
+    finally:
+        pool.shutdown()
 
 
 def main() -> int:
@@ -71,6 +120,9 @@ def main() -> int:
 
     bench("async+wait_all", n, case_async_wait_all)
     bench("post+latch", n, case_post_latch)
+    bench("post_many+latch (batched)", n, case_post_many_latch)
+    bench("async_many+wait_all (batched)", n, case_async_many_wait_all)
+    _native_cases(n)
     bench("call floor (no tasks)", n, case_sync_floor)
     return 0
 

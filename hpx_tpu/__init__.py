@@ -47,7 +47,7 @@ __version__ = full_version_as_string()
 # -- futures / async / dataflow (M1) ----------------------------------------
 from .futures import (  # noqa: F401
     Future, Promise, PackagedTask, Launch,
-    async_, post, sync, dataflow, unwrapping,
+    async_, async_many, post, post_many, sync, dataflow, unwrapping,
     make_ready_future, make_exceptional_future, is_future,
     when_all, when_any, when_each, when_some,
     wait_all, wait_any, wait_each, wait_some, split_future,

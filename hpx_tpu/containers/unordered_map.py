@@ -127,6 +127,9 @@ class UnorderedMap:
         if _parts is not None:
             self._parts = _parts
             return
+        if num_partitions is not None and int(num_partitions) < 1:
+            raise HpxError(Error.bad_parameter,
+                           f"num_partitions={num_partitions} < 1")
         if placement is not None:
             # binpacked()/colocated(...) choose the partition hosts —
             # the reference's binpacking_distribution_policy applied to
@@ -137,9 +140,6 @@ class UnorderedMap:
                     "pass candidate localities to the policy itself "
                     "(binpacked(localities=...)), not both placement= "
                     "and localities=")
-            if num_partitions is not None and int(num_partitions) < 1:
-                raise HpxError(Error.bad_parameter,
-                               f"num_partitions={num_partitions} < 1")
             if num_partitions is None:
                 from ..dist.runtime import get_num_localities
                 n = get_num_localities()
@@ -147,11 +147,21 @@ class UnorderedMap:
                 n = int(num_partitions)
             locs = placement.resolve(
                 n, _MapPartition.__dict__.get("_component_type_name"))
-        elif localities is None:
-            from ..dist.runtime import find_all_localities
-            locs = find_all_localities()
         else:
-            locs = list(localities)
+            if localities is None:
+                from ..dist.runtime import find_all_localities
+                localities = find_all_localities()
+            base = list(localities)
+            if not base:
+                raise HpxError(Error.bad_parameter, "no localities given")
+            if num_partitions is None:
+                locs = base
+            else:
+                # partition count independent of locality count (the
+                # reference's container_layout(n, localities)):
+                # round-robin n partitions over the given localities
+                locs = [base[i % len(base)]
+                        for i in range(int(num_partitions))]
         if not locs:
             raise HpxError(Error.bad_parameter, "no localities given")
         futs = [new_(_MapPartition, loc) for loc in locs]

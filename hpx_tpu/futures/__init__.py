@@ -7,7 +7,14 @@ from .future import (  # noqa: F401
     make_exceptional_future,
     make_ready_future,
 )
-from .async_ import Launch, async_, post, sync  # noqa: F401
+from .async_ import (  # noqa: F401
+    Launch,
+    async_,
+    async_many,
+    post,
+    post_many,
+    sync,
+)
 from .combinators import (  # noqa: F401
     WhenAnyResult,
     WhenSomeResult,

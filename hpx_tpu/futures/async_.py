@@ -109,6 +109,31 @@ def post(fn: Callable[..., Any], *args: Any, executor: Any = None,
         default_pool().submit(fn, *args, **kwargs)
 
 
+def post_many(fn: Callable[..., Any], argss, executor: Any = None) -> None:
+    """Fire-and-forget fan-out: schedule fn(*args) for every args in
+    `argss` through ONE batched pool submission (one GIL/C-ABI crossing
+    on the native scheduler — the high-throughput spawn path the
+    reference reaches with its C++ scheduler; see
+    benchmarks/future_overhead.py)."""
+    argss = [tuple(a) for a in argss]     # accept any iterable once
+    if executor is not None:
+        for a in argss:
+            executor.post(fn, *a)
+        return
+    default_pool().submit_many([(fn, a, {}) for a in argss])
+
+
+def async_many(fn: Callable[..., Any], argss) -> list:
+    """hpx::async fan-out: one Future per args tuple, all submitted in
+    one batch (see post_many)."""
+    argss = [tuple(a) for a in argss]     # a generator must not be
+    states = [SharedState() for _ in argss]   # exhausted building states
+    default_pool().submit_many(
+        [(_run_into, (st, fn, a, {}), {})
+         for st, a in zip(states, argss)])
+    return [Future(st) for st in states]
+
+
 def sync(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
     """hpx::sync: run now, return the value (exceptions propagate raw)."""
     result = fn(*args, **kwargs)

@@ -55,6 +55,12 @@ def native_lib() -> Optional[ctypes.CDLL]:
         lib.hpxrt_pool_create.argtypes = [ctypes.c_int]
         lib.hpxrt_pool_submit.argtypes = [ctypes.c_void_p, _TASK_FN,
                                           ctypes.c_size_t]
+        if hasattr(lib, "hpxrt_pool_submit_many"):
+            # probe, not hard bind: a stale prebuilt .so (copied between
+            # checkouts) lacks the symbol; NativePool then falls back to
+            # per-task submits
+            lib.hpxrt_pool_submit_many.argtypes = [
+                ctypes.c_void_p, _TASK_FN, ctypes.c_size_t, ctypes.c_int]
         lib.hpxrt_pool_help_one.restype = ctypes.c_int
         lib.hpxrt_pool_help_one.argtypes = [ctypes.c_void_p]
         lib.hpxrt_pool_in_worker.restype = ctypes.c_int
@@ -151,17 +157,39 @@ class NativePool:
         if self._shut:  # the C++ pool was freed; a call would be UAF
             from ..core.errors import Error, HpxError
             raise HpxError(Error.invalid_status, "pool is shut down")
-        from ..runtime import threadpool as _tp
-        if _tp._task_observer is not None:
-            try:
-                _tp._task_observer("submit", fn, None, args)
-            except BaseException:  # noqa: BLE001
-                pass
+        from ..runtime.threadpool import notify_submit
+        notify_submit([(fn, args)])
         with self._tasks_lock:
             tid = self._next_id
             self._next_id += 1
             self._tasks[tid] = (fn, args, kwargs)
         self._lib.hpxrt_pool_submit(self._handle, self._tramp, tid)
+
+    def submit_many(self, tasks) -> None:
+        """Batch fire-and-forget: `tasks` is a sequence of
+        (fn, args, kwargs) triples, registered under contiguous ids with
+        ONE lock acquisition and handed to the scheduler with ONE C
+        call (hpxrt_pool_submit_many) — the fan-out path that amortizes
+        the per-task interpreter/ABI overhead."""
+        if self._shut:
+            from ..core.errors import Error, HpxError
+            raise HpxError(Error.invalid_status, "pool is shut down")
+        tasks = list(tasks)
+        if not tasks:
+            return
+        if not hasattr(self._lib, "hpxrt_pool_submit_many"):
+            for fn, args, kwargs in tasks:       # stale .so fallback
+                self.submit(fn, *args, **kwargs)
+            return
+        from ..runtime.threadpool import notify_submit
+        notify_submit((fn, args) for fn, args, _ in tasks)
+        with self._tasks_lock:
+            start = self._next_id
+            self._next_id += len(tasks)
+            for i, t in enumerate(tasks):
+                self._tasks[start + i] = t
+        self._lib.hpxrt_pool_submit_many(self._handle, self._tramp,
+                                         start, len(tasks))
 
     def help_one(self) -> bool:
         if self._shut:

@@ -40,6 +40,19 @@ def set_task_observer(obs: Optional[Callable[..., None]]) -> None:
     global _task_observer
     _task_observer = obs
 
+
+def notify_submit(fn_args_pairs) -> None:
+    """Fire the 'submit' observer event per task; observers must never
+    break submission (shared by both pools' submit/submit_many)."""
+    obs = _task_observer
+    if obs is None:
+        return
+    for fn, args in fn_args_pairs:
+        try:
+            obs("submit", fn, None, args)
+        except BaseException:  # noqa: BLE001
+            pass
+
 # Which pool the current OS thread is a worker of (if any). Futures consult
 # this to "work-help" instead of blocking — the analog of an HPX thread
 # suspending so its worker can steal other work (libs/core/thread_pools
@@ -81,11 +94,7 @@ class WorkStealingPool:
         A worker submits to its own queue (children run hot, LIFO — HPX
         thread_queue does the same); external threads round-robin across
         queues."""
-        if _task_observer is not None:
-            try:
-                _task_observer("submit", fn, None, args)
-            except BaseException:  # noqa: BLE001
-                pass
+        notify_submit([(fn, args)])
         wid = getattr(self._tls, "wid", None)
         if wid is None:
             wid = next(self._rr) % len(self._queues)
@@ -98,6 +107,24 @@ class WorkStealingPool:
         if self._idle:
             with self._cv:
                 self._cv.notify()
+
+    def submit_many(self, tasks) -> None:
+        """Batch fire-and-forget: (fn, args, kwargs) triples appended to
+        one queue under one lock with one wake (interface parity with
+        NativePool.submit_many; the native path additionally amortizes
+        the C-ABI crossing)."""
+        tasks = list(tasks)
+        if not tasks:
+            return
+        notify_submit((fn, args) for fn, args, _ in tasks)
+        wid = getattr(self._tls, "wid", None)
+        if wid is None:
+            wid = next(self._rr) % len(self._queues)
+        with self._locks[wid]:
+            self._queues[wid].extend(tasks)
+        if self._idle:
+            with self._cv:
+                self._cv.notify_all()
 
     def in_worker(self) -> bool:
         return getattr(self._tls, "wid", None) is not None

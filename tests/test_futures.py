@@ -175,3 +175,71 @@ def test_raising_user_callback_does_not_poison_producer():
     g = f.then(lambda fut: fut.get() * 2)
     p.set_value(21)                           # must not raise
     assert g.get(timeout=5.0) == 42
+
+
+class TestManyFanout:
+    """post_many/async_many — the batched spawn path (one submit_many
+    pool crossing; on the native pool one C-ABI call)."""
+
+    def test_post_many_runs_all(self):
+        import threading
+        import hpx_tpu as hpx
+        n = 2000
+        latch = hpx.Latch(n + 1)
+        seen = []
+        lock = threading.Lock()
+
+        def hit(i):
+            with lock:
+                seen.append(i)
+            latch.count_down(1)
+
+        hpx.post_many(hit, [(i,) for i in range(n)])
+        latch.arrive_and_wait()
+        assert sorted(seen) == list(range(n))
+
+    def test_async_many_results_in_order(self):
+        import hpx_tpu as hpx
+        futs = hpx.async_many(lambda i: i * i, [(i,) for i in range(500)])
+        assert [f.get() for f in futs] == [i * i for i in range(500)]
+
+    def test_async_many_exception_isolated(self):
+        import hpx_tpu as hpx
+
+        def maybe(i):
+            if i == 3:
+                raise ValueError("boom")
+            return i
+
+        futs = hpx.async_many(maybe, [(i,) for i in range(6)])
+        for i, f in enumerate(futs):
+            if i == 3:
+                try:
+                    f.get()
+                    raise AssertionError("expected ValueError")
+                except ValueError:
+                    pass
+            else:
+                assert f.get() == i
+
+    def test_post_many_with_executor_object(self):
+        import threading
+        import hpx_tpu as hpx
+        from hpx_tpu.exec.executors import ParallelExecutor
+        n = 100
+        latch = hpx.Latch(n + 1)
+        hpx.post_many(lambda: latch.count_down(1), [()] * n,
+                      executor=ParallelExecutor())
+        latch.arrive_and_wait()
+
+    def test_async_many_accepts_generator(self):
+        import hpx_tpu as hpx
+        futs = hpx.async_many(lambda i: i + 1, ((i,) for i in range(50)))
+        assert [f.get(timeout=30) for f in futs] == list(range(1, 51))
+
+    def test_post_many_accepts_generator(self):
+        import hpx_tpu as hpx
+        latch = hpx.Latch(21)
+        hpx.post_many(lambda: latch.count_down(1),
+                      (() for _ in range(20)))
+        latch.arrive_and_wait()

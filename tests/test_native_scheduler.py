@@ -153,3 +153,79 @@ class TestNativePoolLockFree:
             assert count[0] == total
         finally:
             p.shutdown()
+
+
+class TestSubmitMany:
+    def test_batch_runs_all_exactly_once(self):
+        p = NativePool(4)
+        n = 20_000
+        hits = []
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def task(i):
+            with lock:
+                hits.append(i)
+                if len(hits) == n:
+                    done.set()
+
+        try:
+            p.submit_many([(task, (i,), {}) for i in range(n)])
+            assert done.wait(60), f"only {len(hits)}/{n} ran"
+            assert sorted(hits) == list(range(n))
+        finally:
+            p.shutdown()
+
+    def test_batch_from_inside_worker_uses_owner_deque(self):
+        p = NativePool(2)
+        total = 1 + 64
+        count = [0]
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def leaf():
+            with lock:
+                count[0] += 1
+                if count[0] == total:
+                    done.set()
+
+        def root():
+            leaf()
+            p.submit_many([(leaf, (), {})] * 64)
+
+        try:
+            p.submit(root)
+            assert done.wait(60), count[0]
+        finally:
+            p.shutdown()
+
+    def test_empty_batch_is_noop(self):
+        p = NativePool(1)
+        try:
+            p.submit_many([])
+            assert p.stats()["pending"] == 0
+        finally:
+            p.shutdown()
+
+    def test_batch_interleaves_with_single_submits(self):
+        p = NativePool(4)
+        n = 5_000
+        seen = set()
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def task(i):
+            with lock:
+                seen.add(i)
+                if len(seen) == 3 * n:
+                    done.set()
+
+        try:
+            p.submit_many([(task, (i,), {}) for i in range(n)])
+            for i in range(n, 2 * n):
+                p.submit(task, i)
+            p.submit_many([(task, (i,), {}) for i in range(2 * n, 3 * n)])
+            assert done.wait(60), len(seen)
+            assert seen == set(range(3 * n))
+        finally:
+            p.shutdown()

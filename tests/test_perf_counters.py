@@ -94,6 +94,14 @@ class TestRegistry:
         name = "/threads{locality#0/pool#default}/count/cumulative"
         before = pc.query_counter(name).value
         hpx.wait_all([hpx.async_(lambda: None) for _ in range(20)])
+        # `executed` increments AFTER each task body, and wait_all
+        # returns from inside the last body — poll briefly instead of
+        # racing the counter (flaked under CPU contention)
+        import time
+        for _ in range(500):
+            if pc.query_counter(name).value >= before + 20:
+                break
+            time.sleep(0.01)
         HPX_TEST(pc.query_counter(name).value >= before + 20)
 
     def test_dispatch_counter_advances(self):

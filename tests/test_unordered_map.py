@@ -117,3 +117,20 @@ def test_multiprocess_unordered_map():
                              "unordered_smoke.py"),
                 [], localities=3, timeout=420.0)
     assert rc == 0
+
+
+def test_num_partitions_round_robin_without_placement():
+    from hpx_tpu.containers.unordered_map import UnorderedMap
+    m = UnorderedMap(num_partitions=4)       # 1 locality: 4 partitions
+    assert m.num_partitions == 4
+    for i in range(20):
+        m.set(i, i * 2)
+    assert [m.get(i) for i in range(20)] == [i * 2 for i in range(20)]
+
+
+def test_num_partitions_zero_rejected():
+    import pytest
+    from hpx_tpu.core.errors import HpxError
+    from hpx_tpu.containers.unordered_map import UnorderedMap
+    with pytest.raises(HpxError):
+        UnorderedMap(num_partitions=0)
