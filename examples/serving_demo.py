@@ -74,6 +74,13 @@ def main() -> int:
         match = np.array_equal(np.asarray(sharded), np.asarray(greedy))
         print(f"sharded dp2/tp2: bit-match={match}")
         ok = ok and match
+        # int8 + tp: scales shard with their channels (quant.
+        # shard_quantized); output bit-matches single-device int8
+        qsharded = tfm.generate(quant.shard_quantized(qp, cfg, mesh),
+                                cfg, prompt, max_new=10, mesh=mesh)
+        qmatch = np.array_equal(np.asarray(qsharded), np.asarray(qout))
+        print(f"int8 sharded dp2/tp2: bit-match={qmatch}")
+        ok = ok and qmatch
 
     hits = np.where(np.asarray(pinned)[0] == eos)[0]
     ok = ok and hits.size > 0 and \

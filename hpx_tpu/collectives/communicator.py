@@ -37,6 +37,17 @@ from ..futures.future import Future, SharedState
 
 _lock = threading.Lock()
 _exchanges: Dict[Tuple[str, str, int], dict] = {}
+_hosted_total = 0     # exchanges whose root state lived HERE (cumulative)
+
+
+@plain_action(name="collectives.hosted_count")
+def hosted_exchange_count() -> int:
+    """How many collective exchanges this locality has hosted root
+    state for (cumulative). Lets tests/operators verify load placement
+    — e.g. that a communication_set really spreads fan-in across group
+    roots instead of funneling through locality 0."""
+    with _lock:
+        return _hosted_total
 
 
 def _combine(kind: str, contribs: Dict[int, Any], num_sites: int,
@@ -97,8 +108,12 @@ def _contribute(name: str, kind: str, gen: int, site: int, num_sites: int,
     sites have arrived (and_gate) with this site's combined result."""
     key = (name, kind, gen)
     st = SharedState()
+    global _hosted_total
     with _lock:
-        ex = _exchanges.setdefault(key, {"contribs": {}, "waiters": {}})
+        ex = _exchanges.get(key)
+        if ex is None:
+            ex = _exchanges[key] = {"contribs": {}, "waiters": {}}
+            _hosted_total += 1
         if site in ex["contribs"]:
             raise ValueError(
                 f"duplicate contribution from site {site} to {key}")

@@ -15,9 +15,11 @@ full-precision copy of the weight lives in HBM.
 
 Scope: the DECODE path (models/transformer.generate). Training stays
 full precision; the embedding stays dense (it is a gather table and
-the tied loss head's quality anchor). Quantized sharded decode is not
-wired (scales would shard with their channels — straightforward, not
-yet needed).
+the tied loss head's quality anchor). Sharded (dp x tp) decode is
+wired: scales shard WITH their output channels (quantized_param_specs
+— a scale's dim is size 1 exactly on the contracted axes, so its spec
+is the weight's spec with those axes unsharded), and dequantization
+stays shard-local and exact.
 """
 
 from __future__ import annotations
@@ -27,7 +29,8 @@ from typing import Any, Dict, NamedTuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["QTensor", "quantize_params", "dequant", "quantized_bytes"]
+__all__ = ["QTensor", "quantize_params", "dequant", "quantized_bytes",
+           "quantized_param_specs", "shard_quantized"]
 
 
 class QTensor(NamedTuple):
@@ -79,6 +82,36 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
             qlp[name] = _quantize(w, axes) if axes is not None else w
         out["layers"].append(qlp)
     return out
+
+
+def quantized_param_specs(cfg) -> Dict[str, Any]:
+    """PartitionSpecs matching quantize_params' tree: each quantized
+    weight becomes QTensor(q=<dense weight spec>, s=<that spec with the
+    contracted axes unsharded>). Scales keep dims of size 1 exactly on
+    the contract axes (keepdims absmax), so sharding them there would
+    be meaningless; on every output-channel axis they follow the weight
+    (e.g. wqkv heads over tp -> scales over tp), keeping dequantization
+    shard-local and exact under tensor parallelism."""
+    from jax.sharding import PartitionSpec as P
+    from .transformer import param_specs
+    specs = param_specs(cfg)
+    for lp in specs["layers"]:
+        for name, axes in _CONTRACT_AXES.items():
+            if name in lp:
+                wspec = lp[name]
+                dims = list(wspec)
+                for ax in axes:
+                    if ax < len(dims):
+                        dims[ax] = None
+                lp[name] = QTensor(q=wspec, s=P(*dims))
+    return specs
+
+
+def shard_quantized(qparams: Dict[str, Any], cfg, mesh) -> Dict[str, Any]:
+    """shard_params for quantized trees (int8 q and f32 s placed by
+    quantized_param_specs)."""
+    from .transformer import _place
+    return _place(qparams, quantized_param_specs(cfg), mesh)
 
 
 def quantized_bytes(tree: Any) -> int:

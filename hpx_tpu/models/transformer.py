@@ -844,13 +844,9 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         raise ValueError(
             "top_k/key have no effect at temperature=0 (greedy); pass "
             "temperature > 0 to sample")
-    if mesh is not None:
-        from .quant import QTensor
-        if any(isinstance(x, QTensor) for x in jax.tree.leaves(
-                params, is_leaf=lambda x: isinstance(x, QTensor))):
-            raise NotImplementedError(
-                "quantized sharded decode is not wired; serve int8 "
-                "weights single-device (models/quant.py)")
+    from .quant import QTensor
+    quantized = any(isinstance(x, QTensor) for x in jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QTensor)))
     from ..ops.attention import _pvary
 
     b, plen = prompt.shape
@@ -954,7 +950,11 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         return jax.jit(lambda p, t: run(p, t))(params, prompt)
 
     from jax.sharding import NamedSharding
-    pspecs = param_specs(cfg)
+    if quantized:
+        from .quant import quantized_param_specs
+        pspecs = quantized_param_specs(cfg)   # scales follow channels
+    else:
+        pspecs = param_specs(cfg)
     data_spec = P("dp", None)
     prog = jax.jit(shard_map(
         run, mesh=mesh,

@@ -1,0 +1,75 @@
+"""Tree collectives at real multi-process scale (7 localities, arity 3).
+
+A depth-2 communication_set over 7 sites: leaf groups {0,1,2} {3,4,5}
+{6} with roots 0/3/6, and a flat top communicator over the roots at
+locality 0. Exercises all_reduce / broadcast / barrier / reduce through
+the tree and then PROVES the load-spreading the tree exists for: every
+locality reports how many exchanges it hosted root state for
+(collectives.hosted_count) — group roots must have hosted, non-roots
+must have hosted none.
+
+Reference analog: libs/full/collectives communication_set tests
+(SURVEY.md §2.4 collectives row).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import hpx_tpu as hpx
+from hpx_tpu.collectives.comm_set import create_communication_set
+from hpx_tpu.collectives.communicator import hosted_exchange_count
+from hpx_tpu.dist.actions import async_action
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+ARITY = 3
+ROUNDS = 3
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+    n = hpx.get_num_localities()
+    cs = create_communication_set("smoke/tree", arity=ARITY)
+
+    for r in range(ROUNDS):
+        # all_reduce: sum of (site + r) over all sites
+        got = cs.all_reduce(here + r).get(timeout=120)
+        HPX_TEST_EQ(got, sum(range(n)) + n * r)
+
+        # broadcast: everyone sees site 0's value
+        val = f"round-{r}" if here == 0 else None
+        HPX_TEST_EQ(cs.broadcast(val).get(timeout=120), f"round-{r}")
+
+        # reduce: only site 0 gets the fold
+        red = cs.reduce(1).get(timeout=120)
+        HPX_TEST_EQ(red, n if here == 0 else None)
+
+        cs.barrier().get(timeout=120)
+
+    # placement check from locality 0: root state must live on the
+    # group roots (0, 3, 6, ... plus the top at 0) and NOWHERE else
+    cs.barrier().get(timeout=120)
+    if here == 0:
+        roots = {g * ARITY for g in range(-(-n // ARITY))}
+        counts = {loc: async_action(hosted_exchange_count, loc
+                                    ).get(timeout=120)
+                  for loc in range(n)}
+        for loc, c in counts.items():
+            if loc in roots:
+                HPX_TEST(c > 0, f"group root {loc} hosted nothing: "
+                         f"{counts}")
+            else:
+                HPX_TEST_EQ((loc, c), (loc, 0))
+        # fan-in genuinely spread: locality 0 did not host everything
+        total = sum(counts.values())
+        HPX_TEST(counts[0] < total, counts)
+    hpx.get_runtime().barrier("counted")
+
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,3 +98,20 @@ def test_bad_args():
         CommunicationSet("x", 4, 4)
     with pytest.raises(ValueError):
         CommunicationSet("x", 4, 0, arity=1)
+
+
+@pytest.mark.slow
+def test_multiprocess_comm_set_tree(monkeypatch):
+    """Depth-2 tree over 7 real localities: verbs fold correctly and
+    the root-side exchange state provably lands on group roots."""
+    import os
+    from hpx_tpu.run import launch
+    # 7 interpreters importing jax on a loaded 1-core host have been
+    # observed to exceed even the default 120 s bootstrap window
+    # (core/config.py DEFAULTS) — give the table broadcast more room
+    monkeypatch.setenv("HPX_TPU_STARTUP_TIMEOUT", "180")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = launch(os.path.join(repo, "tests", "mp_scripts",
+                             "comm_set_smoke.py"),
+                [], localities=7, timeout=420.0)
+    assert rc == 0
