@@ -844,9 +844,6 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         raise ValueError(
             "top_k/key have no effect at temperature=0 (greedy); pass "
             "temperature > 0 to sample")
-    from .quant import QTensor
-    quantized = any(isinstance(x, QTensor) for x in jax.tree.leaves(
-        params, is_leaf=lambda x: isinstance(x, QTensor)))
     from ..ops.attention import _pvary
 
     b, plen = prompt.shape
@@ -950,7 +947,9 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         return jax.jit(lambda p, t: run(p, t))(params, prompt)
 
     from jax.sharding import NamedSharding
-    if quantized:
+    from .quant import QTensor
+    if any(isinstance(x, QTensor) for x in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor))):
         from .quant import quantized_param_specs
         pspecs = quantized_param_specs(cfg)   # scales follow channels
     else:

@@ -21,6 +21,41 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 _lib_lock = threading.Lock()
 
+# live NativePool instances, for the perf-counter registry (weak: a
+# pool's lifetime is owned by its creator, not by observability)
+import weakref
+
+_live_pools: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def live_native_pools():
+    """Snapshot of live NativePool instances (perf-counter discovery)."""
+    return [p for p in list(_live_pools) if not p._shut]
+
+
+def _find_pool(name: str):
+    for p in list(_live_pools):
+        if p.name == name and not p._shut:
+            return p
+    return None
+
+
+def native_pool_stat(name: str, key: str) -> float:
+    """Counter feed, resolved by pool NAME at call time: a recreated
+    same-name pool is picked up automatically, and a dead pool reads 0
+    (no stale-instance weakrefs)."""
+    p = _find_pool(name)
+    if p is None:
+        return 0.0
+    return float(p.stats().get(key, 0))
+
+
+def native_pool_queue_len(name: str, wid: int) -> int:
+    """Per-worker queue depth by pool name (0 when absent/shut/out of
+    range — a recreated pool may have fewer workers)."""
+    p = _find_pool(name)
+    return 0 if p is None else p.queue_length(wid)
+
 _TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_size_t)
 
 
@@ -72,6 +107,10 @@ def native_lib() -> Optional[ctypes.CDLL]:
         lib.hpxrt_pool_stolen.argtypes = [ctypes.c_void_p]
         lib.hpxrt_pool_pending.restype = ctypes.c_long
         lib.hpxrt_pool_pending.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "hpxrt_pool_queue_len"):   # stale-.so tolerant
+            lib.hpxrt_pool_queue_len.restype = ctypes.c_long
+            lib.hpxrt_pool_queue_len.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_int]
         lib.hpxrt_now_ns.restype = ctypes.c_uint64
         lib.hpxrt_counter_new.restype = ctypes.c_void_p
         lib.hpxrt_counter_add.argtypes = [ctypes.c_void_p, ctypes.c_int64]
@@ -148,10 +187,27 @@ class NativePool:
                     pass
 
         self._tramp = _TASK_FN(_tramp)
+        _live_pools.add(self)
 
     @property
     def num_threads(self) -> int:
         return self._n
+
+    def queue_length(self, wid: int) -> int:
+        """ONE worker's queue depth (lock-free deque + staged inbox);
+        0 after shutdown or out of range. Counter feed only — the C
+        read is racy by design, and the shutdown lock pins the handle
+        against the free in shutdown() (counters poll from arbitrary
+        threads)."""
+        with self._shutdown_lock:
+            if self._shut or \
+                    not hasattr(self._lib, "hpxrt_pool_queue_len"):
+                return 0
+            return max(0, int(self._lib.hpxrt_pool_queue_len(
+                self._handle, wid)))
+
+    def queue_lengths(self) -> list:
+        return [self.queue_length(i) for i in range(self._n)]
 
     def submit(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
         if self._shut:  # the C++ pool was freed; a call would be UAF
@@ -201,7 +257,8 @@ class NativePool:
             return False
         return bool(self._lib.hpxrt_pool_in_worker(self._handle))
 
-    def stats(self) -> dict:
+    def _stats_locked(self) -> dict:
+        """Caller holds _shutdown_lock (or is shutdown() itself)."""
         if self._shut:
             return dict(self._last_stats, shutdown=True)
         self._last_stats = {
@@ -211,6 +268,13 @@ class NativePool:
             "threads": self._n,
         }
         return self._last_stats
+
+    def stats(self) -> dict:
+        # under the shutdown lock: counter callbacks poll stats() from
+        # arbitrary threads, and an unlocked read could dereference the
+        # C++ pool mid-free (same hazard queue_length documents)
+        with self._shutdown_lock:
+            return self._stats_locked()
 
     def shutdown(self, wait: bool = True) -> None:
         # wait is accepted for interface parity with WorkStealingPool;
@@ -231,7 +295,7 @@ class NativePool:
         with self._shutdown_lock:
             if self._shut:
                 return
-            self.stats()          # snapshot final counters
+            self._stats_locked()  # snapshot final counters (lock held)
             self._shut = True
             # workers in _worker_of must not help a dead pool
             self._lib.hpxrt_pool_shutdown(self._handle)

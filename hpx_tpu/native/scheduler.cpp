@@ -398,6 +398,20 @@ long hpxrt_pool_pending(void* pool) {
   return v > 0 ? v : 0;
 }
 
+// Per-worker queue depth (deque + staged inbox) — the counter feed for
+// /threads{.../pool#<name>/worker-thread#i}/queue/length. Racy reads by
+// design (relaxed size() + try-lock on the inbox): a perf counter must
+// never contend with the scheduler hot path.
+long hpxrt_pool_queue_len(void* pool, int wid) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (wid < 0 || wid >= static_cast<int>(p->deques.size())) return -1;
+  long n = static_cast<long>(p->deques[wid]->size());
+  Inbox& ib = *p->inboxes[wid];
+  std::unique_lock<std::mutex> lk(ib.m, std::try_to_lock);
+  if (lk.owns_lock()) n += static_cast<long>(ib.q.size());
+  return n;
+}
+
 // -- standalone Chase-Lev deque (lock-free structure surface) ---------------
 // Exposed for direct use and stress testing: items are opaque pointers;
 // push/take are OWNER-thread ops, steal is any-thread (ctypes releases

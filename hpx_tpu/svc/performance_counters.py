@@ -327,6 +327,35 @@ def _register_builtins() -> None:
                 lambda p=pname: float(io_pool_pending(p))),
             f"pool#{pname}")
 
+    # native C++ pools (exec/_make_pool-created NativePool instances):
+    # cumulative executed/stolen from the scheduler's atomics, total
+    # pending, and PER-WORKER queue depths. Discovery at refresh time
+    # (pools created later appear on the next refresh hook run), but
+    # callbacks resolve the pool BY NAME at every read — a recreated
+    # same-name pool is picked up, a shut-down one reads 0, and no
+    # instance is kept alive by observability (the io-pool pattern).
+    try:
+        from ..native.loader import (live_native_pools,
+                                     native_pool_queue_len,
+                                     native_pool_stat)
+        pools = live_native_pools()
+    except Exception:  # noqa: BLE001 — native runtime optional
+        pools = []
+
+    for np_ in pools:
+        inst = f"pool#{np_.name}"
+        nm = np_.name
+        put("threads", "count/cumulative", CallbackCounter(
+            lambda n=nm: native_pool_stat(n, "executed")), inst)
+        put("threads", "count/stolen", CallbackCounter(
+            lambda n=nm: native_pool_stat(n, "stolen")), inst)
+        put("threads", "queue/length", CallbackCounter(
+            lambda n=nm: native_pool_stat(n, "pending")), inst)
+        for w in range(np_.num_threads):
+            put("threads", "queue/length", CallbackCounter(
+                lambda n=nm, w=w: float(native_pool_queue_len(n, w))),
+                f"{inst}/worker-thread#{w}")
+
     # runtime uptime
     name = counter_name("runtime", "uptime", "total", loc)
     with _registry_lock:
