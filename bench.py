@@ -180,10 +180,12 @@ def bench_triad(jax, jnp):
     return gbs
 
 
-def bench_stencil_unfused(jax, jnp, heat_step_best):
+def bench_stencil_unfused(jax, jnp, heat_step_best, copy_rate=None):
     """One heat step per dispatch: the HBM-bound per-step number (the
     blocked pallas kernel — ops/stencil.pallas_heat_step — which
-    streams 8 B/cell where XLA's roll lowering moves ~4x that)."""
+    streams 8 B/cell where XLA's roll lowering moves ~4x that).
+    `copy_rate` (elems/s of bench_copy_stream) adds the same-session
+    normalized copy_ratio."""
     n = 1 << 24
     coef = jnp.float32(0.25)
 
@@ -208,8 +210,13 @@ def bench_stencil_unfused(jax, jnp, heat_step_best):
     per, spread = robust(lambda: slope_time(chain, 64, 640, repeats=5))
     cells = n / per
     roof = HBM_PEAK_GBS * 1e9 / 8.0          # read 4B + write 4B per cell
+    extra = {}
+    if copy_rate:
+        # ratio vs the same-session copy stream: the drift-immune bar
+        # (VERDICT r4 item 3 — done when >= 0.9 of copy OR >= 0.75 roof)
+        extra["copy_ratio"] = round(cells / copy_rate, 3)
     emit("1d_stencil_unfused_cell_updates", cells / 1e6, "Mcells/s",
-         cells / roof, spread=round(spread, 3))
+         cells / roof, spread=round(spread, 3), **extra)
     return cells
 
 
@@ -320,9 +327,87 @@ def bench_attention(jax, jnp):
     per, spread = robust(lambda: slope_time(chain, 8, 48))
     flops = 4 * B * N * S * S * H * 0.5          # causal halves the work
     tf = flops / per / 1e12
+    from hpx_tpu.ops.attention_pallas import resolve_blocks
+    bq, bk = resolve_blocks(S, S, True)
     emit("flash_attention_tflops", tf, "TFLOP/s", tf * 1e12 / MXU_PEAK_BF16,
-         shape=f"B{B} S{S} N{N} H{H} bf16 causal", spread=round(spread, 3))
+         shape=f"B{B} S{S} N{N} H{H} bf16 causal", spread=round(spread, 3),
+         blocks=f"{bq}x{bk}")
     return tf
+
+
+def bench_attention_bwd(jax, jnp):
+    """Backward flash kernels (custom_vjp): time grad of sum(flash)
+    w.r.t. (q, k, v). FLOP model: fwd 2 matmuls + bwd 5 matmuls per
+    tile pair => total 3.5x the forward's 2; causal halves everything.
+    Reported TFLOP/s covers the whole fwd+bwd step, which is what
+    training sees; vs_baseline = that rate over MXU peak."""
+    from hpx_tpu.ops.attention_pallas import flash_attention
+    B, S, N, H = 2, 4096, 8, 128
+    rng = np.random.default_rng(1)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, S, N, H), np.float32), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    dq, dk, dv = g(q, k, v)
+    jax.block_until_ready((dq, dk, dv))
+
+    def chain(kk):
+        qq = q
+        t0 = time.perf_counter()
+        for _ in range(kk):
+            dq, _dk, _dv = g(qq, k, v)
+            qq = dq.astype(jnp.bfloat16)        # chain dependency
+        _ = float(qq[0, 0, 0, 0])
+        return time.perf_counter() - t0
+
+    per, spread = robust(lambda: slope_time(chain, 4, 24))
+    flops = 3.5 * 4 * B * N * S * S * H * 0.5
+    tf = flops / per / 1e12
+    emit("flash_attention_bwd_tflops", tf, "TFLOP/s",
+         tf * 1e12 / MXU_PEAK_BF16,
+         shape=f"B{B} S{S} N{N} H{H} bf16 causal fwd+bwd",
+         spread=round(spread, 3))
+    return tf
+
+
+def bench_copy_stream(jax, jnp):
+    """Pure HBM copy stream (read 4B + write 4B per element — the same
+    traffic shape as one unfused stencil step). Its measured rate is the
+    SAME-SESSION normalizer for the stencil: chip-to-chip drift hits
+    both equally, so stencil/copy_ratio stays meaningful when absolute
+    numbers swing +-15% (BASELINE.md round-4 note)."""
+    n = 1 << 24
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(u):
+        # *c with c != 1: a real read->write pass XLA cannot alias away
+        return u * jnp.float32(1.0000001)
+
+    u = jnp.asarray(np.random.default_rng(3).random(n, np.float32))
+    u = step(u)
+    _ = float(u[0])
+    state = [u]
+
+    def chain(k):
+        uu = state[0]
+        t0 = time.perf_counter()
+        for _ in range(k):
+            uu = step(uu)
+        _ = float(uu[0])
+        state[0] = uu
+        return time.perf_counter() - t0
+
+    per, spread = robust(lambda: slope_time(chain, 64, 640, repeats=5))
+    elems = n / per
+    roof = HBM_PEAK_GBS * 1e9 / 8.0
+    emit("copy_stream_elems", elems / 1e6, "Melem/s", elems / roof,
+         spread=round(spread, 3))
+    return elems
 
 
 def bench_transformer(jax, jnp):
@@ -468,8 +553,10 @@ def _bench_main() -> None:
     print(f"# device: {dev} platform={dev.platform}", file=sys.stderr)
 
     bench_triad(jax, jnp)
-    bench_stencil_unfused(jax, jnp, heat_step_best)
+    copy_rate = bench_copy_stream(jax, jnp)
+    bench_stencil_unfused(jax, jnp, heat_step_best, copy_rate=copy_rate)
     bench_attention(jax, jnp)
+    bench_attention_bwd(jax, jnp)
     bench_transformer(jax, jnp)
     bench_fft(jax, jnp)
 

@@ -1,0 +1,112 @@
+"""Flash-attention forward block-size autotune (real TPU).
+
+Sweeps (block_q, block_k) per shape class — S in {2k, 4k, 8k, 16k},
+causal x non-causal at the bench head layout (N8 H128 bf16) — with the
+same slope-timing discipline as bench.py, prints one JSON line per
+measurement, and writes the winners to
+hpx_tpu/ops/flash_blocks.json, which ops/attention_pallas.resolve_blocks
+consults whenever callers don't pass blocks explicitly.
+
+Usage: python benchmarks/flash_tune.py [--quick]
+  --quick: S in {2k, 4k} only and fewer samples (smoke/dev loops).
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def slope_time(run_chain, k1, k2, repeats=3):
+    run_chain(k1)
+    t1 = min(run_chain(k1) for _ in range(repeats))
+    t2 = min(run_chain(k2) for _ in range(repeats))
+    return max(t2 - t1, 1e-9) / (k2 - k1)
+
+
+def measure(jax, jnp, flash, S, causal, bq, bk, samples=3):
+    B, N, H = (2, 8, 128) if S <= 8192 else (1, 8, 128)
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, S, N, H), np.float32), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    f = jax.jit(functools.partial(flash, causal=causal, block_q=bq,
+                                  block_k=bk))
+    out = f(q, k, v)
+    jax.block_until_ready(out)
+
+    def chain(kk):
+        qq = q
+        t0 = time.perf_counter()
+        for _ in range(kk):
+            qq = f(qq, k, v)
+        _ = float(qq[0, 0, 0, 0])
+        return time.perf_counter() - t0
+
+    pers = sorted(slope_time(chain, 4, 20) for _ in range(samples))
+    per = pers[samples // 2]
+    flops = 4 * B * N * S * S * H * (0.5 if causal else 1.0)
+    return flops / per / 1e12, (pers[-1] - pers[0]) / per
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    import jax
+    import jax.numpy as jnp
+    from hpx_tpu.ops.attention_pallas import _BLOCKS_FILE, flash_attention
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "flash_tune needs a real TPU; "
+                          f"backend={jax.default_backend()}"}))
+        return 1
+
+    seqs = (2048, 4096) if quick else (2048, 4096, 8192, 16384)
+    cand = (256, 512, 1024, 2048)
+    samples = 2 if quick else 3
+    table = {}
+    for S in seqs:
+        for causal in (True, False):
+            best = None
+            for bq in cand:
+                if bq > S:
+                    continue
+                for bk in cand:
+                    if bk > S:
+                        continue
+                    try:
+                        tf, spread = measure(jax, jnp, flash_attention,
+                                             S, causal, bq, bk,
+                                             samples=samples)
+                    except Exception as e:  # noqa: BLE001 — eg VMEM OOM
+                        print(json.dumps({"S": S, "causal": causal,
+                                          "bq": bq, "bk": bk,
+                                          "error": str(e)[:120]}),
+                              flush=True)
+                        continue
+                    print(json.dumps({"S": S, "causal": causal,
+                                      "bq": bq, "bk": bk,
+                                      "tflops": round(tf, 1),
+                                      "spread": round(spread, 3)}),
+                          flush=True)
+                    if best is None or tf > best[0]:
+                        best = (tf, bq, bk)
+            if best:
+                table[f"{S}x{S}x{int(causal)}"] = [best[1], best[2]]
+                print(json.dumps({"S": S, "causal": causal,
+                                  "winner": best[1:],
+                                  "tflops": round(best[0], 1)}),
+                      flush=True)
+
+    with open(_BLOCKS_FILE, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+    print(json.dumps({"wrote": _BLOCKS_FILE, "entries": len(table)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,19 +22,26 @@ _lib_tried = False
 _lib_lock = threading.Lock()
 
 # live NativePool instances, for the perf-counter registry (weak: a
-# pool's lifetime is owned by its creator, not by observability)
+# pool's lifetime is owned by its creator, not by observability).
+# WeakSet is NOT thread-safe — all access under _pools_lock (counter
+# threads snapshot while constructors add).
 import weakref
 
 _live_pools: "weakref.WeakSet" = weakref.WeakSet()
+_pools_lock = threading.Lock()
 
 
 def live_native_pools():
     """Snapshot of live NativePool instances (perf-counter discovery)."""
-    return [p for p in list(_live_pools) if not p._shut]
+    with _pools_lock:
+        pools = list(_live_pools)
+    return [p for p in pools if not p._shut]
 
 
 def _find_pool(name: str):
-    for p in list(_live_pools):
+    with _pools_lock:
+        pools = list(_live_pools)
+    for p in pools:
         if p.name == name and not p._shut:
             return p
     return None
@@ -187,7 +194,8 @@ class NativePool:
                     pass
 
         self._tramp = _TASK_FN(_tramp)
-        _live_pools.add(self)
+        with _pools_lock:
+            _live_pools.add(self)
 
     @property
     def num_threads(self) -> int:
@@ -291,15 +299,19 @@ class NativePool:
             return
         # the reaper hand-off means concurrent shutdown callers are
         # expected (reaper + atexit/__del__): serialize the
-        # check-then-free so the native shutdown runs exactly once
+        # check-then-free so the native shutdown runs exactly once.
+        # The lock covers ONLY the state flip — holding it across the
+        # C++ join would deadlock any pool TASK that reads stats()
+        # (worker blocks on the lock, join waits for the worker).
         with self._shutdown_lock:
             if self._shut:
                 return
             self._stats_locked()  # snapshot final counters (lock held)
             self._shut = True
-            # workers in _worker_of must not help a dead pool
-            self._lib.hpxrt_pool_shutdown(self._handle)
-            self._handle = None
+            handle, self._handle = self._handle, None
+        # workers in _worker_of must not help a dead pool; stats/
+        # queue_length callers now see _shut and never touch `handle`
+        self._lib.hpxrt_pool_shutdown(handle)
 
     def __del__(self) -> None:  # best-effort; explicit shutdown preferred
         try:

@@ -27,8 +27,10 @@ kernels are testable on the CPU mesh (pallas interpret semantics).
 from __future__ import annotations
 
 import functools
+import json
 import math
-from typing import Optional
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +38,52 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "flash_attention_chunk",
-           "flash_attention_bwd"]
+           "flash_attention_bwd", "resolve_blocks"]
+
+
+# ---------------------------------------------------------------------------
+# forward block-size selection
+# ---------------------------------------------------------------------------
+# Tile shape is THE forward-MFU lever at short S (causal diagonal tiles
+# are half-masked: with 1024^2 blocks at S=4096 a fifth of the MXU work
+# is wasted; smaller block_k trims the diagonal waste but adds per-tile
+# loop overhead — the right point is measured, not derived). Resolution
+# order: explicit arg > HPX_FLASH_BLOCK_Q/K env > measured table
+# (benchmarks/flash_tune.py writes flash_blocks.json next to this file
+# after sweeping on real hardware) > 1024x1024 default.
+
+_BLOCKS_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "flash_blocks.json")
+_blocks_table: Optional[dict] = None
+
+
+def _load_blocks_table() -> dict:
+    global _blocks_table
+    if _blocks_table is None:
+        try:
+            with open(_BLOCKS_FILE) as f:
+                _blocks_table = {k: tuple(v)
+                                 for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            _blocks_table = {}
+    return _blocks_table
+
+
+def resolve_blocks(seq_q: int, seq_k: int,
+                   causal: bool) -> Tuple[int, int]:
+    """The (block_q, block_k) the forward kernel will use for this
+    shape class when the caller doesn't pass blocks explicitly."""
+    table = _load_blocks_table()
+    bq, bk = table.get(f"{seq_q}x{seq_k}x{int(causal)}", (1024, 1024))
+    # env overrides are PER-DIMENSION: the unset one keeps the
+    # table/default value rather than snapping back to 1024
+    env_q = os.environ.get("HPX_FLASH_BLOCK_Q")
+    env_k = os.environ.get("HPX_FLASH_BLOCK_K")
+    if env_q:
+        bq = int(env_q)
+    if env_k:
+        bk = int(env_k)
+    return bq, bk
 
 
 def _sds(shape, dtype, *operands):
@@ -273,13 +320,16 @@ _flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = False, block_q: int = 1024,
-                    block_k: int = 1024,
+                    causal: bool = False,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """[B, S, N, H] flash attention as one pallas_call per device.
 
-    S is padded to the block size internally; H should be a multiple of
-    the 128-lane layout's tile for best MXU utilization (64/128).
+    block_q/block_k default to resolve_blocks' per-shape-class choice
+    (env override / measured autotune table / 1024). S is padded to the
+    block size internally; H should be a multiple of the 128-lane
+    layout's tile for best MXU utilization (64/128).
     Differentiable: jax.custom_vjp routes reverse-mode through the
     pallas backward kernels (flash_attention_bwd).
 
@@ -290,6 +340,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None or block_k is None:
+        rq, rk = resolve_blocks(q.shape[1], k.shape[1], causal)
+        block_q = rq if block_q is None else block_q
+        block_k = rk if block_k is None else block_k
     return _flash_attention(q, k, v, causal, block_q, block_k, interpret)
 
 

@@ -316,3 +316,59 @@ class TestGqaXlaPaths:
         want = reference_attention(q, kr, vr, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestBlockResolution:
+    def test_default_blocks(self, monkeypatch):
+        from hpx_tpu.ops import attention_pallas as ap
+        monkeypatch.setattr(ap, "_blocks_table", {})   # no tuned table
+        monkeypatch.delenv("HPX_FLASH_BLOCK_Q", raising=False)
+        monkeypatch.delenv("HPX_FLASH_BLOCK_K", raising=False)
+        assert ap.resolve_blocks(4096, 4096, True) == (1024, 1024)
+        monkeypatch.setattr(ap, "_blocks_table", None)
+
+    def test_env_override(self, monkeypatch):
+        from hpx_tpu.ops import attention_pallas as ap
+        monkeypatch.setenv("HPX_FLASH_BLOCK_Q", "256")
+        monkeypatch.setenv("HPX_FLASH_BLOCK_K", "512")
+        assert ap.resolve_blocks(4096, 4096, True) == (256, 512)
+
+    def test_table_override(self, tmp_path, monkeypatch):
+        import json
+        from hpx_tpu.ops import attention_pallas as ap
+        p = tmp_path / "flash_blocks.json"
+        p.write_text(json.dumps({"4096x4096x1": [512, 1024]}))
+        monkeypatch.setattr(ap, "_BLOCKS_FILE", str(p))
+        monkeypatch.setattr(ap, "_blocks_table", None)   # drop cache
+        assert ap.resolve_blocks(4096, 4096, True) == (512, 1024)
+        assert ap.resolve_blocks(2048, 2048, True) == (1024, 1024)
+        monkeypatch.setattr(ap, "_blocks_table", None)
+
+    def test_explicit_blocks_still_honored(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from hpx_tpu.ops.attention_pallas import flash_attention
+        from hpx_tpu.ops.attention import reference_attention
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.standard_normal((1, 64, 2, 8)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 64, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 64, 2, 8)), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, block_q=16,
+                              block_k=32)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3)
+
+    def test_partial_env_override_keeps_table_value(self, tmp_path,
+                                                    monkeypatch):
+        import json
+        from hpx_tpu.ops import attention_pallas as ap
+        p = tmp_path / "flash_blocks.json"
+        p.write_text(json.dumps({"4096x4096x1": [512, 512]}))
+        monkeypatch.setattr(ap, "_BLOCKS_FILE", str(p))
+        monkeypatch.setattr(ap, "_blocks_table", None)
+        monkeypatch.setenv("HPX_FLASH_BLOCK_Q", "256")
+        monkeypatch.delenv("HPX_FLASH_BLOCK_K", raising=False)
+        # q from env, k from the tuned table — not a hardcoded 1024
+        assert ap.resolve_blocks(4096, 4096, True) == (256, 512)
+        monkeypatch.setattr(ap, "_blocks_table", None)
