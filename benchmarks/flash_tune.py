@@ -21,12 +21,7 @@ sys.path.insert(0, ".")
 
 import numpy as np
 
-
-def slope_time(run_chain, k1, k2, repeats=3):
-    run_chain(k1)
-    t1 = min(run_chain(k1) for _ in range(repeats))
-    t2 = min(run_chain(k2) for _ in range(repeats))
-    return max(t2 - t1, 1e-9) / (k2 - k1)
+from bench import slope_time  # noqa: E402 — one timing discipline
 
 
 def measure(jax, jnp, flash, S, causal, bq, bk, samples=3):
@@ -49,7 +44,7 @@ def measure(jax, jnp, flash, S, causal, bq, bk, samples=3):
         return time.perf_counter() - t0
 
     pers = sorted(slope_time(chain, 4, 20) for _ in range(samples))
-    per = pers[samples // 2]
+    per = pers[(samples - 1) // 2]     # median (odd) / faster-of-2
     flops = 4 * B * N * S * S * H * (0.5 if causal else 1.0)
     return flops / per / 1e12, (pers[-1] - pers[0]) / per
 
@@ -102,9 +97,22 @@ def main() -> int:
                                   "tflops": round(best[0], 1)}),
                       flush=True)
 
-    with open(_BLOCKS_FILE, "w") as f:
-        json.dump(table, f, indent=1, sort_keys=True)
-    print(json.dumps({"wrote": _BLOCKS_FILE, "entries": len(table)}))
+    # MERGE into any existing table (a --quick smoke must not discard
+    # previously tuned 8k/16k entries) and write atomically (a kill
+    # mid-dump must not leave a truncated file that silently reads as
+    # an empty table)
+    try:
+        with open(_BLOCKS_FILE) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        merged = {}
+    merged.update(table)
+    tmp = _BLOCKS_FILE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, indent=1, sort_keys=True)
+    os.replace(tmp, _BLOCKS_FILE)
+    print(json.dumps({"wrote": _BLOCKS_FILE, "new": len(table),
+                      "total": len(merged)}))
     return 0
 
 

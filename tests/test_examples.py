@@ -10,6 +10,12 @@ import sys
 
 import pytest
 
+# every test here shells out to a fresh interpreter (jax import + mesh
+# compile each time) — the dominant share of suite wall-clock. Deselect
+# in dev loops with -m 'not slow'; CI and the pre-round full run keep
+# them.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
@@ -69,5 +75,8 @@ def test_future_overhead_benchmark():
     assert r.returncode == 0, r.stderr
     import json
     rows = [json.loads(line) for line in r.stdout.splitlines() if line]
-    assert len(rows) == 3
+    rows = [r_ for r_ in rows if "tasks_per_s" in r_]
+    names = {(r_["name"], r_["executor"]) for r_ in rows}
+    assert ("post+latch", "default-pool") in names, names
+    assert ("post_many+latch (batched)", "default-pool") in names, names
     assert all(row["tasks_per_s"] > 0 for row in rows)
