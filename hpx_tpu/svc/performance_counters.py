@@ -303,16 +303,24 @@ def _register_builtins() -> None:
             if name not in _registry:
                 _registry[name] = c
 
-    # host task pool (scheduler counters)
-    from ..runtime.threadpool import default_pool
-    pool = default_pool()
+    # host task pool (scheduler counters). Resolve the CURRENT pool
+    # inside each callback: binding the instance at registration left
+    # the counters reading a dead pool forever after
+    # reset_default_pool() (observed as a full-suite-order flake). Read
+    # the module slot rather than calling default_pool() — a counter
+    # poll must OBSERVE, never lazily resurrect a pool that was shut
+    # down (same discipline as the native-pool counters below).
+    def _dpool_stat(key):
+        from ..runtime import threadpool as _tp
+        p = _tp._default_pool
+        return 0.0 if p is None else float(p.stats().get(key, 0))
+
     put("threads", "count/cumulative",
-        CallbackCounter(lambda: pool.stats()["executed"]), "pool#default")
+        CallbackCounter(lambda: _dpool_stat("executed")), "pool#default")
     put("threads", "count/stolen",
-        CallbackCounter(lambda: pool.stats()["stolen"]), "pool#default")
+        CallbackCounter(lambda: _dpool_stat("stolen")), "pool#default")
     put("threads", "queue/length",
-        CallbackCounter(lambda: pool.stats().get("pending", 0)),
-        "pool#default")
+        CallbackCounter(lambda: _dpool_stat("pending")), "pool#default")
 
     # io_service helper pools (io/timer/parcel + user pools) — queue
     # length per named pool, like the reference's io_service counters.

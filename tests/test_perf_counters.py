@@ -252,3 +252,19 @@ class TestNativePoolCounters:
                 f"{base}/count/cumulative").value >= k
         finally:
             pool2.shutdown()
+
+
+def test_default_pool_counter_survives_pool_reset():
+    """Counters must track the CURRENT default pool: after
+    reset_default_pool() the callbacks resolve the new pool instead of
+    reading the dead one forever (full-suite-order flake regression)."""
+    from hpx_tpu.runtime.threadpool import reset_default_pool
+    name = "/threads{locality#0/pool#default}/count/cumulative"
+    reset_default_pool()
+    before = pc.query_counter(name).value
+    hpx.wait_all([hpx.async_(lambda: None) for _ in range(10)])
+    for _ in range(500):
+        if pc.query_counter(name).value >= before + 10:
+            break
+        time.sleep(0.01)
+    HPX_TEST(pc.query_counter(name).value >= before + 10)
