@@ -118,6 +118,9 @@ def native_lib() -> Optional[ctypes.CDLL]:
             lib.hpxrt_pool_queue_len.restype = ctypes.c_long
             lib.hpxrt_pool_queue_len.argtypes = [ctypes.c_void_p,
                                                  ctypes.c_int]
+        if hasattr(lib, "hpxrt_pool_idle"):
+            lib.hpxrt_pool_idle.restype = ctypes.c_int
+            lib.hpxrt_pool_idle.argtypes = [ctypes.c_void_p]
         lib.hpxrt_now_ns.restype = ctypes.c_uint64
         lib.hpxrt_counter_new.restype = ctypes.c_void_p
         lib.hpxrt_counter_add.argtypes = [ctypes.c_void_p, ctypes.c_int64]
@@ -275,6 +278,9 @@ class NativePool:
             "pending": int(self._lib.hpxrt_pool_pending(self._handle)),
             "threads": self._n,
         }
+        if hasattr(self._lib, "hpxrt_pool_idle"):
+            self._last_stats["idle"] = int(
+                self._lib.hpxrt_pool_idle(self._handle))
         return self._last_stats
 
     def stats(self) -> dict:

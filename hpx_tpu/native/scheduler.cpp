@@ -398,6 +398,12 @@ long hpxrt_pool_pending(void* pool) {
   return v > 0 ? v : 0;
 }
 
+int hpxrt_pool_idle(void* pool) {
+  // workers currently parked on the cv (shallow or deep) — the
+  // instantaneous idle count behind the idle-rate counter
+  return static_cast<Pool*>(pool)->idle.load(std::memory_order_relaxed);
+}
+
 // Per-worker queue depth (deque + staged inbox) — the counter feed for
 // /threads{.../pool#<name>/worker-thread#i}/queue/length. Racy reads by
 // design (relaxed size() + try-lock on the inbox): a perf counter must

@@ -222,7 +222,8 @@ class WorkStealingPool:
     def stats(self) -> dict:
         return {"executed": self._executed, "stolen": self._stolen,
                 "pending": sum(len(q) for q in self._queues),
-                "threads": len(self._queues)}
+                "threads": len(self._queues),
+                "idle": self._idle}
 
 
 _default_pool: Optional[WorkStealingPool] = None

@@ -315,12 +315,23 @@ def _register_builtins() -> None:
         p = _tp._default_pool
         return 0.0 if p is None else float(p.stats().get(key, 0))
 
+    def _dpool_idle_rate():
+        from ..runtime import threadpool as _tp
+        p = _tp._default_pool
+        if p is None:
+            return 0.0
+        st = p.stats()
+        return float(st.get("idle", 0)) / max(1, st.get("threads", 1))
+
     put("threads", "count/cumulative",
         CallbackCounter(lambda: _dpool_stat("executed")), "pool#default")
     put("threads", "count/stolen",
         CallbackCounter(lambda: _dpool_stat("stolen")), "pool#default")
     put("threads", "queue/length",
         CallbackCounter(lambda: _dpool_stat("pending")), "pool#default")
+    # HPX_WITH_THREAD_IDLE_RATES analog: parked workers / total, 0..1
+    put("threads", "idle-rate",
+        CallbackCounter(_dpool_idle_rate), "pool#default")
 
     # io_service helper pools (io/timer/parcel + user pools) — queue
     # length per named pool, like the reference's io_service counters.
@@ -359,6 +370,9 @@ def _register_builtins() -> None:
             lambda n=nm: native_pool_stat(n, "stolen")), inst)
         put("threads", "queue/length", CallbackCounter(
             lambda n=nm: native_pool_stat(n, "pending")), inst)
+        put("threads", "idle-rate", CallbackCounter(
+            lambda n=nm: native_pool_stat(n, "idle")
+            / max(1.0, native_pool_stat(n, "threads"))), inst)
         for w in range(np_.num_threads):
             put("threads", "queue/length", CallbackCounter(
                 lambda n=nm, w=w: float(native_pool_queue_len(n, w))),

@@ -268,3 +268,29 @@ def test_default_pool_counter_survives_pool_reset():
             break
         time.sleep(0.01)
     HPX_TEST(pc.query_counter(name).value >= before + 10)
+
+
+def test_idle_rate_counters():
+    """HPX_WITH_THREAD_IDLE_RATES analog: parked/total in [0, 1] for
+    both the default pool and native pools."""
+    name = "/threads{locality#0/pool#default}/idle-rate"
+    v = pc.query_counter(name).value
+    assert 0.0 <= v <= 1.0, v
+    try:
+        from hpx_tpu.native.loader import NativePool
+        pool = NativePool(2, "idlecnt")
+    except Exception:
+        pytest.skip("native runtime unavailable")
+    try:
+        n = "/threads{locality#0/pool#idlecnt}/idle-rate"
+        # give the workers a moment to park, then the rate should be
+        # high on an idle pool
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if pc.query_counter(n).value >= 0.5:
+                break
+            time.sleep(0.05)
+        v = pc.query_counter(n).value
+        assert 0.5 <= v <= 1.0, v     # an idle pool must READ as idle
+    finally:
+        pool.shutdown()
