@@ -118,7 +118,10 @@ class SharedState(Generic[T]):
         # Work-helping (HPX suspension analog): a pool worker waiting on a
         # future keeps executing queued tasks so nested async+get patterns
         # can't starve the pool — essential on few-core hosts where the
-        # whole pool may be a single worker.
+        # whole pool may be a single worker. help_one itself is
+        # depth-bounded (threadpool.HELP_DEPTH_CAP): a mass fan-out of
+        # blocking tasks parks at the cap instead of recursing one
+        # Python/C call chain per nested help into a stack overflow.
         from ..runtime.threadpool import current_worker_pool
         pool = current_worker_pool()
         if pool is not None:
@@ -128,8 +131,9 @@ class SharedState(Generic[T]):
                 if deadline is not None and _time.monotonic() >= deadline:
                     return False
                 if not pool.help_one():
-                    # nothing runnable: the dependency is on another thread
-                    # (or a device); park briefly and re-check
+                    # nothing runnable (or at the help-depth cap): the
+                    # dependency completes on another thread (or a
+                    # device); park briefly and re-check
                     with self._lock:
                         if self.is_ready():
                             return True

@@ -261,7 +261,16 @@ class NativePool:
     def help_one(self) -> bool:
         if self._shut:
             return False
-        return bool(self._lib.hpxrt_pool_help_one(self._handle))
+        # depth-bounded like the Python pool: every nested help crosses
+        # the C stack through the ctypes trampoline, so unbounded
+        # nesting overflows long before Python's recursion limit
+        from ..runtime.threadpool import enter_help, exit_help
+        if not enter_help():
+            return False
+        try:
+            return bool(self._lib.hpxrt_pool_help_one(self._handle))
+        finally:
+            exit_help()
 
     def in_worker(self) -> bool:
         if self._shut:

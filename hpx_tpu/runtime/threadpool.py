@@ -41,6 +41,43 @@ def set_task_observer(obs: Optional[Callable[..., None]]) -> None:
     _task_observer = obs
 
 
+# Work-helping recursion bound, enforced INSIDE help_one (both pools),
+# so every help site — future waits, execution_base yield/suspend/
+# yield_while, fork-join latches — is covered. Each nested help is a
+# full Python call chain (and on the native pool a C->Python callback
+# crossing), so a mass fan-out of tasks that BLOCK (sync remote calls,
+# get() inside tasks) would otherwise nest helping until
+# RecursionError / C-stack overflow (observed: 2000 blocking component
+# calls). At the cap help_one reports "nothing runnable" and waiters
+# park — correct whenever the completion arrives from another thread
+# (parcel IO thread, device watcher, any worker below the cap), which
+# is every legitimate mass-blocking pattern. A PURELY LOCAL serial
+# dependency chain deeper than the cap on a LONE worker is the one
+# pattern this cannot run; it was already within a few frames of
+# crashing the interpreter (~10 stack frames per nested help against
+# the default 1000-frame limit).
+HELP_DEPTH_CAP = 64
+_help_depth = threading.local()
+
+
+def help_depth() -> int:
+    return getattr(_help_depth, "d", 0)
+
+
+def enter_help() -> bool:
+    """True (and one level deeper) when helping may proceed; False at
+    the cap. Pair every True with exit_help() in a finally."""
+    d = help_depth()
+    if d >= HELP_DEPTH_CAP:
+        return False
+    _help_depth.d = d + 1
+    return True
+
+
+def exit_help() -> None:
+    _help_depth.d -= 1
+
+
 def notify_submit(fn_args_pairs) -> None:
     """Fire the 'submit' observer event per task; observers must never
     break submission (shared by both pools' submit/submit_many)."""
@@ -174,12 +211,19 @@ class WorkStealingPool:
 
         Called by futures while a worker waits — keeps the pool making
         progress instead of deadlocking on nested get() (HPX suspension
-        analog)."""
-        wid = getattr(self._tls, "wid", 0)
-        task = self._try_pop(wid % len(self._queues))
-        if task is None:
+        analog). Depth-bounded: at HELP_DEPTH_CAP nested helps this
+        reports False so waiters park instead of overflowing the
+        stack."""
+        if not enter_help():
             return False
-        self._run_task(task)
+        try:
+            wid = getattr(self._tls, "wid", 0)
+            task = self._try_pop(wid % len(self._queues))
+            if task is None:
+                return False
+            self._run_task(task)
+        finally:
+            exit_help()
         return True
 
     def _worker(self, wid: int) -> None:

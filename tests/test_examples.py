@@ -50,6 +50,7 @@ def run_distributed(name, localities, timeout=420):
     ("fft_distributed.py", ["12", "14"]),
     ("pipeline_train.py", ["4"]),
     ("serving_demo.py", []),
+    ("load_balancing.py", []),
 ])
 def test_example_single(name, args):
     r = run_example(name, *args)
@@ -61,6 +62,7 @@ def test_example_single(name, args):
     ("channel_demo.py", 2),
     ("accumulator.py", 2),
     ("1d_stencil_distributed.py", 3),
+    ("load_balancing.py", 3),
 ])
 def test_example_distributed(name, localities):
     r = run_distributed(name, localities)

@@ -243,3 +243,26 @@ class TestManyFanout:
         hpx.post_many(lambda: latch.count_down(1),
                       (() for _ in range(20)))
         latch.arrive_and_wait()
+
+    def test_mass_blocking_fanout_no_stack_overflow(self):
+        """2000 tasks that BLOCK on externally-completed futures: the
+        work-helping chain must stay depth-bounded (HELP_DEPTH_CAP)
+        instead of recursing one Python/C call chain per nested help
+        until stack overflow (regression: RecursionError at ~100)."""
+        import threading
+        import hpx_tpu as hpx
+        from hpx_tpu.futures.future import Future, SharedState
+        n = 2000
+        states = [SharedState() for _ in range(n)]
+
+        def completer():
+            import time
+            time.sleep(0.3)           # let the helpers dive first
+            for st in states:
+                st.set_value(1)
+
+        threading.Thread(target=completer, daemon=True).start()
+        futs = hpx.async_many(
+            lambda i: Future(states[i]).get(timeout=60),
+            [(i,) for i in range(n)])
+        assert sum(f.get(timeout=120) for f in futs) == n
