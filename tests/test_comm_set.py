@@ -110,6 +110,7 @@ def test_multiprocess_comm_set_tree(monkeypatch):
     # observed to exceed even the default 120 s bootstrap window
     # (core/config.py DEFAULTS) — give the table broadcast more room
     monkeypatch.setenv("HPX_TPU_STARTUP_TIMEOUT", "180")
+    monkeypatch.setenv("HPX_TPU_BARRIER_TIMEOUT", "420")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     rc = launch(os.path.join(repo, "tests", "mp_scripts",
                              "comm_set_smoke.py"),
