@@ -62,7 +62,7 @@ def test_example_single(name, args):
     ("channel_demo.py", 2),
     ("accumulator.py", 2),
     ("1d_stencil_distributed.py", 3),
-    ("load_balancing.py", 3),
+    ("load_balancing.py", 2),
 ])
 def test_example_distributed(name, localities):
     r = run_distributed(name, localities)
