@@ -154,9 +154,13 @@ def moe_ffn(x: jax.Array, params: Dict[str, Any], cfg: MoeConfig,
     else:
         ei = expert_in                                 # [E, C, D]
 
-    h = jnp.einsum("ecd,edf->ecf", ei, params["w1"])
+    # expert weights may arrive int8-quantized for serving
+    # (models/quant.QTensor); dequantization happens AT USE so XLA
+    # fuses the convert into the matmul operand read
+    from .quant import dequant
+    h = jnp.einsum("ecd,edf->ecf", ei, dequant(params["w1"], cfg.dtype))
     h = jax.nn.gelu(h + params["b1"][:, None, :])
-    eo = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    eo = jnp.einsum("ecf,efd->ecd", h, dequant(params["w2"], cfg.dtype))
 
     if p > 1:
         eo = eo.reshape(1, e_loc, p * capacity, d)

@@ -13,13 +13,17 @@ dequantize AT USE (`dequant`): under jit, XLA fuses the int8->bf16
 convert + scale multiply into the matmul operand read, so no
 full-precision copy of the weight lives in HBM.
 
-Scope: the DECODE path (models/transformer.generate). Training stays
+Scope: the DECODE path (models/transformer.generate), dense AND MoE
+layers (expert w1/w2 quantize per (expert, output channel); the router
+stays dense — it decides WHICH experts run and is tiny). Training stays
 full precision; the embedding stays dense (it is a gather table and
 the tied loss head's quality anchor). Sharded (dp x tp) decode is
-wired: scales shard WITH their output channels (quantized_param_specs
-— a scale's dim is size 1 exactly on the contracted axes, so its spec
-is the weight's spec with those axes unsharded), and dequantization
-stays shard-local and exact.
+wired FOR DENSE MODELS: scales shard WITH their output channels
+(quantized_param_specs — a scale's dim is size 1 exactly on the
+contracted axes, so its spec is the weight's spec with those axes
+unsharded), and dequantization stays shard-local and exact. MoE
+decodes single-device (generate rejects MoE + mesh regardless of
+quantization — drop-free routing is the serving contract there).
 """
 
 from __future__ import annotations
@@ -49,6 +53,13 @@ class QTensor(NamedTuple):
 _CONTRACT_AXES = {"wqkv": (1,), "wq": (0,), "wkv": (1,),
                   "wo": (0, 1), "w1": (0,), "w2": (0,)}
 
+# MoE expert weights (the einsums in moe.moe_ffn): per-(expert,
+# output-channel) scales — axis 0 is the expert dimension, never a
+# contraction.  w1 [E, d, f] contracts d (axis 1); w2 [E, f, d]
+# contracts f (axis 1). The router wg and b1 stay dense (routing
+# precision decides WHICH experts run; it is tiny and quality-critical).
+_MOE_CONTRACT_AXES = {"w1": (1,), "w2": (1,)}
+
 
 def _quantize(w: jax.Array, axes) -> QTensor:
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
@@ -73,11 +84,14 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     is discovered from the param dict keys.)"""
     out = {"emb": params["emb"], "ln_f": params["ln_f"], "layers": []}
     for lp in params["layers"]:
-        if "moe" in lp:
-            raise NotImplementedError(
-                "quantized MoE serving is not wired; dense layers only")
         qlp = {}
         for name, w in lp.items():
+            if name == "moe":
+                qlp["moe"] = {
+                    mn: (_quantize(mw, _MOE_CONTRACT_AXES[mn])
+                         if mn in _MOE_CONTRACT_AXES else mw)
+                    for mn, mw in w.items()}
+                continue
             axes = _CONTRACT_AXES.get(name)
             qlp[name] = _quantize(w, axes) if axes is not None else w
         out["layers"].append(qlp)
@@ -94,16 +108,26 @@ def quantized_param_specs(cfg) -> Dict[str, Any]:
     shard-local and exact under tensor parallelism."""
     from jax.sharding import PartitionSpec as P
     from .transformer import param_specs
+    def qspec(wspec, axes):
+        dims = list(wspec)
+        for ax in axes:
+            if ax < len(dims):
+                dims[ax] = None
+        return QTensor(q=wspec, s=P(*dims))
+
     specs = param_specs(cfg)
     for lp in specs["layers"]:
         for name, axes in _CONTRACT_AXES.items():
             if name in lp:
-                wspec = lp[name]
-                dims = list(wspec)
-                for ax in axes:
-                    if ax < len(dims):
-                        dims[ax] = None
-                lp[name] = QTensor(q=wspec, s=P(*dims))
+                lp[name] = qspec(lp[name], axes)
+        if "moe" in lp:
+            # param_specs shares ONE moe dict across layers (shallow
+            # per-layer copies) — copy before mutating or every layer
+            # re-wraps the same specs into nested QTensors
+            m = dict(lp["moe"])
+            for mn, axes in _MOE_CONTRACT_AXES.items():
+                m[mn] = qspec(m[mn], axes)
+            lp["moe"] = m
     return specs
 
 

@@ -221,3 +221,24 @@ def test_eight_locality_soak():
                              "eight_locality_smoke.py"),
                 [], localities=8, timeout=900.0)
     assert rc == 0
+
+
+def test_yield_while_mass_blocking_depth_bounded():
+    """yield_while help chains are bounded by the same in-help_one
+    depth cap as future waits (the cap lives in help_one, so every
+    help site is covered)."""
+    import threading
+    import hpx_tpu as hpx
+    n = 600
+    gate = threading.Event()
+
+    def task():
+        hpx.exec.yield_while(lambda: not gate.is_set())
+
+    hpx.post_many(task, [()] * n)
+    import time
+    time.sleep(0.3)                   # let the helpers dive
+    gate.set()
+    latch = hpx.Latch(2)
+    hpx.post(lambda: latch.count_down(1))
+    latch.arrive_and_wait()           # pool still functional afterwards

@@ -786,3 +786,55 @@ class TestQuantizedShardedDecode:
         # w2's contracted f axis is tp-sharded, its scales replicated
         assert tuple(lp["w2"].q.sharding.spec)[0] == "tp"
         assert all(a is None for a in tuple(lp["w2"].s.sharding.spec))
+
+
+class TestQuantizedMoE:
+    """int8 expert weights for MoE serving: w1/w2 quantized per
+    (expert, output channel); router and biases stay dense."""
+
+    MOE_Q_CFG = tfm.TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, head_dim=8, n_layers=2,
+        d_ff=64, n_experts=4, moe_top_k=2, moe_capacity=4.0)
+
+    def test_quantized_moe_decode_matches_dense(self):
+        from hpx_tpu.models import quant
+        params = tfm.init_params(self.MOE_Q_CFG, jax.random.PRNGKey(60))
+        qp = quant.quantize_params(params)
+        lp = qp["layers"][0]["moe"]
+        assert isinstance(lp["w1"], quant.QTensor)
+        assert isinstance(lp["w2"], quant.QTensor)
+        assert not isinstance(lp["wg"], quant.QTensor)   # router dense
+        prompt = jnp.array([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+        dense = tfm.generate(params, self.MOE_Q_CFG, prompt, max_new=6)
+        q = tfm.generate(qp, self.MOE_Q_CFG, prompt, max_new=6)
+        # int8 rounding can flip a rare near-tie; anything below high
+        # agreement means the scales are wrong
+        agree = float((np.asarray(q) == np.asarray(dense)).mean())
+        assert agree >= 0.9, agree
+        assert q.shape == dense.shape
+
+    def test_expert_weight_roundtrip_error_bounded(self):
+        from hpx_tpu.models import quant
+        params = tfm.init_params(self.MOE_Q_CFG, jax.random.PRNGKey(61))
+        qp = quant.quantize_params(params)
+        for name in ("w1", "w2"):
+            w = np.asarray(params["layers"][0]["moe"][name], np.float32)
+            wq = np.asarray(quant.dequant(
+                qp["layers"][0]["moe"][name], jnp.float32))
+            rel = np.linalg.norm(w - wq) / np.linalg.norm(w)
+            assert rel < 0.01, (name, rel)
+
+    def test_quantized_moe_specs_tree_matches(self):
+        from jax.sharding import PartitionSpec
+        from hpx_tpu.models import quant
+        params = tfm.init_params(self.MOE_Q_CFG, jax.random.PRNGKey(62))
+        qp = quant.quantize_params(params)
+        specs = quant.quantized_param_specs(self.MOE_Q_CFG)
+        # STRUCTURE equality (tree.map alone flattens specs only up to
+        # qp's structure and would accept nested garbage), and every
+        # spec leaf is an actual PartitionSpec — catches the
+        # shared-moe-dict double-wrap regression
+        assert (jax.tree.structure(qp)
+                == jax.tree.structure(specs)), "tree mismatch"
+        for leaf in jax.tree.leaves(specs):
+            assert isinstance(leaf, PartitionSpec), leaf
