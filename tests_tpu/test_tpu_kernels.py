@@ -318,3 +318,32 @@ class TestServingOnChip:
                               jnp.array([[1, 2, 3]], jnp.int32),
                               max_new=6, beam_width=4)
         assert out.shape == (1, 6)
+
+
+class TestTunedBlocks:
+    """Whatever block sizes resolve_blocks picks (tuned table, env, or
+    default) must Mosaic-compile and agree with the XLA oracle — run
+    after benchmarks/flash_tune.py writes a table to catch a tuned
+    shape that compiles differently than it benched."""
+
+    def test_resolved_blocks_compile_and_match(self):
+        import functools
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from hpx_tpu.ops.attention import blockwise_attention
+        from hpx_tpu.ops.attention_pallas import (flash_attention,
+                                                  resolve_blocks)
+        B, S, N, H = 1, 2048, 4, 128
+        bq, bk = resolve_blocks(S, S, True)
+        rng = np.random.default_rng(0)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.standard_normal((B, S, N, H), np.float32), jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+        got = jax.jit(functools.partial(flash_attention, causal=True))(
+            q, k, v)
+        want = blockwise_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            atol=3e-2, rtol=3e-2)
+        assert bq >= 8 and bk >= 8
