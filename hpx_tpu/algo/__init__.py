@@ -57,6 +57,7 @@ adjacent_find = _seg(_sc.adjacent_find)
 # -- sorting / permutations --------------------------------------------------
 sort = _seg(_so.sort, preserves_shape=True)
 sort_sharded = _so.sort_sharded        # explicit distributed surface
+sort_sharded_by_key = _so.sort_sharded_by_key
 stable_sort = _seg(_so.stable_sort, preserves_shape=True)
 is_sorted = _seg(_so.is_sorted)
 merge = _seg(_so.merge)
@@ -80,6 +81,6 @@ __all__ = [
     "minmax_element", "equal", "mismatch", "find", "find_if",
     "inclusive_scan", "exclusive_scan", "transform_inclusive_scan",
     "transform_exclusive_scan", "adjacent_difference", "adjacent_find",
-    "sort", "sort_sharded", "stable_sort", "is_sorted", "merge",
+    "sort", "sort_sharded", "sort_sharded_by_key", "stable_sort", "is_sorted", "merge",
     "reverse", "rotate", "unique", "partition",
 ]

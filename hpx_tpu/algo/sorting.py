@@ -24,6 +24,13 @@ from ._core import (
 
 _SHARDED_SORT_PROGRAMS: dict = {}
 
+# jitted per-element key programs, weakly keyed by the user's key
+# function so repeated sorts with the same (named) key reuse one
+# executable; inline lambdas are new objects per call and simply miss
+import weakref
+
+_KEY_PROGRAMS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 
 def _sharded_axis(a) -> Optional[tuple]:
     """(mesh, axis) when `a` is a jax.Array sharded in contiguous
@@ -122,7 +129,25 @@ def _sort_key_fns(dt):
     return to_key, from_key, ui
 
 
-def _build_sample_sort(mesh, axis: str):
+def _transport_fns(dt):
+    """(encode, decode, wire_dtype): lossless BIT transport of any
+    fixed-width dtype as unsigned ints (the by-key payload path — the
+    payload is moved, never compared; integer wire format keeps the
+    final zero-identity sum-scatter exact)."""
+    import jax
+    import jax.numpy as jnp
+    if dt == jnp.bool_:
+        return (lambda x: x.astype(jnp.uint8)), \
+               (lambda u: u.astype(jnp.bool_)), jnp.dtype(jnp.uint8)
+    nbits = jnp.dtype(dt).itemsize * 8
+    ui = jnp.dtype(f"uint{nbits}")
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        return (lambda x: x), (lambda u: u), jnp.dtype(dt)
+    return (lambda x: jax.lax.bitcast_convert_type(x, ui)), \
+           (lambda u: jax.lax.bitcast_convert_type(u, dt)), ui
+
+
+def _build_sample_sort(mesh, axis: str, with_payload: bool = False):
     """One-shot sample sort (PSRS — parallel sorting by regular
     sampling): local sort → rank-stripe all_to_all → regular-sample
     splitters via all_gather → ONE bucket all_to_all → local merge →
@@ -159,6 +184,13 @@ def _build_sample_sort(mesh, axis: str):
     id, which for distributed duplicates is original-position order —
     but the public contract stays "unstable"; stable_sort keeps the
     XLA path). NaN payloads collapse to one canonical NaN.
+
+    with_payload=True builds the BY-KEY variant: the program takes
+    (keys, values) and returns values reordered by ascending key. The
+    payload rides every exchange under the same permutations (as its
+    own total-order-key transport, so the sum-scatter trick still
+    works), and the gid tiebreak makes this one STABLE — equal keys
+    keep original global order.
     """
     import jax
     import jax.numpy as jnp
@@ -167,7 +199,7 @@ def _build_sample_sort(mesh, axis: str):
 
     p = mesh.shape[axis]
 
-    def body(chunk):
+    def body(chunk, payload=None):
         m = chunk.shape[0]
         n = m * p
         to_key, from_key, kdt = _sort_key_fns(chunk.dtype)
@@ -192,30 +224,44 @@ def _build_sample_sort(mesh, axis: str):
         # at the very scale the int64 path exists for
         gid = i.astype(idt) * m + jnp.arange(m, dtype=idt)
         v = to_key(chunk)              # total-order integer keys
+        if payload is not None:
+            # plain BIT transport (never compared): lossless for any
+            # fixed-width dtype incl. NaN payload bits, and integer so
+            # the final zero-identity sum-scatter stays exact
+            to_pk, from_pk, pdt = _transport_fns(payload.dtype)
+            w = to_pk(payload)
         if pad:
             v = jnp.concatenate([v, jnp.full((pad,), kmax, kdt)])
             gid = jnp.concatenate(
                 [gid, jnp.asarray(n, idt) + i.astype(idt) * pad
                  + jnp.arange(pad, dtype=idt)])
+            if payload is not None:
+                w = jnp.concatenate([w, jnp.zeros((pad,), pdt)])
 
-        def lexsorted(vv, gg):
-            order = jnp.lexsort((gg, vv))
-            return vv[order], gg[order]
+        a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
+                                split_axis=0, concat_axis=0, tiled=True)
+
+        def stripe(arr):
+            return a2a(arr.reshape(mp_, p).T.reshape(p, mp_)).reshape(M)
 
         # ---- phase A: local sort + rank stripe (balances bucket
         # composition across sources; per-pair volume exactly M/p)
-        v, gid = lexsorted(v, gid)
-        a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
-                                split_axis=0, concat_axis=0, tiled=True)
-        v = a2a(v.reshape(mp_, p).T.reshape(p, mp_)).reshape(M)
-        gid = a2a(gid.reshape(mp_, p).T.reshape(p, mp_)).reshape(M)
-        v, gid = lexsorted(v, gid)
+        order = jnp.lexsort((gid, v))
+        v, gid = v[order], gid[order]
+        if payload is not None:
+            w = stripe(w[order])
+        v, gid = stripe(v), stripe(gid)
+        order = jnp.lexsort((gid, v))
+        v, gid = v[order], gid[order]
+        if payload is not None:
+            w = w[order]
 
         # ---- phase B: p regular samples/device -> p^2 gathered ->
         # splitters at every p-th (p-1 of them)
         sv = jax.lax.all_gather(v[0::mp_][:p], axis).reshape(-1)
         sg = jax.lax.all_gather(gid[0::mp_][:p], axis).reshape(-1)
-        sv, sg = lexsorted(sv, sg)
+        sorder = jnp.lexsort((sg, sv))
+        sv, sg = sv[sorder], sg[sorder]
         sv, sg = sv[p::p][:p - 1], sg[p::p][:p - 1]
 
         # ---- phase C: bucket by splitter count (lexicographic), ONE
@@ -234,12 +280,18 @@ def _build_sample_sort(mesh, axis: str):
         rv = a2a(bv).reshape(-1)
         rg = a2a(bg).reshape(-1)
         rc = a2a(counts.reshape(p, 1)).reshape(p)          # per-src counts
+        if payload is not None:
+            bw = jnp.zeros((p, cap), pdt).at[dest, off].set(
+                w, mode="drop")
+            rw = a2a(bw).reshape(-1)
 
         # ---- local merge of my bucket (invalid slots sort last)
         invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
                    >= rc[:, None]).reshape(-1)
         order = jnp.lexsort((rg, rv, invalid))
-        rv, rg, invalid = rv[order], rg[order], invalid[order]
+        rv, rg = rv[order], rg[order]
+        if payload is not None:
+            rw = rw[order]
         b_mine = rc.sum()
 
         # ---- phase D: exact global rank -> (device, slot) scatter.
@@ -252,10 +304,17 @@ def _build_sample_sort(mesh, axis: str):
         grank = base + pos
         d2 = jnp.where((pos < b_mine) & (grank < n), grank // m, p)
         o2 = grank % m
-        out = jnp.zeros((p, m), kdt).at[d2, o2].set(rv, mode="drop")
         # exactly one source owns each global rank, empty slots are 0
-        return from_key(a2a(out).sum(axis=0))
+        if payload is None:
+            out = jnp.zeros((p, m), kdt).at[d2, o2].set(rv, mode="drop")
+            return from_key(a2a(out).sum(axis=0))
+        pout = jnp.zeros((p, m), pdt).at[d2, o2].set(rw, mode="drop")
+        return from_pk(a2a(pout).sum(axis=0))
 
+    if with_payload:
+        return jax.jit(shard_map(body, mesh=mesh,
+                                 in_specs=(P(axis), P(axis)),
+                                 out_specs=P(axis)))
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axis),),
                              out_specs=P(axis)))
 
@@ -290,25 +349,57 @@ def sort_sharded(v: Any, mesh, axis: str = "x",
     return prog(v)
 
 
+def sort_sharded_by_key(keys: Any, values: Any, mesh,
+                        axis: str = "x") -> Any:
+    """Reorder a sharded 1-D `values` by ascending sharded `keys`
+    WITHOUT gathering — the PSRS sample sort with the values riding
+    every exchange as payload (lossless bit transport — payload NaN
+    bit patterns survive). STABLE: the global-id tiebreak preserves
+    original order for equal keys."""
+    key_ = ("sample_by_key", mesh, axis)
+    prog = _SHARDED_SORT_PROGRAMS.get(key_)
+    if prog is None:
+        prog = _SHARDED_SORT_PROGRAMS[key_] = _build_sample_sort(
+            mesh, axis, with_payload=True)
+    return prog(keys, values)
+
+
 def sort(policy: ExecutionPolicy, rng: Any,
          key: Optional[Callable] = None) -> Any:
     """Returns the sorted range. `key` maps elements to sort keys
     (HPX's comparator generalized to the key form jax supports).
-    A range sharded over a 1-D mesh sorts DISTRIBUTED (sort_sharded:
-    merge-exchange over ppermute; the segmented-algorithms sort)."""
+    A range sharded over a 1-D mesh sorts DISTRIBUTED — with or
+    without a key — through the segmented-algorithms sort
+    (sort_sharded / sort_sharded_by_key: no gather, O(1) collective
+    steps on the sample path)."""
     if is_device_policy(policy, rng):
         import jax
         import jax.numpy as jnp
         ex = device_executor(policy)
 
-        sharded = key is None and _sharded_axis(rng)
+        sharded = _sharded_axis(rng)
         if sharded:
             mesh, axis = sharded
-            fut = ex.async_execute_raw(
-                lambda a: sort_sharded(a, mesh, axis), rng) \
+            if key is None:
+                dispatch = lambda a: sort_sharded(a, mesh, axis)  # noqa: E731
+            else:
+                kp = _KEY_PROGRAMS.get(key)
+                if kp is None:
+                    kp = jax.jit(jax.vmap(key))
+                    try:
+                        _KEY_PROGRAMS[key] = kp
+                    except TypeError:
+                        pass
+
+                def dispatch(a, kp=kp):
+                    # keys computed shard-locally (elementwise vmap
+                    # keeps the input's sharding), then the by-key
+                    # program reorders the values — stable, like the
+                    # single-device stable-argsort path below
+                    return sort_sharded_by_key(kp(a), a, mesh, axis)
+            fut = ex.async_execute_raw(dispatch, rng) \
                 if hasattr(ex, "async_execute_raw") else \
-                ex.async_execute(lambda a: sort_sharded(a, mesh, axis),
-                                 rng)
+                ex.async_execute(dispatch, rng)
             return fut if policy.is_task else fut.get()
 
         def kernel(a):

@@ -186,3 +186,66 @@ def test_algo_sort_with_key_still_works(devices):
     out = sort(par, v, key=lambda x: -x)        # descending via key
     np.testing.assert_allclose(np.asarray(out),
                                np.sort(np.asarray(v))[::-1], rtol=1e-6)
+
+
+class TestSortByKey:
+    """Distributed by-key sort: values ride the PSRS exchanges as
+    payload; STABLE via the global-id tiebreak."""
+
+    def test_matches_numpy_stable_argsort(self, devices):
+        from hpx_tpu.algo.sorting import sort_sharded_by_key
+        mesh = _mesh(devices, 8)
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, 10, 512).astype(np.int32)   # many ties
+        vals = np.arange(512, dtype=np.float32)            # identity
+        got = np.asarray(sort_sharded_by_key(
+            _put(keys, mesh), _put(vals, mesh), mesh))
+        want = vals[np.argsort(keys, kind="stable")]
+        np.testing.assert_array_equal(got, want)           # stability!
+
+    @pytest.mark.parametrize("p,n", [(8, 72), (5, 200), (6, 42)])
+    def test_ragged_and_float_keys(self, devices, p, n):
+        from hpx_tpu.algo.sorting import sort_sharded_by_key
+        mesh = _mesh(devices, p)
+        rng = np.random.default_rng(n)
+        keys = rng.standard_normal(n).astype(np.float32)
+        vals = rng.integers(0, 1000, n).astype(np.int32)
+        got = np.asarray(sort_sharded_by_key(
+            _put(keys, mesh), _put(vals, mesh), mesh))
+        np.testing.assert_array_equal(
+            got, vals[np.argsort(keys, kind="stable")])
+
+    def test_public_sort_with_key_on_sharded(self, devices):
+        """algo.sort(par, sharded, key=...) now sorts distributed (it
+        previously fell back to the gather path)."""
+        from hpx_tpu.algo import sort
+        from hpx_tpu.exec.policies import par
+        mesh = _mesh(devices, 8)
+        rng = np.random.default_rng(9)
+        v = rng.standard_normal(256).astype(np.float32)
+        out = sort(par, _put(v, mesh), key=lambda x: -x)   # descending
+        np.testing.assert_allclose(np.asarray(out), np.sort(v)[::-1],
+                                   rtol=1e-6)
+
+    def test_bool_payload(self, devices):
+        from hpx_tpu.algo.sorting import sort_sharded_by_key
+        mesh = _mesh(devices, 8)
+        keys = np.arange(64, dtype=np.int32)[::-1].copy()
+        vals = (np.arange(64) % 2 == 0)
+        got = np.asarray(sort_sharded_by_key(
+            _put(keys, mesh), _put(vals, mesh), mesh))
+        np.testing.assert_array_equal(got, vals[::-1])
+
+    def test_payload_nan_bits_survive(self, devices):
+        """Payload is bit transport, not ordering: NaN payload values
+        survive byte-exactly."""
+        from hpx_tpu.algo.sorting import sort_sharded_by_key
+        mesh = _mesh(devices, 8)
+        keys = np.arange(64, dtype=np.int32)[::-1].copy()
+        vals = np.full(64, np.nan, np.float32)
+        vals[::3] = 7.5
+        got = np.asarray(sort_sharded_by_key(
+            _put(keys, mesh), _put(vals, mesh), mesh))
+        want = vals[::-1]
+        np.testing.assert_array_equal(
+            got.view(np.uint32), want.view(np.uint32))   # bit-exact
