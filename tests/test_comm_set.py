@@ -112,7 +112,10 @@ def test_multiprocess_comm_set_tree(monkeypatch):
     monkeypatch.setenv("HPX_TPU_STARTUP_TIMEOUT", "180")
     monkeypatch.setenv("HPX_TPU_BARRIER_TIMEOUT", "420")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    rc = launch(os.path.join(repo, "tests", "mp_scripts",
-                             "comm_set_smoke.py"),
-                [], localities=7, timeout=420.0)
+    script = os.path.join(repo, "tests", "mp_scripts",
+                          "comm_set_smoke.py")
+    rc = launch(script, [], localities=7, timeout=420.0)
+    if rc != 0:
+        # contention retry — see test_multiprocess_binpacking's note
+        rc = launch(script, [], localities=7, timeout=420.0)
     assert rc == 0
