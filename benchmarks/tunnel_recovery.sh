@@ -1,0 +1,32 @@
+#!/bin/bash
+# One-shot measurement sequence for when the axon TPU tunnel recovers
+# (round-5 plan: BASELINE.md "Round-5 status"). Run from the repo root.
+#
+#   1. bench.py             — full metric set incl. the new
+#                             flash_attention_bwd_tflops and copy_ratio;
+#                             writes bench_fallback.local.json
+#   2. flash_tune --quick   — 2k/4k block sweep -> flash_blocks.json
+#   3. bench.py (again)     — flash forward re-measured with tuned tiles
+#
+# Artifacts land in benchmarks/recovery_*.log; commit flash_blocks.json
+# with `git add -f hpx_tpu/ops/flash_blocks.json` if the tuned table
+# beats the 1024x1024 default.
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%dT%H%M%S)
+
+echo "== probe =="
+if ! timeout 120 python -c "import jax; print(jax.devices())"; then
+    echo "tunnel still down"; exit 1
+fi
+
+echo "== bench (pre-tune) ==" | tee "benchmarks/recovery_${ts}.log"
+HPX_BENCH_PROBE_BUDGET=300 python bench.py 2>&1 | tee -a "benchmarks/recovery_${ts}.log"
+
+echo "== flash tune (quick) ==" | tee -a "benchmarks/recovery_${ts}.log"
+timeout 1800 python benchmarks/flash_tune.py --quick 2>&1 | tee -a "benchmarks/recovery_${ts}.log"
+
+echo "== bench (post-tune) ==" | tee -a "benchmarks/recovery_${ts}.log"
+HPX_BENCH_PROBE_BUDGET=300 python bench.py 2>&1 | tee -a "benchmarks/recovery_${ts}.log"
+
+echo "done: benchmarks/recovery_${ts}.log"
