@@ -27,6 +27,10 @@ fill = _seg(_ew.fill, preserves_shape=True)
 fill_n = _seg(_ew.fill_n)
 generate = _seg(_ew.generate, preserves_shape=True)
 generate_n = _seg(_ew.generate_n)
+remove = _seg(_ew.remove)
+remove_if = _seg(_ew.remove_if)
+replace = _seg(_ew.replace, preserves_shape=True)
+replace_if = _seg(_ew.replace_if, preserves_shape=True)
 
 # -- reductions / searches (scalar results) ----------------------------------
 reduce = _seg(_red.reduce)
@@ -43,6 +47,10 @@ equal = _seg(_red.equal)
 mismatch = _seg(_red.mismatch)
 find = _seg(_red.find)
 find_if = _seg(_red.find_if)
+find_first_of = _seg(_red.find_first_of)
+is_sorted_until = _seg(_red.is_sorted_until)
+is_partitioned = _seg(_red.is_partitioned)
+lexicographical_compare = _seg(_red.lexicographical_compare)
 
 # -- scans (shape-preserving) ------------------------------------------------
 inclusive_scan = _seg(_sc.inclusive_scan, preserves_shape=True)
@@ -79,6 +87,9 @@ __all__ = [
     "reduce", "transform_reduce", "count", "count_if",
     "all_of", "any_of", "none_of", "min_element", "max_element",
     "minmax_element", "equal", "mismatch", "find", "find_if",
+    "find_first_of", "is_sorted_until", "is_partitioned",
+    "lexicographical_compare", "remove", "remove_if", "replace",
+    "replace_if",
     "inclusive_scan", "exclusive_scan", "transform_inclusive_scan",
     "transform_exclusive_scan", "adjacent_difference", "adjacent_find",
     "sort", "sort_sharded", "sort_sharded_by_key", "stable_sort", "is_sorted", "merge",

@@ -326,3 +326,51 @@ def for_loop(policy: ExecutionPolicy, first: int, last: int,
         return results
 
     return finish(policy, run)
+
+
+def remove_if(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
+    """std::remove_if semantics, shrunk: elements NOT satisfying pred,
+    order preserved (the complement of copy_if; size is data-dependent,
+    so the device path compacts at the host boundary like copy_if)."""
+    if is_device_policy(policy, rng):
+        return copy_if(policy, rng, lambda x: ~pred(x))   # traced bool
+    return copy_if(policy, rng, lambda x: not pred(x))
+
+
+def remove(policy: ExecutionPolicy, rng: Any, value: Any) -> Any:
+    """std::remove semantics, shrunk."""
+    return remove_if(policy, rng, lambda x: x == value)
+
+
+def replace_if(policy: ExecutionPolicy, rng: Any, pred: Callable,
+               new_value: Any) -> Any:
+    """Elements satisfying pred become new_value (shape-preserving —
+    on device one fused where)."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(
+            lambda a: jnp.where(jax.vmap(pred)(a.reshape(-1)).reshape(
+                a.shape), jnp.asarray(new_value, a.dtype), a), rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def run():
+        # in place, like fill/for_each (the module's host convention
+        # and std::replace_if's semantics)
+        parts = host_bulk(
+            policy, len(arr),
+            lambda b, e: [(i, bool(pred(arr[i]))) for i in range(b, e)])
+        for part in parts:
+            for i, hit in part:
+                if hit:
+                    arr[i] = new_value
+        return arr
+
+    return finish(policy, run)
+
+
+def replace(policy: ExecutionPolicy, rng: Any, old_value: Any,
+            new_value: Any) -> Any:
+    return replace_if(policy, rng, lambda x: x == old_value, new_value)

@@ -312,3 +312,136 @@ def find_if(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
         return -1
 
     return finish(policy, run)
+
+
+def is_sorted_until(policy: ExecutionPolicy, rng: Any) -> Any:
+    """Index of the first element breaking ascending order (the
+    std::is_sorted_until iterator as an index), or len(rng) if sorted."""
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            f = a.reshape(-1)
+            if f.shape[0] <= 1:        # static shape: nothing to break
+                return jnp.asarray(f.shape[0])
+            bad = f[1:] < f[:-1]
+            return jnp.where(bad.any(), jnp.argmax(bad) + 1, f.shape[0])
+        fut = ex.async_execute(kernel, rng)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        if len(arr) <= 1:
+            return len(arr)
+        bad = np.flatnonzero(arr[1:] < arr[:-1])
+        return int(bad[0]) + 1 if bad.size else len(arr)
+
+    return finish(policy, run)
+
+
+def is_partitioned(policy: ExecutionPolicy, rng: Any,
+                   pred: Callable) -> Any:
+    """True when every pred-satisfying element precedes every
+    non-satisfying one (std::is_partitioned)."""
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            m = jax.vmap(pred)(a.reshape(-1))
+            # partitioned <=> mask is non-increasing
+            return (m[1:].astype(jnp.int8)
+                    <= m[:-1].astype(jnp.int8)).all()
+        fut = ex.async_execute(kernel, rng)
+        if policy.is_task:
+            return fut.then(lambda f: bool(f.get()))
+        return bool(fut.get())
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        parts = host_bulk(
+            policy, len(arr),
+            lambda b, e: [bool(pred(arr[i])) for i in range(b, e)])
+        mask = np.array([m for part in parts for m in part], dtype=bool)
+        if mask.size <= 1:
+            return True
+        # partitioned <=> mask is non-increasing
+        return bool((mask[1:].astype(np.int8)
+                     <= mask[:-1].astype(np.int8)).all())
+
+    return finish(policy, run)
+
+
+def lexicographical_compare(policy: ExecutionPolicy, rng: Any,
+                            rng2: Any) -> Any:
+    """True when rng compares lexicographically LESS than rng2."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a, b):
+            fa, fb = a.reshape(-1), b.reshape(-1)
+            n = min(fa.shape[0], fb.shape[0])
+            if n == 0:                 # static: empty prefix — length
+                return jnp.asarray(fa.shape[0] < fb.shape[0])  # decides
+            lt = fa[:n] < fb[:n]
+            ne = fa[:n] != fb[:n]
+            first = jnp.where(ne.any(), jnp.argmax(ne), n)
+            in_prefix = first < n
+            # differ inside the common prefix: that position decides;
+            # else the shorter range is the lesser
+            return jnp.where(in_prefix,
+                             lt[jnp.minimum(first, n - 1)],
+                             fa.shape[0] < fb.shape[0])
+        fut = ex.async_execute(kernel, rng, rng2)
+        if policy.is_task:
+            return fut.then(lambda f: bool(f.get()))
+        return bool(fut.get())
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        n = min(len(a), len(b))
+        if n:
+            ne = np.flatnonzero(a[:n] != b[:n])
+            if ne.size:
+                i = int(ne[0])
+                return bool(a[i] < b[i])
+        return len(a) < len(b)
+
+    return finish(policy, run)
+
+
+def find_first_of(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Index of the first element of rng that equals ANY element of
+    rng2, or -1 (std::find_first_of)."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a, b):
+            fa, fb = a.reshape(-1), b.reshape(-1)
+            if fa.shape[0] == 0 or fb.shape[0] == 0:   # static shapes
+                return jnp.asarray(-1)
+            m = (fa[:, None] == fb[None, :]).any(axis=1)
+            return jnp.where(m.any(), jnp.argmax(m), -1)
+        fut = ex.async_execute(kernel, rng, rng2)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        if len(a) == 0 or len(b) == 0:
+            return -1
+        hits = np.flatnonzero(np.isin(a, b))
+        return int(hits[0]) if hits.size else -1
+
+    return finish(policy, run)

@@ -373,3 +373,110 @@ def test_for_loop_bad_clause_raises():
     import pytest as _pt
     with _pt.raises(hpx.HpxError):
         hpx.for_loop(hpx.par, 0, 3, lambda i: i, "not-a-clause")
+
+
+# -- round-5 std additions ---------------------------------------------------
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_remove_and_remove_if(pol_idx):
+    from hpx_tpu.algo import remove, remove_if
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    data = mk(np.array([3, 1, 3, 4, 3, 5], np.int32))
+    out = asnp(unwrap(remove(pol, data, 3)))
+    np.testing.assert_array_equal(out, [1, 4, 5])
+    out2 = asnp(unwrap(remove_if(pol, data, lambda x: x > 3)))
+    np.testing.assert_array_equal(out2, [3, 1, 3, 3])
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_replace_and_replace_if(pol_idx):
+    from hpx_tpu.algo import replace, replace_if
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    # fresh array per call: the host path mutates in place (std
+    # semantics, like fill/for_each)
+    np.testing.assert_array_equal(
+        asnp(unwrap(replace(pol, mk(np.array([3, 1, 3, 4], np.int32)),
+                            3, 9))), [9, 1, 9, 4])
+    np.testing.assert_array_equal(
+        asnp(unwrap(replace_if(pol, mk(np.array([3, 1, 3, 4], np.int32)),
+                               lambda x: x < 3, 0))),
+        [3, 0, 3, 4])
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_is_sorted_until_and_is_partitioned(pol_idx):
+    from hpx_tpu.algo import is_partitioned, is_sorted_until
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    assert unwrap(is_sorted_until(pol, mk(
+        np.array([1, 2, 5, 3, 4], np.int32)))) == 3
+    assert unwrap(is_sorted_until(pol, mk(
+        np.array([1, 2, 3], np.int32)))) == 3
+    assert unwrap(is_partitioned(
+        pol, mk(np.array([2, 4, 1, 3], np.int32)),
+        lambda x: x % 2 == 0)) is True
+    assert unwrap(is_partitioned(
+        pol, mk(np.array([2, 1, 4], np.int32)),
+        lambda x: x % 2 == 0)) is False
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_lexicographical_compare(pol_idx):
+    from hpx_tpu.algo import lexicographical_compare as lc
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    assert unwrap(lc(pol, mk(np.array([1, 2, 3])),
+                     mk(np.array([1, 2, 4])))) is True
+    assert unwrap(lc(pol, mk(np.array([1, 2, 4])),
+                     mk(np.array([1, 2, 3])))) is False
+    # equal prefix: the shorter range is the lesser
+    assert unwrap(lc(pol, mk(np.array([1, 2])),
+                     mk(np.array([1, 2, 0])))) is True
+    assert unwrap(lc(pol, mk(np.array([1, 2])),
+                     mk(np.array([1, 2])))) is False
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_find_first_of(pol_idx):
+    from hpx_tpu.algo import find_first_of
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    a = mk(np.array([7, 8, 2, 9], np.int32))
+    assert unwrap(find_first_of(pol, a, mk(np.array([9, 2])))) == 2
+    assert unwrap(find_first_of(pol, a, mk(np.array([5, 6])))) == -1
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_new_queries_empty_and_single(pol_idx):
+    """Edge shapes: empty and single-element ranges (static-shape
+    guards in the device kernels — review regression)."""
+    from hpx_tpu.algo import (find_first_of, is_sorted_until,
+                              lexicographical_compare)
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    e = mk(np.array([], np.int32))
+    one = mk(np.array([7], np.int32))
+    assert unwrap(is_sorted_until(pol, e)) == 0
+    assert unwrap(is_sorted_until(pol, one)) == 1
+    assert unwrap(lexicographical_compare(pol, e, one)) is True
+    assert unwrap(lexicographical_compare(pol, one, e)) is False
+    assert unwrap(lexicographical_compare(pol, e, e)) is False
+    assert unwrap(find_first_of(pol, e, one)) == -1
+    assert unwrap(find_first_of(pol, one, e)) == -1
+
+
+def test_replace_if_mutates_host_array_in_place():
+    import hpx_tpu as hpx
+    from hpx_tpu.algo import replace_if
+    a = np.array([1, 2, 3, 4], np.int32)
+    out = replace_if(hpx.seq, a, lambda x: x % 2 == 0, 0)
+    np.testing.assert_array_equal(a, [1, 0, 3, 0])   # in place
+    assert out is a
