@@ -161,6 +161,37 @@ def bench_fft(ndev: int, devices) -> None:
           gflops=round(gflops, 2), ms=round(per * 1e3, 3))
 
 
+def bench_sort(ndev: int, devices) -> None:
+    """Distributed PSRS sample sort (weak scaling at 2^17 elems/device):
+    collective-step count is constant in mesh size, so per-op time
+    should stay flat as devices grow — the curve this table exists to
+    show. The sample path is FORCED at every ndev (not the p<=4
+    odd-even default) so the measured program is the pod-scale one."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hpx_tpu.algo.sorting import sort_sharded
+    from hpx_tpu.parallel import make_mesh
+
+    mesh = make_mesh((ndev,), ("x",), devices[:ndev])
+    n = ndev * (1 << 17)
+    rng = np.random.default_rng(2)
+    v = jax.device_put(
+        jnp.asarray(rng.standard_normal(n).astype(np.float32)),
+        NamedSharding(mesh, P("x")))
+    method = "sample" if ndev > 1 else None
+
+    def run():
+        return (sort_sharded(v, mesh, method=method) if ndev > 1
+                else jnp.sort(v))
+
+    per = _time_loop(run, iters=5)
+    _emit(metric="sort_sample", n_devices=ndev, elements=n,
+          melem_s=round(n / per / 1e6, 2), ms=round(per * 1e3, 3))
+
+
 def sweep(max_devices: int) -> None:
     import jax
     devs = jax.devices()
@@ -182,6 +213,7 @@ def sweep(max_devices: int) -> None:
         bench_all_reduce(k, devs)
         bench_jacobi(k, devs)
         bench_fft(k, devs)
+        bench_sort(k, devs)
 
 
 if __name__ == "__main__":
