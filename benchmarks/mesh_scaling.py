@@ -181,15 +181,18 @@ def bench_sort(ndev: int, devices) -> None:
     v = jax.device_put(
         jnp.asarray(rng.standard_normal(n).astype(np.float32)),
         NamedSharding(mesh, P("x")))
-    method = "sample" if ndev > 1 else None
-
-    def run():
-        return (sort_sharded(v, mesh, method=method) if ndev > 1
-                else jnp.sort(v))
+    if ndev > 1:
+        run = lambda: sort_sharded(v, mesh, method="sample")  # noqa: E731
+        method = "sample"
+    else:
+        run = lambda: jnp.sort(v)  # noqa: E731 — 1-dev reference program
+        method = "jnp.sort"
 
     per = _time_loop(run, iters=5)
     _emit(metric="sort_sample", n_devices=ndev, elements=n,
-          melem_s=round(n / per / 1e6, 2), ms=round(per * 1e3, 3))
+          method=method,                     # self-describing: the
+          melem_s=round(n / per / 1e6, 2),   # 1-dev row is a DIFFERENT
+          ms=round(per * 1e3, 3))            # program (local reference)
 
 
 def sweep(max_devices: int) -> None:
