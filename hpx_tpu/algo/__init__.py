@@ -12,6 +12,7 @@ from . import elementwise as _ew
 from . import fft  # noqa: F401  (sharded-array surface, not a CPO)
 from . import reductions as _red
 from . import scans as _sc
+from . import setops as _set
 from . import sorting as _so
 from .segmented import segmentable as _seg
 
@@ -51,6 +52,20 @@ find_first_of = _seg(_red.find_first_of)
 is_sorted_until = _seg(_red.is_sorted_until)
 is_partitioned = _seg(_red.is_partitioned)
 lexicographical_compare = _seg(_red.lexicographical_compare)
+search = _seg(_red.search)
+search_n = _seg(_red.search_n)
+find_end = _seg(_red.find_end)
+contains = _seg(_red.contains)
+contains_subrange = _seg(_red.contains_subrange)
+starts_with = _seg(_red.starts_with)
+ends_with = _seg(_red.ends_with)
+
+# -- set operations on sorted ranges (data-dependent output sizes) -----------
+set_union = _seg(_set.set_union)
+set_intersection = _seg(_set.set_intersection)
+set_difference = _seg(_set.set_difference)
+set_symmetric_difference = _seg(_set.set_symmetric_difference)
+includes = _seg(_set.includes)
 
 # -- scans (shape-preserving) ------------------------------------------------
 inclusive_scan = _seg(_sc.inclusive_scan, preserves_shape=True)
@@ -73,6 +88,26 @@ reverse = _seg(_so.reverse, preserves_shape=True)
 rotate = _seg(_so.rotate, preserves_shape=True)
 unique = _seg(_so.unique)
 partition = _seg(_so.partition)
+partition_copy = _seg(_so.partition_copy)
+partial_sort = _seg(_so.partial_sort, preserves_shape=True)
+partial_sort_copy = _seg(_so.partial_sort_copy)
+nth_element = _seg(_so.nth_element, preserves_shape=True)
+shift_left = _seg(_so.shift_left, preserves_shape=True)
+shift_right = _seg(_so.shift_right, preserves_shape=True)
+swap_ranges = _so.swap_ranges          # pair-valued: no segmented overlay
+
+# functional-data-model aliases: where the target already returns a NEW
+# range (remove/unique compact, copy copies) the *_copy variant IS the
+# in-place sibling, and std::move degenerates to copy. replace/replace_if
+# mutate on the host path (std semantics), so their _copy variants are
+# real copy-first wrappers (hpx/parallel/algorithms/{unique,remove_copy,
+# replace_copy,move}.hpp surface).
+unique_copy = unique
+remove_copy = remove
+remove_copy_if = remove_if
+replace_copy = _seg(_ew.replace_copy, preserves_shape=True)
+replace_copy_if = _seg(_ew.replace_copy_if, preserves_shape=True)
+move = copy
 
 # for_loop clause objects (hpx::experimental::induction/reduction)
 induction = _ew.induction
@@ -94,4 +129,12 @@ __all__ = [
     "transform_exclusive_scan", "adjacent_difference", "adjacent_find",
     "sort", "sort_sharded", "sort_sharded_by_key", "stable_sort", "is_sorted", "merge",
     "reverse", "rotate", "unique", "partition",
+    "search", "search_n", "find_end", "contains", "contains_subrange",
+    "starts_with", "ends_with",
+    "set_union", "set_intersection", "set_difference",
+    "set_symmetric_difference", "includes",
+    "partition_copy", "partial_sort", "partial_sort_copy", "nth_element",
+    "shift_left", "shift_right", "swap_ranges",
+    "unique_copy", "remove_copy", "remove_copy_if", "replace_copy",
+    "replace_copy_if", "move",
 ]

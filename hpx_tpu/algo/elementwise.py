@@ -374,3 +374,25 @@ def replace_if(policy: ExecutionPolicy, rng: Any, pred: Callable,
 def replace(policy: ExecutionPolicy, rng: Any, old_value: Any,
             new_value: Any) -> Any:
     return replace_if(policy, rng, lambda x: x == old_value, new_value)
+
+
+def _fresh_host_copy(rng: Any) -> Any:
+    """A detached host copy when the input is a mutable numpy array; jax
+    arrays are immutable and pass through."""
+    import numpy as np
+    return rng.copy() if isinstance(rng, np.ndarray) else rng
+
+
+def replace_copy(policy: ExecutionPolicy, rng: Any, old_value: Any,
+                 new_value: Any) -> Any:
+    """Like replace, but NEVER modifies the input (std::replace_copy):
+    the host path works on a fresh copy (replace's host convention is
+    in-place, matching std::replace)."""
+    return replace(policy, _fresh_host_copy(rng), old_value, new_value)
+
+
+def replace_copy_if(policy: ExecutionPolicy, rng: Any, pred: Callable,
+                    new_value: Any) -> Any:
+    """Like replace_if, but NEVER modifies the input
+    (std::replace_copy_if)."""
+    return replace_if(policy, _fresh_host_copy(rng), pred, new_value)

@@ -445,3 +445,160 @@ def find_first_of(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
         return int(hits[0]) if hits.size else -1
 
     return finish(policy, run)
+
+
+def _window_match(jnp, fa, fb):
+    """(n-m+1,) bool: window i of fa equals fb elementwise. Static
+    shapes: the (n-m+1, m) window gather is one XLA gather the compiler
+    tiles; fine at the m << n shapes subsequence search is for."""
+    n, m = fa.shape[0], fb.shape[0]
+    idx = jnp.arange(n - m + 1)[:, None] + jnp.arange(m)[None, :]
+    return (fa[idx] == fb[None, :]).all(axis=1)
+
+
+def search(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Index of the FIRST occurrence of subsequence rng2 in rng, or -1
+    (std::search). An empty needle matches at 0."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a, b):
+            fa, fb = a.reshape(-1), b.reshape(-1)
+            if fb.shape[0] == 0:                       # static shapes:
+                return jnp.asarray(0)                  # empty needle
+            if fb.shape[0] > fa.shape[0]:
+                return jnp.asarray(-1)
+            m = _window_match(jnp, fa, fb)
+            return jnp.where(m.any(), jnp.argmax(m), -1)
+        fut = ex.async_execute(kernel, rng, rng2)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        if len(b) == 0:
+            return 0
+        if len(b) > len(a):
+            return -1
+        starts = np.flatnonzero(a[:len(a) - len(b) + 1] == b[0])
+        for i in starts:
+            if np.array_equal(a[i:i + len(b)], b):
+                return int(i)
+        return -1
+
+    return finish(policy, run)
+
+
+def find_end(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Index of the LAST occurrence of subsequence rng2 in rng, or -1
+    (std::find_end). An empty needle matches at len(rng)."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a, b):
+            fa, fb = a.reshape(-1), b.reshape(-1)
+            if fb.shape[0] == 0:
+                return jnp.asarray(fa.shape[0])
+            if fb.shape[0] > fa.shape[0]:
+                return jnp.asarray(-1)
+            m = _window_match(jnp, fa, fb)
+            last = m.shape[0] - 1 - jnp.argmax(m[::-1])
+            return jnp.where(m.any(), last, -1)
+        fut = ex.async_execute(kernel, rng, rng2)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        if len(b) == 0:
+            return len(a)
+        if len(b) > len(a):
+            return -1
+        starts = np.flatnonzero(a[:len(a) - len(b) + 1] == b[0])
+        for i in starts[::-1]:
+            if np.array_equal(a[i:i + len(b)], b):
+                return int(i)
+        return -1
+
+    return finish(policy, run)
+
+
+def search_n(policy: ExecutionPolicy, rng: Any, n: int,
+             value: Any) -> Any:
+    """Index of the first run of n consecutive elements equal to value,
+    or -1 (std::search_n). n == 0 matches at 0."""
+    if n == 0:
+        return finish(policy, lambda: 0)
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            fa = a.reshape(-1)
+            if n > fa.shape[0]:
+                return jnp.asarray(-1)
+            eq = (fa == value)
+            # run length ending at i = (i+1) - (1 + last non-match
+            # position <= i), the latter as a cummax of reset markers;
+            # the first i with runlen >= n starts the match at i-n+1
+            sz = fa.shape[0]
+            run = jnp.arange(1, sz + 1) - jax.lax.cummax(
+                jnp.where(eq, 0, jnp.arange(1, sz + 1)))
+            hit = run >= n
+            return jnp.where(hit.any(), jnp.argmax(hit) - (n - 1), -1)
+        fut = ex.async_execute(kernel, rng)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    arr = to_numpy_view(rng)
+
+    def run():
+        count = 0
+        for i, x in enumerate(arr):
+            count = count + 1 if x == value else 0
+            if count >= n:
+                return i - n + 1
+        return -1
+
+    return finish(policy, run)
+
+
+def contains(policy: ExecutionPolicy, rng: Any, value: Any) -> Any:
+    """True when value appears in rng (std::ranges::contains)."""
+    res = find(policy, rng, value)
+    if policy.is_task:
+        return res.then(lambda f: f.get() != -1)
+    return res != -1
+
+
+def contains_subrange(policy: ExecutionPolicy, rng: Any,
+                      rng2: Any) -> Any:
+    """True when rng2 appears as a contiguous subsequence of rng
+    (std::ranges::contains_subrange)."""
+    res = search(policy, rng, rng2)
+    if policy.is_task:
+        return res.then(lambda f: f.get() != -1)
+    return res != -1
+
+
+def starts_with(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """True when rng2 is a prefix of rng (std::ranges::starts_with)."""
+    if len(rng2) > len(rng):
+        return finish(policy, lambda: False)
+    return equal(policy, rng[:len(rng2)], rng2)
+
+
+def ends_with(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """True when rng2 is a suffix of rng (std::ranges::ends_with)."""
+    if len(rng2) > len(rng):
+        return finish(policy, lambda: False)
+    if len(rng2) == 0:
+        return finish(policy, lambda: True)
+    return equal(policy, rng[len(rng) - len(rng2):], rng2)

@@ -1,0 +1,157 @@
+"""Set operations on sorted ranges: set_union, set_intersection,
+set_difference, set_symmetric_difference, includes.
+
+Reference analog: libs/core/algorithms include/hpx/parallel/algorithms/
+{set_union,set_intersection,set_difference,set_symmetric_difference,
+includes}.hpp — std multiset semantics (an element appearing m times in
+a and n times in b appears max(m,n)/min(m,n)/max(m-n,0)/|m-n| times in
+union/intersection/difference/symmetric_difference).
+
+Device lowering: one jitted rank kernel per input. For sorted ranges the
+multiset rules reduce to a per-element comparison of the element's
+OCCURRENCE INDEX within its equal-run (i - searchsorted(a, a[i], 'left'))
+against its multiplicity in the other range (searchsorted right - left):
+e.g. a[i] survives set_difference iff occ(a,i) >= count_b(a[i]). That
+turns data-dependent merge walks (the C++ formulation) into fixed-shape
+vector ops XLA fuses into one pass; the data-dependent OUTPUT size is
+compacted at the host boundary exactly like copy_if/unique (XLA needs
+static shapes). `includes` has a static (boolean) result and stays fully
+on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..exec.policies import ExecutionPolicy
+from ._core import (
+    device_executor,
+    finish,
+    is_device_policy,
+    to_numpy_view,
+)
+
+
+def _rank_masks_device(which: str):
+    """Mask kernel(s) for one side: keep a[i] by comparing its run-local
+    occurrence index with its multiplicity in b."""
+    import jax.numpy as jnp
+
+    if which not in ("extra", "common"):
+        raise ValueError(which)
+
+    def mask(a, b):
+        occ = jnp.arange(a.shape[0]) - jnp.searchsorted(a, a, side="left")
+        cnt = (jnp.searchsorted(b, a, side="right")
+               - jnp.searchsorted(b, a, side="left"))
+        # "extra" copies max(m-n, 0) (difference side); "common" copies
+        # min(m, n) (intersection side)
+        return occ >= cnt if which == "extra" else occ < cnt
+
+    return mask
+
+
+def _np_rank_mask(a, b, which: str):
+    import numpy as np
+    occ = np.arange(len(a)) - np.searchsorted(a, a, side="left")
+    cnt = (np.searchsorted(b, a, side="right")
+           - np.searchsorted(b, a, side="left"))
+    return occ >= cnt if which == "extra" else occ < cnt
+
+
+def _masked_setop(policy: ExecutionPolicy, rng: Any, rng2: Any,
+                  which_a: str, which_b: str | None, keep_all_a: bool):
+    """Shared driver: device computes the keep-mask(s) in one jitted
+    program; compaction + final merge happen at the host boundary
+    (data-dependent sizes). Inputs must be sorted; output is sorted."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a, b):
+            fa, fb = a.reshape(-1), b.reshape(-1)
+            ma = (jnp.ones(fa.shape, bool) if keep_all_a
+                  else _rank_masks_device(which_a)(fa, fb))
+            if which_b is None:
+                return ma, jnp.zeros((0,), bool)
+            return ma, _rank_masks_device(which_b)(fb, fa)
+        mask_f = ex.async_execute(kernel, rng, rng2)
+
+        def run():
+            import numpy as np
+            ma, mb = (np.asarray(m) for m in mask_f.get())
+            fa = np.asarray(rng).reshape(-1)[ma]
+            if which_b is None:
+                return jnp.asarray(fa)
+            fb = np.asarray(rng2).reshape(-1)[mb]
+            # both pieces are sorted; a stable sort of the concat is the
+            # merge (a-elements precede equal b-elements, std order)
+            return jnp.asarray(np.sort(np.concatenate([fa, fb]),
+                                       kind="stable"))
+        return finish(policy, run)
+
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        import numpy as np
+        fa = a if keep_all_a else a[_np_rank_mask(a, b, which_a)]
+        if which_b is None:
+            return fa.copy() if fa is a else fa
+        fb = b[_np_rank_mask(b, a, which_b)]
+        return np.sort(np.concatenate([fa, fb]), kind="stable")
+
+    return finish(policy, run)
+
+
+def set_union(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Sorted union of two sorted ranges; an element with multiplicities
+    (m, n) appears max(m, n) times (std::set_union)."""
+    return _masked_setop(policy, rng, rng2, "all", "extra",
+                         keep_all_a=True)
+
+
+def set_intersection(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Sorted intersection; multiplicity min(m, n) (std::set_intersection)."""
+    return _masked_setop(policy, rng, rng2, "common", None,
+                         keep_all_a=False)
+
+
+def set_difference(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Sorted a minus b; multiplicity max(m - n, 0) (std::set_difference)."""
+    return _masked_setop(policy, rng, rng2, "extra", None,
+                         keep_all_a=False)
+
+
+def set_symmetric_difference(policy: ExecutionPolicy, rng: Any,
+                             rng2: Any) -> Any:
+    """Sorted symmetric difference; multiplicity |m - n|
+    (std::set_symmetric_difference)."""
+    return _masked_setop(policy, rng, rng2, "extra", "extra",
+                         keep_all_a=False)
+
+
+def includes(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """True when sorted rng contains every element of sorted rng2 with
+    at least its multiplicity (std::includes). Static-shaped result —
+    the device path never leaves the chip."""
+    if is_device_policy(policy, rng, rng2):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a, b):
+            fa, fb = a.reshape(-1), b.reshape(-1)
+            if fb.shape[0] == 0:       # static shape: empty subset
+                return jnp.asarray(True)
+            return _rank_masks_device("common")(fb, fa).all()
+        fut = ex.async_execute(kernel, rng, rng2)
+        if policy.is_task:
+            return fut.then(lambda f: bool(f.get()))
+        return bool(fut.get())
+    a, b = to_numpy_view(rng), to_numpy_view(rng2)
+
+    def run():
+        if len(b) == 0:
+            return True
+        return bool(_np_rank_mask(b, a, "common").all())
+
+    return finish(policy, run)

@@ -541,7 +541,161 @@ def partition(policy: ExecutionPolicy, rng: Any, pred: Callable) -> Any:
 
     def run():
         import numpy as np
-        mask = np.array([bool(pred(x)) for x in arr])
+        mask = np.array([bool(pred(x)) for x in arr], dtype=bool)
         return np.concatenate([arr[mask], arr[~mask]]), int(mask.sum())
 
     return finish(policy, run)
+
+
+def partial_sort(policy: ExecutionPolicy, rng: Any, middle: int) -> Any:
+    """Rearrange so the smallest `middle` elements are first and sorted;
+    the tail is unspecified (std::partial_sort). Device path lowers to
+    the full XLA sort — on TPU the compiler's O(n log n) sort network is
+    the parallel sort, and a sorted tail satisfies 'unspecified'; the
+    host path does a real introselect + head sort."""
+    if is_device_policy(policy, rng):
+        return sort(policy, rng)
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        if middle <= 0:
+            return arr.copy()
+        if middle >= len(arr):
+            return np.sort(arr, kind="stable")
+        out = np.partition(arr, middle - 1)
+        out[:middle] = np.sort(out[:middle], kind="stable")
+        return out
+
+    return finish(policy, run)
+
+
+def partial_sort_copy(policy: ExecutionPolicy, rng: Any, k: int) -> Any:
+    """The k smallest elements, sorted (std::partial_sort_copy with a
+    length-k destination). Device path: lax.top_k on the negated range —
+    O(n log k), never materializes a full sort when k << n."""
+    k = max(0, min(k, len(rng)))
+    if is_device_policy(policy, rng):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            flat = a.reshape(-1)
+            if k == 0:                         # static shapes
+                return flat[:0]
+            if not jnp.issubdtype(flat.dtype, jnp.floating):
+                # integer/bool negation wraps (unsigned always, signed
+                # at INT_MIN): take the sort-slice path
+                return jnp.sort(flat)[:k]
+            neg, _ = jax.lax.top_k(-flat, k)   # top_k descending on the
+            return -neg                        # negation == ascending k-smallest
+        fut = ex.async_execute(kernel, rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        if k == 0:
+            return arr[:0].copy()
+        if k >= len(arr):
+            return np.sort(arr, kind="stable")
+        return np.sort(np.partition(arr, k - 1)[:k], kind="stable")
+
+    return finish(policy, run)
+
+
+def nth_element(policy: ExecutionPolicy, rng: Any, n: int) -> Any:
+    """Rearrange so position n holds the element that would be there in
+    a full sort, with everything before it <= and after it >=
+    (std::nth_element). Device path lowers to the full XLA sort (which
+    satisfies the postcondition); host path is numpy's introselect."""
+    if is_device_policy(policy, rng):
+        return sort(policy, rng)
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        if not 0 <= n < len(arr):
+            return arr.copy()
+        return np.partition(arr, n)
+
+    return finish(policy, run)
+
+
+def shift_left(policy: ExecutionPolicy, rng: Any, n: int) -> Any:
+    """Shift elements n positions toward the front; the vacated tail
+    keeps its original values ('unspecified' per std::shift_left)."""
+    if n <= 0:
+        from .elementwise import copy as _copy
+        return _copy(policy, rng)
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(
+            lambda a: a if n >= a.shape[0] else
+            jnp.concatenate([a[n:], a[a.shape[0] - n:]]), rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def run():
+        out = arr.copy()
+        if n < len(arr):
+            out[:len(arr) - n] = arr[n:]
+        return out
+
+    return finish(policy, run)
+
+
+def shift_right(policy: ExecutionPolicy, rng: Any, n: int) -> Any:
+    """Shift elements n positions toward the back; the vacated head
+    keeps its original values ('unspecified' per std::shift_right)."""
+    if n <= 0:
+        from .elementwise import copy as _copy
+        return _copy(policy, rng)
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+        fut = ex.async_execute(
+            lambda a: a if n >= a.shape[0] else
+            jnp.concatenate([a[:n], a[:a.shape[0] - n]]), rng)
+        return fut if policy.is_task else fut.get()
+    arr = to_numpy_view(rng)
+
+    def run():
+        out = arr.copy()
+        if n < len(arr):
+            out[n:] = arr[:len(arr) - n]
+        return out
+
+    return finish(policy, run)
+
+
+def swap_ranges(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
+    """Exchange the contents of two equal-length ranges; returns the
+    (new_rng, new_rng2) pair (std::swap_ranges in the functional data
+    model: a swap IS returning the copies crossed over)."""
+    from .elementwise import copy as _copy
+    if len(rng) != len(rng2):
+        raise ValueError("swap_ranges: ranges must have equal length")
+    a2 = _copy(policy, rng2)
+    b2 = _copy(policy, rng)
+    if policy.is_task:
+        from ..futures.combinators import when_all
+        return when_all(a2, b2).then(
+            lambda f: tuple(x.get() for x in f.get()))
+    return a2, b2
+
+
+def partition_copy(policy: ExecutionPolicy, rng: Any,
+                   pred: Callable) -> Any:
+    """(true_part, false_part) — the pred-satisfying elements and the
+    rest, each in stable order (std::partition_copy as a pair return)."""
+    res = partition(policy, rng, pred)
+
+    def split(pair):
+        arr2, point = pair
+        return arr2[:point], arr2[point:]
+    if policy.is_task:
+        return res.then(lambda f: split(f.get()))
+    return split(res)

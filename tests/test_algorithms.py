@@ -480,3 +480,229 @@ def test_replace_if_mutates_host_array_in_place():
     out = replace_if(hpx.seq, a, lambda x: x % 2 == 0, 0)
     np.testing.assert_array_equal(a, [1, 0, 3, 0])   # in place
     assert out is a
+
+
+# -- round-5 batch 2: search family, set ops, selection, shifts --------------
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_search_and_find_end(pol_idx):
+    from hpx_tpu.algo import find_end, search
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    hay = mk(np.array([1, 2, 3, 1, 2, 3, 4], np.int32))
+    assert unwrap(search(pol, hay, mk(np.array([2, 3], np.int32)))) == 1
+    assert unwrap(find_end(pol, hay, mk(np.array([2, 3], np.int32)))) == 4
+    assert unwrap(search(pol, hay, mk(np.array([3, 1], np.int32)))) == 2
+    assert unwrap(search(pol, hay, mk(np.array([9], np.int32)))) == -1
+    assert unwrap(find_end(pol, hay, mk(np.array([9], np.int32)))) == -1
+    # empty needle: first match at 0, last at len
+    assert unwrap(search(pol, hay, mk(np.array([], np.int32)))) == 0
+    assert unwrap(find_end(pol, hay, mk(np.array([], np.int32)))) == 7
+    # needle longer than haystack
+    assert unwrap(search(pol, mk(np.array([1], np.int32)),
+                         mk(np.array([1, 2], np.int32)))) == -1
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_search_n(pol_idx):
+    from hpx_tpu.algo import search_n
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    data = mk(np.array([5, 7, 7, 5, 7, 7, 7, 2], np.int32))
+    assert unwrap(search_n(pol, data, 2, 7)) == 1
+    assert unwrap(search_n(pol, data, 3, 7)) == 4
+    assert unwrap(search_n(pol, data, 4, 7)) == -1
+    assert unwrap(search_n(pol, data, 1, 2)) == 7
+    assert unwrap(search_n(pol, data, 0, 9)) == 0
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_contains_family(pol_idx):
+    from hpx_tpu.algo import (
+        contains, contains_subrange, ends_with, starts_with)
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    data = mk(np.array([4, 8, 15, 16, 23, 42], np.int32))
+    assert unwrap(contains(pol, data, 15)) is True
+    assert unwrap(contains(pol, data, 17)) is False
+    assert unwrap(contains_subrange(
+        pol, data, mk(np.array([15, 16], np.int32)))) is True
+    assert unwrap(contains_subrange(
+        pol, data, mk(np.array([16, 15], np.int32)))) is False
+    assert unwrap(starts_with(
+        pol, data, mk(np.array([4, 8], np.int32)))) is True
+    assert unwrap(starts_with(
+        pol, data, mk(np.array([8], np.int32)))) is False
+    assert unwrap(ends_with(
+        pol, data, mk(np.array([23, 42], np.int32)))) is True
+    assert unwrap(ends_with(
+        pol, data, mk(np.array([23], np.int32)))) is False
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_set_operations_multiset_semantics(pol_idx):
+    from hpx_tpu.algo import (
+        set_difference, set_intersection, set_symmetric_difference,
+        set_union)
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    # multiplicities: a has {1:2, 2:1, 5:3}; b has {1:1, 2:2, 7:1}
+    a = np.array([1, 1, 2, 5, 5, 5], np.int32)
+    b = np.array([1, 2, 2, 7], np.int32)
+    # union: max(m, n) of each
+    np.testing.assert_array_equal(
+        asnp(unwrap(set_union(pol, mk(a), mk(b)))),
+        [1, 1, 2, 2, 5, 5, 5, 7])
+    # intersection: min(m, n)
+    np.testing.assert_array_equal(
+        asnp(unwrap(set_intersection(pol, mk(a), mk(b)))), [1, 2])
+    # difference: max(m - n, 0)
+    np.testing.assert_array_equal(
+        asnp(unwrap(set_difference(pol, mk(a), mk(b)))), [1, 5, 5, 5])
+    np.testing.assert_array_equal(
+        asnp(unwrap(set_difference(pol, mk(b), mk(a)))), [2, 7])
+    # symmetric difference: |m - n|
+    np.testing.assert_array_equal(
+        asnp(unwrap(set_symmetric_difference(pol, mk(a), mk(b)))),
+        [1, 2, 5, 5, 5, 7])
+    # empty edge
+    np.testing.assert_array_equal(
+        asnp(unwrap(set_union(pol, mk(a[:0]), mk(b)))), b)
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_includes(pol_idx):
+    from hpx_tpu.algo import includes
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    a = mk(np.array([1, 1, 2, 3, 5, 8], np.int32))
+    assert unwrap(includes(pol, a, mk(np.array([1, 3, 8], np.int32)))) \
+        is True
+    assert unwrap(includes(pol, a, mk(np.array([1, 1], np.int32)))) is True
+    # multiplicity matters: three 1s are not included in two
+    assert unwrap(includes(
+        pol, a, mk(np.array([1, 1, 1], np.int32)))) is False
+    assert unwrap(includes(pol, a, mk(np.array([4], np.int32)))) is False
+    assert unwrap(includes(pol, a, mk(np.array([], np.int32)))) is True
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_partial_sort_and_nth_element(pol_idx):
+    from hpx_tpu.algo import nth_element, partial_sort
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    data = np.array([9, 1, 8, 2, 7, 3, 6], np.int32)
+    out = asnp(unwrap(partial_sort(pol, mk(data), 3)))
+    np.testing.assert_array_equal(out[:3], [1, 2, 3])
+    assert sorted(out.tolist()) == sorted(data.tolist())
+    out2 = asnp(unwrap(nth_element(pol, mk(data), 3)))
+    assert out2[3] == np.sort(data)[3]
+    assert (out2[:3] <= out2[3]).all() and (out2[4:] >= out2[3]).all()
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_partial_sort_copy(pol_idx):
+    from hpx_tpu.algo import partial_sort_copy
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    data = np.array([9.0, -1.5, 8.0, 2.0, 7.0], np.float32)
+    np.testing.assert_allclose(
+        asnp(unwrap(partial_sort_copy(pol, mk(data), 3))),
+        [-1.5, 2.0, 7.0])
+    # k > len clamps to a full sort; k == 0 is empty
+    np.testing.assert_allclose(
+        asnp(unwrap(partial_sort_copy(pol, mk(data), 99))),
+        np.sort(data))
+    assert len(asnp(unwrap(partial_sort_copy(pol, mk(data), 0)))) == 0
+    # unsigned dtype takes the sort path (negation would wrap)
+    np.testing.assert_array_equal(
+        asnp(unwrap(partial_sort_copy(
+            pol, mk(np.array([3, 1, 2], np.uint32)), 2))), [1, 2])
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_shift_left_right(pol_idx):
+    from hpx_tpu.algo import shift_left, shift_right
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    data = np.array([1, 2, 3, 4, 5], np.int32)
+    out = asnp(unwrap(shift_left(pol, mk(data), 2)))
+    np.testing.assert_array_equal(out[:3], [3, 4, 5])
+    out2 = asnp(unwrap(shift_right(pol, mk(data), 2)))
+    np.testing.assert_array_equal(out2[2:], [1, 2, 3])
+    # n == 0 and n >= len are identity-shaped
+    np.testing.assert_array_equal(
+        asnp(unwrap(shift_left(pol, mk(data), 0))), data)
+    np.testing.assert_array_equal(
+        asnp(unwrap(shift_left(pol, mk(data), 9))), data)
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_swap_ranges_and_partition_copy(pol_idx):
+    from hpx_tpu.algo import partition_copy, swap_ranges
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    a = np.array([1, 2, 3], np.int32)
+    b = np.array([4, 5, 6], np.int32)
+    na, nb = unwrap(swap_ranges(pol, mk(a), mk(b)))
+    np.testing.assert_array_equal(asnp(na), b)
+    np.testing.assert_array_equal(asnp(nb), a)
+    with pytest.raises(ValueError):
+        swap_ranges(pol, mk(a), mk(b[:2]))
+    t, f = unwrap(partition_copy(
+        pol, mk(np.array([1, 2, 3, 4, 5], np.int32)),
+        lambda x: x % 2 == 1))
+    np.testing.assert_array_equal(asnp(t), [1, 3, 5])
+    np.testing.assert_array_equal(asnp(f), [2, 4])
+
+
+def test_functional_copy_aliases():
+    from hpx_tpu import algo
+    assert algo.unique_copy is algo.unique
+    assert algo.remove_copy is algo.remove
+    assert algo.remove_copy_if is algo.remove_if
+    assert algo.move is algo.copy
+    # replace_copy is NOT an alias: replace mutates on the host path
+    # (std semantics), so the _copy form must be a copy-first wrapper
+    assert algo.replace_copy is not algo.replace
+    assert algo.replace_copy_if is not algo.replace_if
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_replace_copy_preserves_input(pol_idx):
+    from hpx_tpu.algo import replace_copy, replace_copy_if
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    src = mk(np.array([1, 2, 3, 2], np.int32))
+    out = asnp(unwrap(replace_copy(pol, src, 2, 0)))
+    np.testing.assert_array_equal(out, [1, 0, 3, 0])
+    np.testing.assert_array_equal(asnp(src), [1, 2, 3, 2])  # untouched
+    out2 = asnp(unwrap(replace_copy_if(pol, src, lambda x: x > 2, 9)))
+    np.testing.assert_array_equal(out2, [1, 2, 9, 2])
+    np.testing.assert_array_equal(asnp(src), [1, 2, 3, 2])
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_partition_copy_empty_and_int_min_selection(pol_idx):
+    from hpx_tpu.algo import partial_sort_copy, partition_copy
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    t, f = unwrap(partition_copy(pol, mk(np.array([], np.int32)),
+                                 lambda x: x > 0))
+    assert len(asnp(t)) == 0 and len(asnp(f)) == 0
+    # INT_MIN must survive k-smallest selection (negation wraps)
+    imin = np.iinfo(np.int32).min
+    np.testing.assert_array_equal(
+        asnp(unwrap(partial_sort_copy(
+            pol, mk(np.array([imin, 5, 3], np.int32)), 2))), [imin, 3])
