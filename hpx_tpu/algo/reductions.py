@@ -532,8 +532,8 @@ def find_end(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
 def search_n(policy: ExecutionPolicy, rng: Any, n: int,
              value: Any) -> Any:
     """Index of the first run of n consecutive elements equal to value,
-    or -1 (std::search_n). n == 0 matches at 0."""
-    if n == 0:
+    or -1 (std::search_n). n <= 0 matches at 0 (std semantics)."""
+    if n <= 0:
         return finish(policy, lambda: 0)
     if is_device_policy(policy, rng):
         import jax

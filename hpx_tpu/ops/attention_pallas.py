@@ -153,17 +153,28 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                      # (block_q, 1)
-        l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-        corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         p = jnp.where(mask, p, 0.0)                # masked lanes: exact 0
-        l_ref[:] = jnp.broadcast_to(corr * l_prev + p.sum(
-            axis=1, keepdims=True), l_ref.shape)
+
+        # delayed rescaling: the (corr = exp(m_prev - m_new)) multiply
+        # of acc and l is an exact no-op on every tile where the running
+        # max didn't move (corr == exp(0) == 1) — common once the max
+        # stabilizes along the k walk. Rescale CONDITIONALLY (one scalar
+        # reduction gates a (block_q, H) + (block_q, 128) VPU multiply),
+        # then accumulate unconditionally.
+        @pl.when(jnp.logical_not((m_new == m_prev).all()))
+        def _rescale():
+            corr = jnp.exp(m_prev - m_new)
+            acc_ref[:] = acc_ref[:] * corr
+            l_ref[:] = l_ref[:] * corr
+
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = l_ref[:] + jnp.broadcast_to(
+            p.sum(axis=1, keepdims=True), l_ref.shape)
         # second matmul in the storage dtype too (p cast bf16 when v is
         # bf16 — standard flash practice), still accumulated in fp32
-        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+        acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
             p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p, v,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -643,16 +654,23 @@ def _flash_chunk_kernel(d_ref, q_ref, k_ref, v_ref, acc_in, m_in, l_in,
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_s[:, :1]
-        l_prev = l_s[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
-        corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         if causal:
             p = jnp.where(mask, p, 0.0)
-        l_s[:] = jnp.broadcast_to(corr * l_prev + p.sum(
-            axis=1, keepdims=True), l_s.shape)
+
+        # delayed rescaling, same as _flash_kernel: the corr multiply is
+        # an exact no-op (corr == 1) whenever the running max held still
+        @pl.when(jnp.logical_not((m_new == m_prev).all()))
+        def _rescale():
+            corr = jnp.exp(m_prev - m_new)
+            acc_s[:] = acc_s[:] * corr
+            l_s[:] = l_s[:] * corr
+
         m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
-        acc_s[:] = acc_s[:] * corr + jax.lax.dot_general(
+        l_s[:] = l_s[:] + jnp.broadcast_to(
+            p.sum(axis=1, keepdims=True), l_s.shape)
+        acc_s[:] = acc_s[:] + jax.lax.dot_general(
             p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p, v,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

@@ -516,6 +516,7 @@ def test_search_n(pol_idx):
     assert unwrap(search_n(pol, data, 4, 7)) == -1
     assert unwrap(search_n(pol, data, 1, 2)) == 7
     assert unwrap(search_n(pol, data, 0, 9)) == 0
+    assert unwrap(search_n(pol, data, -2, 9)) == 0  # count <= 0: first pos
 
 
 @pytest.mark.parametrize("pol_idx", range(3))
