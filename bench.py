@@ -90,29 +90,76 @@ _FALLBACK_LOCAL = os.path.join(
     "bench_fallback.local.json")
 
 
+# canonical emission order (headline LAST — the driver parses the final
+# stdout line as the headline metric)
+_METRIC_ORDER = [
+    "stream_triad_gbs", "copy_stream_elems",
+    "1d_stencil_unfused_cell_updates", "flash_attention_tflops",
+    "flash_attention_bwd_tflops", "transformer_step_ms", "fft_1d_gflops",
+    "1d_stencil_cell_updates",
+]
+
+
 def emit(metric, value, unit, vs_baseline, **extra):
     line = {"metric": metric, "value": round(value, 3), "unit": unit,
             "vs_baseline": round(vs_baseline, 3)}
     line.update(extra)
     _EMITTED.append(line)
     print(json.dumps(line), flush=True)
+    # save after EVERY metric: on a tunnel that wedges mid-run (observed
+    # r4/r5: answers one probe, runs ~one metric, hangs for 30+ min),
+    # each partial run still banks its live wins — successive partial
+    # runs ASSEMBLE a full fresh record metric by metric
+    _save_fallback()
 
 
 def _save_fallback() -> None:
-    """A successful run records its own results so a later run with a
-    dead device tunnel can re-emit them labeled builder-session (the
-    round-4 lesson: BENCH_r04.json was empty because the tunnel died and
-    the bench had nothing to say — never be evidence-free again).
-    Atomic write: a kill mid-dump must not clobber the previous good
-    record."""
+    """Merge this run's results into the local record so a later run
+    with a dead device tunnel can re-emit them labeled builder-session
+    (the round-4 lesson: BENCH_r04.json was empty because the tunnel
+    died and the bench had nothing to say — never be evidence-free
+    again). Per-metric merge with per-line timestamps: the freshest
+    measurement of each metric wins, whatever run it came from. Atomic
+    write: a kill mid-dump must not clobber the previous good record."""
     import datetime
+    now = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    def _stamp(ln):
+        # ISO timestamps compare lexicographically; "unknown" is oldest
+        ts = ln.get("measured_at", "unknown")
+        return "" if ts == "unknown" else ts
+
+    merged = {}
+    for path in (_FALLBACK_SEED, _FALLBACK_LOCAL):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for line in rec.get("lines", []):
+            ln = dict(line)
+            ln.setdefault("measured_at", rec.get("measured_at", "unknown"))
+            prev = merged.get(ln.get("metric"))
+            # freshest wins regardless of which file it came from (a
+            # re-curated seed must beat a stale local record)
+            if prev is None or _stamp(ln) >= _stamp(prev):
+                merged[ln.get("metric")] = ln
+    for line in _EMITTED:
+        ln = dict(line)
+        ln["measured_at"] = now
+        merged[ln["metric"]] = ln
+    # headline strictly LAST, unknown metric names before it — a future
+    # emit not yet in _METRIC_ORDER must never land after the headline
+    headline = _METRIC_ORDER[-1]
+    order = [m for m in _METRIC_ORDER[:-1] if m in merged] + \
+            [m for m in merged
+             if m not in _METRIC_ORDER and m != headline] + \
+            ([headline] if headline in merged else [])
     tmp = _FALLBACK_LOCAL + ".tmp"
     try:
         with open(tmp, "w") as f:
-            json.dump({"measured_at":
-                       datetime.datetime.now(datetime.timezone.utc
-                                             ).isoformat(timespec="seconds"),
-                       "lines": _EMITTED}, f, indent=1)
+            json.dump({"measured_at": now,
+                       "lines": [merged[m] for m in order]}, f, indent=1)
         os.replace(tmp, _FALLBACK_LOCAL)
     except OSError:
         pass
@@ -137,7 +184,7 @@ def _load_fallback(skip=()):
             continue
         fb = dict(line)
         fb["provenance"] = "builder-session"
-        fb["measured_at"] = rec.get("measured_at", "unknown")
+        fb.setdefault("measured_at", rec.get("measured_at", "unknown"))
         out.append(fb)
     return out
 
@@ -552,25 +599,49 @@ def _bench_main() -> None:
     dev = jax.devices()[0]
     print(f"# device: {dev} platform={dev.platform}", file=sys.stderr)
 
-    bench_triad(jax, jnp)
-    copy_rate = bench_copy_stream(jax, jnp)
-    bench_stencil_unfused(jax, jnp, heat_step_best, copy_rate=copy_rate)
-    bench_attention(jax, jnp)
-    bench_attention_bwd(jax, jnp)
-    bench_transformer(jax, jnp)
-    bench_fft(jax, jnp)
+    # HPX_BENCH_ONLY=m1,m2 measures just those metrics — the tool for a
+    # flaky tunnel: one metric per invocation, banked incrementally into
+    # the local record (see emit), assembles a full fresh set over time
+    only = {m.strip() for m in
+            os.environ.get("HPX_BENCH_ONLY", "").split(",") if m.strip()}
 
-    vpu_rate = bench_vpu_rate(jax, jnp)
-    cells_per_s, hbm_roof, spread = bench_stencil_fused(jax, jnp,
-                                                        multistep)
-    # headline LAST so a last-line JSON parser picks it up. The honest
-    # roof for the VMEM-resident kernel is COMPUTE: the empirically
-    # measured VPU op rate divided by the kernel's 9 vector ops per
-    # cell-update. The unfused-HBM ratio is kept for round-1 continuity.
-    emit("1d_stencil_cell_updates", cells_per_s / 1e6, "Mcells/s",
-         cells_per_s * _STENCIL_OPS_PER_CELL / vpu_rate,
-         x_vs_unfused_hbm_roof=round(cells_per_s / hbm_roof, 3),
-         vpu_rate_gops=round(vpu_rate / 1e9, 1), spread=round(spread, 3))
+    def want(name):
+        return not only or name in only
+
+    if want("stream_triad_gbs"):
+        bench_triad(jax, jnp)
+    copy_rate = None
+    if want("copy_stream_elems") or \
+            want("1d_stencil_unfused_cell_updates"):
+        # the copy stream is the unfused stencil's same-session
+        # normalizer, so it rides along with it
+        copy_rate = bench_copy_stream(jax, jnp)
+    if want("1d_stencil_unfused_cell_updates"):
+        bench_stencil_unfused(jax, jnp, heat_step_best,
+                              copy_rate=copy_rate)
+    if want("flash_attention_tflops"):
+        bench_attention(jax, jnp)
+    if want("flash_attention_bwd_tflops"):
+        bench_attention_bwd(jax, jnp)
+    if want("transformer_step_ms"):
+        bench_transformer(jax, jnp)
+    if want("fft_1d_gflops"):
+        bench_fft(jax, jnp)
+
+    if want("1d_stencil_cell_updates"):
+        vpu_rate = bench_vpu_rate(jax, jnp)
+        cells_per_s, hbm_roof, spread = bench_stencil_fused(jax, jnp,
+                                                            multistep)
+        # headline LAST so a last-line JSON parser picks it up. The
+        # honest roof for the VMEM-resident kernel is COMPUTE: the
+        # empirically measured VPU op rate divided by the kernel's 9
+        # vector ops per cell-update. The unfused-HBM ratio is kept for
+        # round-1 continuity.
+        emit("1d_stencil_cell_updates", cells_per_s / 1e6, "Mcells/s",
+             cells_per_s * _STENCIL_OPS_PER_CELL / vpu_rate,
+             x_vs_unfused_hbm_roof=round(cells_per_s / hbm_roof, 3),
+             vpu_rate_gops=round(vpu_rate / 1e9, 1),
+             spread=round(spread, 3))
     _save_fallback()
 
 
@@ -580,6 +651,20 @@ _CHILD_ENV = "_HPX_BENCH_CHILD"
 def main() -> None:
     if os.environ.get(_CHILD_ENV) == "1":
         return _bench_main()
+
+    only = {m.strip() for m in
+            os.environ.get("HPX_BENCH_ONLY", "").split(",") if m.strip()}
+    unknown = only - set(_METRIC_ORDER)
+    if unknown:
+        # fail the typo loudly BEFORE probing: a silent no-op child
+        # would be mislabeled as a tunnel death by the gap-fill path
+        print(json.dumps({
+            "metric": "bench_usage_error", "value": 0, "unit": "none",
+            "vs_baseline": 0,
+            "error": f"HPX_BENCH_ONLY names unknown metrics "
+                     f"{sorted(unknown)}; known: {_METRIC_ORDER}"}),
+            flush=True)
+        sys.exit(2)
 
     if not _probe_device():
         print(json.dumps({
@@ -655,6 +740,13 @@ def main() -> None:
         rc = -1
     _flush_lines(buf)
     if rc == 0 and done:
+        if only:
+            # a successful PARTIAL run (HPX_BENCH_ONLY) still owes the
+            # driver a complete, headline-LAST record: fill what was
+            # filtered out from the banked fallback (live lines from
+            # this run were already merged into it by the child)
+            for line in _load_fallback(skip=done):
+                print(json.dumps(line), flush=True)
         return
     # child died or hung mid-run: fill the gaps from the last good run,
     # keeping the original emission order (headline last). The marker
