@@ -17,7 +17,9 @@ import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+# repo root (this file lives in benchmarks/), regardless of the cwd
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -49,9 +51,43 @@ def measure(jax, jnp, flash, S, causal, bq, bk, samples=3):
     return flops / per / 1e12, (pers[-1] - pers[0]) / per
 
 
+def _arg(name):
+    if name in sys.argv:
+        return sys.argv[sys.argv.index(name) + 1]
+    return None
+
+
+def _bank(table, blocks_file) -> int:
+    """Merge `table` into the on-disk table atomically; returns total.
+    Called after EVERY shape class: a tunnel wedge mid-sweep must not
+    discard classes already tuned (same discipline as bench.py's
+    incremental fallback banking)."""
+    try:
+        with open(blocks_file) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        merged = {}
+    merged.update(table)
+    tmp = blocks_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, indent=1, sort_keys=True)
+    os.replace(tmp, blocks_file)
+    return len(merged)
+
+
 def main() -> int:
     quick = "--quick" in sys.argv
+    # single-class mode for a flaky tunnel: tune ONE (S, causal) per
+    # invocation, e.g. --shape 4096 --causal 1 (the bench shape)
+    shape_only = _arg("--shape")
+    causal_only = _arg("--causal")
     import jax
+    # the sandbox sitecustomize forces jax_platforms to axon-first; honor
+    # an explicit JAX_PLATFORMS env so the guard below can run (and fail
+    # fast) without touching a possibly-wedged device tunnel
+    env_plat = os.environ.get("JAX_PLATFORMS")
+    if env_plat:
+        jax.config.update("jax_platforms", env_plat)
     import jax.numpy as jnp
     from hpx_tpu.ops.attention_pallas import _BLOCKS_FILE, flash_attention
 
@@ -61,11 +97,15 @@ def main() -> int:
         return 1
 
     seqs = (2048, 4096) if quick else (2048, 4096, 8192, 16384)
+    if shape_only:
+        seqs = (int(shape_only),)
+    causals = (True, False) if causal_only is None else \
+        (bool(int(causal_only)),)
     cand = (256, 512, 1024, 2048)
     samples = 2 if quick else 3
     table = {}
     for S in seqs:
-        for causal in (True, False):
+        for causal in causals:
             best = None
             for bq in cand:
                 if bq > S:
@@ -92,27 +132,14 @@ def main() -> int:
                         best = (tf, bq, bk)
             if best:
                 table[f"{S}x{S}x{int(causal)}"] = [best[1], best[2]]
+                total = _bank(table, _BLOCKS_FILE)
                 print(json.dumps({"S": S, "causal": causal,
                                   "winner": best[1:],
-                                  "tflops": round(best[0], 1)}),
+                                  "tflops": round(best[0], 1),
+                                  "banked": total}),
                       flush=True)
 
-    # MERGE into any existing table (a --quick smoke must not discard
-    # previously tuned 8k/16k entries) and write atomically (a kill
-    # mid-dump must not leave a truncated file that silently reads as
-    # an empty table)
-    try:
-        with open(_BLOCKS_FILE) as f:
-            merged = json.load(f)
-    except (OSError, ValueError):
-        merged = {}
-    merged.update(table)
-    tmp = _BLOCKS_FILE + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(merged, f, indent=1, sort_keys=True)
-    os.replace(tmp, _BLOCKS_FILE)
-    print(json.dumps({"wrote": _BLOCKS_FILE, "new": len(table),
-                      "total": len(merged)}))
+    print(json.dumps({"wrote": _BLOCKS_FILE, "new": len(table)}))
     return 0
 
 
