@@ -52,6 +52,7 @@ find_first_of = _seg(_red.find_first_of)
 is_sorted_until = _seg(_red.is_sorted_until)
 is_partitioned = _seg(_red.is_partitioned)
 lexicographical_compare = _seg(_red.lexicographical_compare)
+reduce_by_key = _seg(_red.reduce_by_key)
 search = _seg(_red.search)
 search_n = _seg(_red.search_n)
 find_end = _seg(_red.find_end)
@@ -136,5 +137,5 @@ __all__ = [
     "partition_copy", "partial_sort", "partial_sort_copy", "nth_element",
     "shift_left", "shift_right", "swap_ranges",
     "unique_copy", "remove_copy", "remove_copy_if", "replace_copy",
-    "replace_copy_if", "move",
+    "replace_copy_if", "move", "reduce_by_key",
 ]

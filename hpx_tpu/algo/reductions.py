@@ -602,3 +602,73 @@ def ends_with(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
     if len(rng2) == 0:
         return finish(policy, lambda: True)
     return equal(policy, rng[len(rng) - len(rng2):], rng2)
+
+
+def reduce_by_key(policy: ExecutionPolicy, keys: Any, values: Any,
+                  op: Callable = _op.add) -> Any:
+    """Collapse each run of CONSECUTIVE equal keys to one (key, reduced
+    value) pair; returns (unique_run_keys, reduced_values)
+    (hpx::experimental::reduce_by_key semantics — sort by key first for
+    a global group-by).
+
+    Device lowering: one jitted segmented associative scan — the carry
+    is a (value, run_start) pair, so XLA's log-depth scan machinery does
+    the segmentation (no data-dependent shapes inside jit); the
+    data-dependent OUTPUT length compacts at the host boundary exactly
+    like unique/copy_if."""
+    if is_device_policy(policy, keys, values):
+        import jax
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(ks, vs):
+            ks, vs = ks.reshape(-1), vs.reshape(-1)
+            n = ks.shape[0]
+            if n == 0:                         # static shapes
+                return jnp.zeros(0, bool), jnp.zeros(0, bool), vs
+            start = jnp.concatenate(
+                [jnp.ones(1, bool), ks[1:] != ks[:-1]])
+            end = jnp.concatenate([start[1:], jnp.ones(1, bool)])
+            known = _known_folds().get(op)
+            combine = known[1] if known is not None else jax.vmap(op)
+
+            def seg_combine(a, b):
+                av, af = a
+                bv, bf = b
+                return jnp.where(bf, bv, combine(av, bv)), af | bf
+
+            scanned, _ = jax.lax.associative_scan(
+                seg_combine, (vs, start))
+            return start, end, scanned
+        fut = ex.async_execute(kernel, keys, values)
+
+        def done(f):
+            import numpy as np
+            start, end, scanned = (np.asarray(x) for x in f.get())
+            import jax.numpy as jnp
+            uk = jnp.asarray(np.asarray(keys).reshape(-1)[start])
+            rv = jnp.asarray(scanned[end])
+            return uk, rv
+        return fut.then(done) if policy.is_task else done(fut)
+
+    ks = to_numpy_view(keys).reshape(-1)
+    vs = to_numpy_view(values).reshape(-1)
+
+    def run():
+        import numpy as np
+        if len(ks) == 0:
+            return ks.copy(), vs.copy()
+        starts = np.flatnonzero(
+            np.concatenate([[True], ks[1:] != ks[:-1]]))
+        if op is _op.add:
+            return ks[starts], np.add.reduceat(vs, starts)
+        out = []
+        bounds = np.append(starts, len(ks))
+        for b, e in zip(bounds[:-1], bounds[1:]):
+            acc = vs[b]
+            for i in range(b + 1, e):
+                acc = op(acc, vs[i])
+            out.append(acc)
+        return ks[starts], np.array(out)
+
+    return finish(policy, run)

@@ -707,3 +707,28 @@ def test_partition_copy_empty_and_int_min_selection(pol_idx):
     np.testing.assert_array_equal(
         asnp(unwrap(partial_sort_copy(
             pol, mk(np.array([imin, 5, 3], np.int32)), 2))), [imin, 3])
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_reduce_by_key(pol_idx):
+    from hpx_tpu.algo import reduce_by_key
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    ks = mk(np.array([1, 1, 2, 2, 2, 1, 3], np.int32))
+    vs = mk(np.array([1., 2., 3., 4., 5., 6., 7.], np.float32))
+    uk, rv = unwrap(reduce_by_key(pol, ks, vs))
+    np.testing.assert_array_equal(asnp(uk), [1, 2, 1, 3])  # runs, not groups
+    np.testing.assert_allclose(asnp(rv), [3., 12., 6., 7.])
+    # generic path: an associative op that is not in the known-fold
+    # table (a lambda misses the operator.add identity lookup)
+    uk2, rv2 = unwrap(reduce_by_key(pol, ks, vs, op=lambda a, b: a + b))
+    np.testing.assert_allclose(asnp(rv2), [3., 12., 6., 7.])
+    # single run and empty
+    uk3, rv3 = unwrap(reduce_by_key(pol, mk(np.array([9, 9], np.int32)),
+                                    mk(np.array([2., 8.], np.float32))))
+    np.testing.assert_array_equal(asnp(uk3), [9])
+    np.testing.assert_allclose(asnp(rv3), [10.])
+    uk4, rv4 = unwrap(reduce_by_key(pol, mk(np.array([], np.int32)),
+                                    mk(np.array([], np.float32))))
+    assert len(asnp(uk4)) == 0 and len(asnp(rv4)) == 0
