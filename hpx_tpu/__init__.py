@@ -152,6 +152,8 @@ from .svc.performance_counters import (  # noqa: F401
 from .svc.checkpoint import (  # noqa: F401
     Checkpoint, save_checkpoint, save_checkpoint_sync, restore_checkpoint,
     save_checkpoint_to_file, restore_checkpoint_from_file,
+    save_sharded_state, save_sharded_state_to_file,
+    restore_sharded_state, restore_sharded_state_from_file,
 )
 from .svc.resiliency import (  # noqa: F401
     AbortReplayException, AbortReplicateException, ReplayValidationError,

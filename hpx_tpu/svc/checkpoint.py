@@ -62,15 +62,23 @@ def _encode(obj: Any) -> Any:
     return obj
 
 
-def _decode(obj: Any) -> Any:
+def _decode(obj: Any, sharded_ok: bool = False) -> Any:
     if isinstance(obj, _PVMarker):
         return obj.restore()
+    if isinstance(obj, _ShardedMarker):
+        if not sharded_ok:
+            # a sharded-state file read through the PLAIN restore API
+            # must fail loudly, not leak private marker objects
+            raise ValueError(
+                "checkpoint holds mesh-sharded leaves; restore it with "
+                "restore_sharded_state(_from_file)(..., mesh=...)")
+        return obj
     if isinstance(obj, (list, tuple)):
         t = type(obj)
-        vals = [_decode(x) for x in obj]
+        vals = [_decode(x, sharded_ok) for x in obj]
         return t(vals) if t in (list, tuple) else vals
     if isinstance(obj, dict):
-        return {k: _decode(v) for k, v in obj.items()}
+        return {k: _decode(v, sharded_ok) for k, v in obj.items()}
     return obj
 
 
@@ -121,36 +129,35 @@ def save_checkpoint_sync(*args: Any) -> Checkpoint:
     return save_checkpoint(*args).get()
 
 
-def restore_checkpoint(cp: Checkpoint) -> Tuple:
+def restore_checkpoint(cp: Checkpoint, _sharded_ok: bool = False) -> Tuple:
     """Returns the restored argument pack as a tuple (Python can't fill
     out-params; a 1-arg checkpoint restores as a 1-tuple)."""
-    return tuple(_decode(deserialize(cp.data)))
+    return tuple(_decode(deserialize(cp.data), _sharded_ok))
 
 
-def save_checkpoint_to_file(path: Union[str, os.PathLike],
-                            *args: Any) -> Future:
-    def build() -> Checkpoint:
-        return Checkpoint(serialize(_encode(list(args))))
-
-    def write(cp: Checkpoint) -> Checkpoint:
-        import tempfile
-        d = os.path.dirname(os.path.abspath(path)) or "."
-        # unique temp per call: concurrent saves to one path must not
-        # interleave into the same tmp file before the atomic publish
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(
-            str(path)) + ".tmp.")
+def _publish(path: Union[str, os.PathLike], cp: Checkpoint) -> Checkpoint:
+    """Write-then-atomic-rename: a kill mid-write can never truncate a
+    previous good checkpoint at `path`."""
+    import tempfile
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    # unique temp per call: concurrent saves to one path must not
+    # interleave into the same tmp file before the atomic publish
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(
+        str(path)) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            cp.write(f)
+        os.replace(tmp, path)    # atomic publish
+    except BaseException:
         try:
-            with os.fdopen(fd, "wb") as f:
-                cp.write(f)
-            os.replace(tmp, path)    # atomic publish
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        return cp
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return cp
 
+
+def _save_to_file(path: Union[str, os.PathLike], build) -> Future:
     # serialize on the compute pool (CPU-bound), write on the "io"
     # helper pool (blocking syscalls off the scheduler workers — the
     # reference's io_service_pool split, SURVEY.md §2.1)
@@ -158,9 +165,119 @@ def save_checkpoint_to_file(path: Union[str, os.PathLike],
 
     return async_(build).then(
         lambda fut: get_io_service_pool("io").async_execute(
-            write, fut.get()))
+            _publish, path, fut.get()))
+
+
+def save_checkpoint_to_file(path: Union[str, os.PathLike],
+                            *args: Any) -> Future:
+    def build() -> Checkpoint:
+        return Checkpoint(serialize(_encode(list(args))))
+
+    return _save_to_file(path, build)
 
 
 def restore_checkpoint_from_file(path: Union[str, os.PathLike]) -> Tuple:
     with open(path, "rb") as f:
         return restore_checkpoint(Checkpoint.read(f))
+
+
+# ---------------------------------------------------------------------------
+# Sharded train-state checkpointing (the TPU-native elasticity story)
+# ---------------------------------------------------------------------------
+
+class _ShardedMarker:
+    """Wire form of a mesh-sharded jax.Array: host data + the
+    PartitionSpec entries (as plain nested tuples), so restore can
+    re-place the leaf onto the RESTORING run's mesh — same axis names,
+    any device count (reference analog: the checkpoint restarting on a
+    different locality count, SURVEY.md §5.4)."""
+
+    __slots__ = ("np_value", "spec")
+
+    def __init__(self, np_value, spec) -> None:
+        self.np_value = np_value
+        self.spec = spec
+
+
+def _spec_entries(spec) -> tuple:
+    out = []
+    for e in spec:
+        out.append(tuple(e) if isinstance(e, (tuple, list)) else e)
+    return tuple(out)
+
+
+def _sharded_payload(tree: Any) -> dict:
+    """Flatten the pytree and lower mesh-sharded leaves to markers.
+    The device→host pulls (np.asarray) happen HERE, so callers run this
+    inside the build task — the training loop gets its future back
+    without waiting on multi-GB transfers."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+
+    def enc(leaf):
+        if isinstance(leaf, jax.Array) and \
+                isinstance(getattr(leaf, "sharding", None), NamedSharding):
+            return _ShardedMarker(np.asarray(leaf),
+                                  _spec_entries(leaf.sharding.spec))
+        return leaf
+
+    return {"treedef": treedef, "leaves": [enc(x) for x in leaves]}
+
+
+def save_sharded_state(tree: Any) -> Future:
+    """-> future<Checkpoint> of a PYTREE of jax arrays (a train state:
+    params/opt state/step...). Mesh-sharded leaves record their
+    PartitionSpec; restore_sharded_state re-places them on a given
+    mesh. Unsharded leaves (host scalars, numpy, single-device arrays)
+    ride the plain checkpoint path. Device→host pulls and serialization
+    both run as a task."""
+    def build() -> Checkpoint:
+        return Checkpoint(serialize(_encode([_sharded_payload(tree)])))
+
+    return async_(build)
+
+
+def save_sharded_state_to_file(path: Union[str, os.PathLike],
+                               tree: Any) -> Future:
+    """Same atomic tmp+rename publish and io-pool write as
+    save_checkpoint_to_file — a kill mid-save never clobbers the
+    previous good checkpoint."""
+    def build() -> Checkpoint:
+        return Checkpoint(serialize(_encode([_sharded_payload(tree)])))
+
+    return _save_to_file(path, build)
+
+
+def restore_sharded_state(cp: Checkpoint, mesh=None) -> Any:
+    """Rebuild the pytree; mesh-sharded leaves are device_put with
+    their saved PartitionSpec over `mesh` (required when the checkpoint
+    holds sharded leaves — the restoring mesh must use the same axis
+    NAMES, the device count is free to differ as long as the saved
+    global shapes still divide)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    (payload,) = restore_checkpoint(cp, _sharded_ok=True)
+    leaves = []
+    for leaf in payload["leaves"]:
+        if isinstance(leaf, _ShardedMarker):
+            if mesh is None:
+                raise ValueError(
+                    "restore_sharded_state: checkpoint holds sharded "
+                    "leaves; pass mesh=")
+            sh = NamedSharding(mesh, PartitionSpec(*leaf.spec))
+            leaves.append(jax.device_put(jnp.asarray(leaf.np_value), sh))
+        else:
+            leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(payload["treedef"], leaves)
+
+
+def restore_sharded_state_from_file(path: Union[str, os.PathLike],
+                                    mesh=None) -> Any:
+    with open(path, "rb") as stream:
+        cp = Checkpoint.read(stream)
+    return restore_sharded_state(cp, mesh=mesh)
