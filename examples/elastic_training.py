@@ -1,0 +1,122 @@
+"""Elastic training: checkpoint a sharded train state mid-run, restore
+onto a DIFFERENTLY-SHAPED mesh, and continue — the next losses match
+the uninterrupted run exactly.
+
+Reference analog: checkpoint/restart across a changed locality count
+(libs/full/checkpoint + the batch-environment restart story, SURVEY.md
+§5.3/§5.4). TPU-native form: every leaf of the train-state pytree
+records its PartitionSpec; restore re-places it over whatever mesh the
+resuming run built (same axis NAMES, any device count whose shape still
+divides the arrays).
+
+Flow:
+  1. build a tiny MLP train state sharded over mesh A = (dp=4, tp=2)
+  2. train k steps; save_sharded_state_to_file
+  3. throw everything away ("the job was preempted")
+  4. rebuild on mesh B = (dp=2, tp=4); restore_sharded_state_from_file
+  5. train the remaining steps on BOTH paths; compare losses
+
+Usage: python examples/elastic_training.py [steps]
+       (--cpu-mesh 8 for the virtual-device run the tests use)
+"""
+
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+from examples._common import setup_platform  # noqa: E402
+
+argv = setup_platform()
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    steps = int(argv[0]) if argv else 6
+    half = steps // 2
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import hpx_tpu as hpx
+
+    devs = np.array(jax.devices())
+    if devs.size < 8:
+        print(f"elastic_training: need 8 devices, have {devs.size} — "
+              "run with --cpu-mesh 8")
+        return 0
+    mesh_a = Mesh(devs[:8].reshape(4, 2), ("dp", "tp"))
+    mesh_b = Mesh(devs[:8].reshape(2, 4), ("dp", "tp"))
+
+    d_in, d_hid = 16, 32
+    rng = np.random.default_rng(0)
+    x_host = rng.standard_normal((8, d_in)).astype(np.float32)
+    y_host = rng.standard_normal((8, 1)).astype(np.float32)
+
+    def place(mesh, state):
+        return {
+            "w1": jax.device_put(state["w1"],
+                                 NamedSharding(mesh, P(None, "tp"))),
+            "w2": jax.device_put(state["w2"],
+                                 NamedSharding(mesh, P("tp", None))),
+            "step": state["step"],
+        }
+
+    def data(mesh):
+        return (jax.device_put(x_host, NamedSharding(mesh, P("dp"))),
+                jax.device_put(y_host, NamedSharding(mesh, P("dp"))))
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x @ params["w1"])
+        return ((h @ params["w2"] - y) ** 2).mean()
+
+    @jax.jit
+    def step_fn(state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            {"w1": state["w1"], "w2": state["w2"]}, x, y)
+        return {"w1": state["w1"] - 0.05 * grads["w1"],
+                "w2": state["w2"] - 0.05 * grads["w2"],
+                "step": state["step"] + 1}, loss
+
+    init = {"w1": rng.standard_normal((d_in, d_hid)).astype(np.float32),
+            "w2": rng.standard_normal((d_hid, 1)).astype(np.float32),
+            "step": 0}
+
+    # ---- uninterrupted reference on mesh A
+    ref = place(mesh_a, init)
+    xa, ya = data(mesh_a)
+    ref_losses = []
+    for _ in range(steps):
+        ref, lo = step_fn(ref, xa, ya)
+        ref_losses.append(float(lo))
+
+    # ---- elastic run: half on A, checkpoint, restore on B, finish
+    state = place(mesh_a, init)
+    for _ in range(half):
+        state, _ = step_fn(state, xa, ya)
+
+    with tempfile.NamedTemporaryFile(suffix=".ckpt") as f:
+        hpx.save_sharded_state_to_file(f.name, state).get(timeout=120)
+        del state                                   # "preempted"
+        resumed = hpx.restore_sharded_state_from_file(f.name,
+                                                      mesh=mesh_b)
+
+    xb, yb = data(mesh_b)
+    res_losses = []
+    for _ in range(steps - half):
+        resumed, lo = step_fn(resumed, xb, yb)
+        res_losses.append(float(lo))
+
+    tail = ref_losses[half:]
+    ok = np.allclose(res_losses, tail, rtol=1e-5)
+    print(f"ref tail    : {[round(v, 6) for v in tail]}")
+    print(f"resumed (B) : {[round(v, 6) for v in res_losses]}")
+    print(f"mesh A {dict(mesh_a.shape)} -> mesh B {dict(mesh_b.shape)}; "
+          f"steps {int(resumed['step'])}/{steps}; "
+          f"match={'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -208,9 +208,12 @@ def _spec_entries(spec) -> tuple:
 
 def _sharded_payload(tree: Any) -> dict:
     """Flatten the pytree and lower mesh-sharded leaves to markers.
-    The device→host pulls (np.asarray) happen HERE, so callers run this
-    inside the build task — the training loop gets its future back
-    without waiting on multi-GB transfers."""
+    The device→host pulls (np.asarray) happen HERE — EAGERLY on the
+    caller, by design: a training loop with donated buffers
+    (jit(donate_argnums=...)) invalidates the old state the moment the
+    next step runs, so a deferred pull would race and read deleted
+    arrays. The snapshot is synchronous; serialization still runs as a
+    task."""
     import jax
     import numpy as np
     from jax.sharding import NamedSharding
@@ -227,17 +230,23 @@ def _sharded_payload(tree: Any) -> dict:
     return {"treedef": treedef, "leaves": [enc(x) for x in leaves]}
 
 
+def _sharded_build(tree: Any):
+    """ONE build closure for both save paths (in-memory and file): the
+    wire format cannot diverge between them. The payload (device→host
+    snapshot) is taken eagerly — see _sharded_payload — and the closure
+    serializes it as a task."""
+    payload = _sharded_payload(tree)
+    return lambda: Checkpoint(serialize(_encode([payload])))
+
+
 def save_sharded_state(tree: Any) -> Future:
     """-> future<Checkpoint> of a PYTREE of jax arrays (a train state:
     params/opt state/step...). Mesh-sharded leaves record their
     PartitionSpec; restore_sharded_state re-places them on a given
     mesh. Unsharded leaves (host scalars, numpy, single-device arrays)
-    ride the plain checkpoint path. Device→host pulls and serialization
-    both run as a task."""
-    def build() -> Checkpoint:
-        return Checkpoint(serialize(_encode([_sharded_payload(tree)])))
-
-    return async_(build)
+    ride the plain checkpoint path. The device→host snapshot is taken
+    before this returns (donation-safe); serialization runs as a task."""
+    return async_(_sharded_build(tree))
 
 
 def save_sharded_state_to_file(path: Union[str, os.PathLike],
@@ -245,10 +254,7 @@ def save_sharded_state_to_file(path: Union[str, os.PathLike],
     """Same atomic tmp+rename publish and io-pool write as
     save_checkpoint_to_file — a kill mid-save never clobbers the
     previous good checkpoint."""
-    def build() -> Checkpoint:
-        return Checkpoint(serialize(_encode([_sharded_payload(tree)])))
-
-    return _save_to_file(path, build)
+    return _save_to_file(path, _sharded_build(tree))
 
 
 def restore_sharded_state(cp: Checkpoint, mesh=None) -> Any:
@@ -258,10 +264,17 @@ def restore_sharded_state(cp: Checkpoint, mesh=None) -> Any:
     NAMES, the device count is free to differ as long as the saved
     global shapes still divide)."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    (payload,) = restore_checkpoint(cp, _sharded_ok=True)
+    restored = restore_checkpoint(cp, _sharded_ok=True)
+    payload = restored[0] if len(restored) == 1 else None
+    if not (isinstance(payload, dict)
+            and {"treedef", "leaves"} <= payload.keys()):
+        # friendly in BOTH directions of API mix-up (the reverse case
+        # raises from _decode with a pointer to restore_sharded_state)
+        raise ValueError(
+            "not a sharded-state checkpoint; restore it with "
+            "restore_checkpoint(_from_file)")
     leaves = []
     for leaf in payload["leaves"]:
         if isinstance(leaf, _ShardedMarker):
@@ -270,7 +283,11 @@ def restore_sharded_state(cp: Checkpoint, mesh=None) -> Any:
                     "restore_sharded_state: checkpoint holds sharded "
                     "leaves; pass mesh=")
             sh = NamedSharding(mesh, PartitionSpec(*leaf.spec))
-            leaves.append(jax.device_put(jnp.asarray(leaf.np_value), sh))
+            # device_put takes host memory straight to the SHARDED
+            # layout; a jnp.asarray first would materialize the full
+            # global array on device 0 (OOM for states that only fit
+            # sharded — the exact elasticity use case)
+            leaves.append(jax.device_put(leaf.np_value, sh))
         else:
             leaves.append(leaf)
     return jax.tree_util.tree_unflatten(payload["treedef"], leaves)

@@ -57,6 +57,7 @@ def run_distributed(name, localities, timeout=480):
     ("pipeline_train.py", ["4"]),
     ("serving_demo.py", []),
     ("load_balancing.py", []),
+    ("elastic_training.py", ["6"]),
 ])
 def test_example_single(name, args):
     r = run_example(name, *args)

@@ -361,3 +361,8 @@ class TestShardedStateCheckpoint:
                                        self._state(mesh2d)).get(timeout=60)
         with pytest.raises(ValueError, match="restore_sharded_state"):
             hpx.restore_checkpoint_from_file(path)
+
+    def test_sharded_restore_rejects_plain_checkpoint(self, mesh2d):
+        cp = hpx.save_checkpoint(42).get()
+        with pytest.raises(ValueError, match="restore_checkpoint"):
+            hpx.restore_sharded_state(cp, mesh=mesh2d)
