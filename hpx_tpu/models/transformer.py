@@ -741,10 +741,13 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
     kc, vc = kv
     h = _ln(x, lp["ln1"])
     q, k, v = _qkv_proj(h, lp)
+    sq = x.shape[1]
     if cfg.rope:
-        # rotate at the write position; the cache stores POST-rope k,
-        # so cached entries never need re-rotation
-        pos = jnp.atleast_1d(jnp.asarray(write_at))
+        # rotate at the write positions; the cache stores POST-rope k,
+        # so cached entries never need re-rotation. sq > 1 is the
+        # WINDOW decode (speculative verification / chunked prefill):
+        # token i of the window sits at write_at + i.
+        pos = jnp.asarray(write_at) + jnp.arange(sq)
         q, k = _rope(q, pos, cfg), _rope(k, pos, cfg)
     kc = jax.lax.dynamic_update_slice_in_dim(kc, k, write_at, axis=1)
     vc = jax.lax.dynamic_update_slice_in_dim(vc, v, write_at, axis=1)
@@ -754,8 +757,11 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
     qg = q.reshape(b, sq, nkv, g, hd)
     s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
     pos = jnp.arange(kc.shape[1])
-    s = jnp.where(pos[None, None, None, None, :] <= write_at, s,
-                  -jnp.inf)
+    # per-query causal horizon: window token i attends cache positions
+    # <= write_at + i (collapses to the old scalar mask at sq == 1)
+    qpos = jnp.asarray(write_at) + jnp.arange(sq)
+    s = jnp.where(pos[None, None, None, None, :]
+                  <= qpos[None, None, None, :, None], s, -jnp.inf)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
     att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, sq, nq, hd)
     o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
@@ -783,19 +789,37 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
 
 
 def _decode_forward(params, caches, tok, pos, cfg, tp_axis=None):
-    """One decode token through every block: embed -> cached blocks ->
-    final ln -> tied-embedding logits. Returns (caches, f32 logits
-    [B, V]) — f32 so scan carries are dtype-stable whatever the model
-    dtype. Shared by generate() and beam_search(): any change to the
-    per-token forward lands in both decoders."""
-    x = params["emb"][tok][:, None, :]
+    """One decode token through every block: the W == 1 case of
+    _decode_window, so there is exactly ONE copy of the cached forward
+    — any change to it lands in generate(), beam_search(), and both
+    phases of speculative_generate(). Returns (caches, f32 logits
+    [B, V])."""
+    caches, logits = _decode_window(params, caches, tok[:, None], pos,
+                                    cfg, tp_axis=tp_axis)
+    return caches, logits[:, 0, :]
+
+
+def _decode_window(params, caches, toks, pos0, cfg, tp_axis=None,
+                   need_logits=True):
+    """A WINDOW of new tokens through the cached blocks in one pass:
+    toks [B, W] at positions pos0..pos0+W-1. Returns (caches, f32
+    logits [B, W, V]). One MXU-batched forward where a scan would run
+    W sequential steps — the speculative-verification / chunked-prefill
+    fast path (every weight is read once per window instead of once per
+    token, which is the whole memory-bandwidth case for speculative
+    decoding). need_logits=False is the cache-only prefill: skips the
+    final ln + [B, W, V] unembedding when the caller only wants the KV
+    side effects (returns (caches, None))."""
+    x = params["emb"][toks]
     new_caches = []
     for lp, kv in zip(params["layers"], caches):
-        x, kv = _block_decode(x, lp, kv, pos, cfg, tp_axis=tp_axis)
+        x, kv = _block_decode(x, lp, kv, pos0, cfg, tp_axis=tp_axis)
         new_caches.append(kv)
+    if not need_logits:
+        return new_caches, None
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-    return new_caches, logits[:, 0, :].astype(jnp.float32)
+    return new_caches, logits.astype(jnp.float32)
 
 
 def _prefill_scan(params, cfg, caches, prompt, logits0, tp_axis=None):
@@ -961,6 +985,105 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         out_specs=data_spec))
     prompt = jax.device_put(prompt, NamedSharding(mesh, data_spec))
     return prog(params, prompt)
+
+
+def speculative_generate(params, cfg: TransformerConfig,
+                         draft_params, draft_cfg: TransformerConfig,
+                         prompt: jax.Array, max_new: int = 32,
+                         k: int = 4) -> jax.Array:
+    """Greedy speculative decoding (Leviathan et al. shape, greedy
+    acceptance): a small DRAFT model proposes k tokens autoregressively,
+    the target model scores all k+1 positions in ONE window forward
+    (_decode_window — each target weight is read once per window instead
+    of once per token, which is the whole memory-bandwidth win), and the
+    longest agreeing prefix is accepted plus the target's own token at
+    the first disagreement. Every emitted token comes from the TARGET's
+    argmax, so the output matches generate(temperature=0) up to
+    floating-point argmax ties: the window and sequential forwards
+    reassociate sums (~1e-4 logit difference), so a position whose
+    top-2 target logits are closer than that can resolve either way —
+    the draft still never changes which DISTRIBUTION tokens come from.
+
+    Batches accept the MINIMUM agreement count across rows each round
+    (per-row counts would need per-row cache positions): correct for
+    every row — tokens below the minimum agree everywhere, and the
+    bonus token equals the draft token on rows that agreed further —
+    at reduced speedup for large batches. Single device; greedy only;
+    models must share the vocab (sizes may differ otherwise).
+
+    Cache staleness note: rejected draft entries stay in the caches
+    PAST the accepted position; they are harmless because the next
+    round rewrites positions sequentially from the rewound cursor and
+    the causal mask never lets a query see beyond its own position."""
+    if k < 1:
+        raise ValueError(f"speculative_generate: k must be >= 1, got {k}")
+    if draft_cfg.vocab != cfg.vocab:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
+    if max_new <= 0:
+        return prompt[:, :0].astype(jnp.int32)
+
+    b, plen = prompt.shape
+    # target windows start at plen+m-1 (m <= max_new-1) and span k+1
+    smax = plen + max_new + k
+
+    def fresh(c: TransformerConfig):
+        return [(jnp.zeros((b, smax, c.kv_heads, c.head_dim), c.dtype),
+                 jnp.zeros((b, smax, c.kv_heads, c.head_dim), c.dtype))
+                for _ in range(c.n_layers)]
+
+    def run(tp, dp, prompt):
+        # chunked prefill: the whole prompt in one window forward per
+        # model (the [B, plen, V] logits are transient; chunk the
+        # prompt if that ever matters)
+        t_caches, t_logits = _decode_window(tp, fresh(cfg), prompt, 0,
+                                            cfg)
+        # draft prefill is cache-only: its prompt logits are never read
+        d_caches, _ = _decode_window(dp, fresh(draft_cfg), prompt, 0,
+                                     draft_cfg, need_logits=False)
+        tok0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        out = jnp.zeros((b, max_new), jnp.int32).at[:, 0].set(tok0)
+
+        def cond(carry):
+            return carry[0] < max_new
+
+        def body(carry):
+            m, cur, out, t_caches, d_caches = carry
+            pos0 = plen + m - 1          # cur's sequence position
+
+            def dstep(c, j):
+                dc, tok = c
+                dc, lg = _decode_forward(dp, dc, tok, pos0 + j,
+                                         draft_cfg)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (dc, nxt), nxt
+
+            (d_caches, _), d = jax.lax.scan(
+                dstep, (d_caches, cur), jnp.arange(k))
+            d = d.T                                    # [B, k]
+            window = jnp.concatenate([cur[:, None], d], axis=1)
+            t_caches, lg = _decode_window(tp, t_caches, window, pos0,
+                                          cfg)
+            t = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B, k+1]
+            # longest all-rows-agree prefix; +1 bonus from the target.
+            # Every EMITTED token is t[:, j]: for j < a the draft
+            # agreed (d == t there by definition of a), at j == a it is
+            # the target's correction — so the scatter writes t itself.
+            matches = (d == t[:, :k]).astype(jnp.int32)
+            a = jnp.cumprod(matches, axis=1).sum(axis=1).min()
+            idx = m + jnp.arange(k + 1)
+            valid = (jnp.arange(k + 1) <= a) & (idx < max_new)
+            idx_safe = jnp.where(valid, idx, max_new)  # max_new: dropped
+            out = out.at[:, idx_safe].set(
+                jnp.where(valid[None, :], t, 0), mode="drop")
+            cur = jnp.take(t, a, axis=1)
+            return (jnp.minimum(m + a + 1, max_new), cur, out,
+                    t_caches, d_caches)
+
+        carry = (jnp.asarray(1), tok0, out, t_caches, d_caches)
+        return jax.lax.while_loop(cond, body, carry)[2]
+
+    return jax.jit(run)(params, draft_params, prompt)
 
 
 def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,

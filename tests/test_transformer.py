@@ -838,3 +838,74 @@ class TestQuantizedMoE:
                 == jax.tree.structure(specs)), "tree mismatch"
         for leaf in jax.tree.leaves(specs):
             assert isinstance(leaf, PartitionSpec), leaf
+
+
+# -- speculative decoding ----------------------------------------------------
+
+class TestSpeculativeDecoding:
+    """speculative_generate must emit generate(temperature=0)'s tokens
+    — the draft changes throughput, never content. (Exact equality
+    holds when no position's top-2 target logits are within the window
+    vs sequential forward's ~1e-4 reassociation gap; these f32 models
+    at fixed seeds have no such ties.)"""
+
+    DRAFT = tfm.TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                                  head_dim=8, n_layers=1, d_ff=32)
+
+    def test_window_forward_matches_sequential(self):
+        """_decode_window == a scan of _decode_forward on the same
+        tokens (validates the multi-token mask/rope generalization of
+        _block_decode directly)."""
+        params = tfm.init_params(CFG, jax.random.PRNGKey(3))
+        toks = jnp.array([[5, 9, 11, 2], [7, 1, 3, 8]], jnp.int32)
+        b, w = toks.shape
+        smax = 16
+
+        def fresh():
+            return [(jnp.zeros((b, smax, CFG.kv_heads, CFG.head_dim),
+                               CFG.dtype),
+                     jnp.zeros((b, smax, CFG.kv_heads, CFG.head_dim),
+                               CFG.dtype))
+                    for _ in range(CFG.n_layers)]
+
+        _, win_logits = tfm._decode_window(params, fresh(), toks, 0, CFG)
+        caches = fresh()
+        seq_logits = []
+        for i in range(w):
+            caches, lg = tfm._decode_forward(params, caches, toks[:, i],
+                                             i, CFG)
+            seq_logits.append(lg)
+        np.testing.assert_allclose(np.asarray(win_logits),
+                                   np.stack(seq_logits, axis=1),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_draft_equals_target_all_accepted(self):
+        params = tfm.init_params(CFG, jax.random.PRNGKey(6))
+        prompt = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        ref = tfm.generate(params, CFG, prompt, max_new=8)
+        out = tfm.speculative_generate(params, CFG, params, CFG, prompt,
+                                       max_new=8, k=3)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    @pytest.mark.parametrize("k", [1, 2, 4, 7])
+    def test_small_draft_matches_greedy(self, k):
+        params = tfm.init_params(CFG, jax.random.PRNGKey(6))
+        draft = tfm.init_params(self.DRAFT, jax.random.PRNGKey(7))
+        prompt = jnp.array([[1, 2, 3, 4], [9, 8, 7, 6],
+                            [0, 0, 0, 0]], jnp.int32)
+        ref = tfm.generate(params, CFG, prompt, max_new=11)
+        out = tfm.speculative_generate(params, CFG, draft, self.DRAFT,
+                                       prompt, max_new=11, k=k)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_rejects_bad_args(self):
+        params = tfm.init_params(CFG, jax.random.PRNGKey(6))
+        draft = tfm.init_params(self.DRAFT, jax.random.PRNGKey(7))
+        prompt = jnp.array([[1, 2]], jnp.int32)
+        with pytest.raises(ValueError, match="k must be"):
+            tfm.speculative_generate(params, CFG, draft, self.DRAFT,
+                                     prompt, max_new=4, k=0)
+        bad = dataclasses.replace(self.DRAFT, vocab=32)
+        with pytest.raises(ValueError, match="vocab"):
+            tfm.speculative_generate(params, CFG, draft, bad, prompt,
+                                     max_new=4)
