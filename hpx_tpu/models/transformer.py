@@ -822,23 +822,37 @@ def _decode_window(params, caches, toks, pos0, cfg, tp_axis=None,
     return new_caches, logits.astype(jnp.float32)
 
 
-def _prefill_scan(params, cfg, caches, prompt, logits0, tp_axis=None):
-    """Feed the prompt token-by-token into the caches; returns
-    (caches, logits after the LAST prompt token). Selection happens
-    outside — per-position sampling inside the scan would be computed
-    and discarded for all but the last position. Shared by generate()
-    and beam_search()."""
-    def prefill(carry, inp):
-        caches, _ = carry
-        tok, pos = inp
-        caches, logits = _decode_forward(params, caches, tok, pos, cfg,
-                                         tp_axis=tp_axis)
-        return (caches, logits), None
+# CHUNK tokens per prefill window: large enough that every weight read
+# amortizes over a full MXU tile of tokens, small enough that the
+# transient per-chunk [B, CHUNK, V] logits (last chunk only) and [B,
+# CHUNK, S] attention scores stay modest at long prompts
+_PREFILL_CHUNK = 128
 
-    (caches, last), _ = jax.lax.scan(
-        prefill, (caches, logits0),
-        (prompt.T, jnp.arange(prompt.shape[1])))
-    return caches, last
+
+def _prefill_window(params, cfg, caches, prompt, tp_axis=None,
+                    chunk: int = _PREFILL_CHUNK, need_logits=True,
+                    logits0=None):
+    """Feed the prompt into the caches in windowed one-pass chunks
+    (chunked prefill): each chunk of up to `chunk` tokens is ONE
+    _decode_window forward — every weight is read once per chunk
+    instead of once per token, the classic prefill-vs-decode
+    distinction. Returns (caches, logits after the LAST prompt token);
+    intermediate chunks run cache-only, as does everything when
+    need_logits=False (a draft model's prefill never reads logits).
+    `logits0` is the empty-prompt fallback result (callers build it
+    with the right sharding/vma). Shared by generate(), beam_search(),
+    and speculative_generate()."""
+    plen = prompt.shape[1]
+    last = logits0[:, None] if logits0 is not None else None
+    for s in range(0, plen, chunk):
+        e = min(plen, s + chunk)
+        caches, lg = _decode_window(params, caches, prompt[:, s:e], s,
+                                    cfg, tp_axis=tp_axis,
+                                    need_logits=need_logits
+                                    and e == plen)
+        if lg is not None:
+            last = lg
+    return caches, (last[:, -1] if need_logits else None)
 
 
 def generate(params, cfg: TransformerConfig, prompt: jax.Array,
@@ -933,15 +947,16 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
     def run(params, prompt):
         b_local = prompt.shape[0]
         caches = fresh_cache(b_local, cfg.kv_heads // tp)
-        # prefill: feed prompt tokens at positions 0..plen-1; the scan
-        # carries raw LOGITS and selection happens once afterwards —
-        # per-position sampling work inside the prefill scan would be
-        # computed and discarded for all but the last position
+        # chunked prefill: windowed one-pass forwards at positions
+        # 0..plen-1; selection happens once afterwards on the last
+        # position's logits. logits0 covers the empty-prompt edge
+        # (unconditional generation: argmax/sample over zeros).
         logits0 = jnp.zeros((b_local, cfg.vocab), jnp.float32)
         if mesh is not None:
             logits0 = _pvary(logits0, ("dp",))
-        caches, last_logits = _prefill_scan(params, cfg, caches, prompt,
-                                            logits0, tp_axis=tp_axis)
+        caches, last_logits = _prefill_window(params, cfg, caches,
+                                              prompt, tp_axis=tp_axis,
+                                              logits0=logits0)
         # t0 = the prediction following the last prompt token, drawn at
         # position plen-1 (same key fold the in-scan path would use)
         tok0 = select(last_logits, plen - 1, b_local)
@@ -990,7 +1005,8 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
 def speculative_generate(params, cfg: TransformerConfig,
                          draft_params, draft_cfg: TransformerConfig,
                          prompt: jax.Array, max_new: int = 32,
-                         k: int = 4) -> jax.Array:
+                         k: int = 4,
+                         return_stats: bool = False) -> jax.Array:
     """Greedy speculative decoding (Leviathan et al. shape, greedy
     acceptance): a small DRAFT model proposes k tokens autoregressively,
     the target model scores all k+1 positions in ONE window forward
@@ -1021,7 +1037,8 @@ def speculative_generate(params, cfg: TransformerConfig,
         raise ValueError(
             f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
     if max_new <= 0:
-        return prompt[:, :0].astype(jnp.int32)
+        empty = prompt[:, :0].astype(jnp.int32)
+        return (empty, 0) if return_stats else empty
 
     b, plen = prompt.shape
     # target windows start at plen+m-1 (m <= max_new-1) and span k+1
@@ -1033,22 +1050,20 @@ def speculative_generate(params, cfg: TransformerConfig,
                 for _ in range(c.n_layers)]
 
     def run(tp, dp, prompt):
-        # chunked prefill: the whole prompt in one window forward per
-        # model (the [B, plen, V] logits are transient; chunk the
-        # prompt if that ever matters)
-        t_caches, t_logits = _decode_window(tp, fresh(cfg), prompt, 0,
-                                            cfg)
+        t_caches, t_last = _prefill_window(
+            tp, cfg, fresh(cfg), prompt,
+            logits0=jnp.zeros((b, cfg.vocab), jnp.float32))
         # draft prefill is cache-only: its prompt logits are never read
-        d_caches, _ = _decode_window(dp, fresh(draft_cfg), prompt, 0,
-                                     draft_cfg, need_logits=False)
-        tok0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        d_caches, _ = _prefill_window(dp, draft_cfg, fresh(draft_cfg),
+                                      prompt, need_logits=False)
+        tok0 = jnp.argmax(t_last, axis=-1).astype(jnp.int32)
         out = jnp.zeros((b, max_new), jnp.int32).at[:, 0].set(tok0)
 
         def cond(carry):
             return carry[0] < max_new
 
         def body(carry):
-            m, cur, out, t_caches, d_caches = carry
+            m, cur, out, t_caches, d_caches, rounds = carry
             pos0 = plen + m - 1          # cur's sequence position
 
             def dstep(c, j):
@@ -1058,9 +1073,16 @@ def speculative_generate(params, cfg: TransformerConfig,
                 nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
                 return (dc, nxt), nxt
 
+            # k+1 steps, not k: the extra step feeds d_{k-1} so ITS KV
+            # lands at pos0+k — on a fully-accepted round the next
+            # round resumes past that slot, and a skipped write would
+            # leave a permanent zero-KV hole every later draft query
+            # attends (silently collapsing acceptance rates; outputs
+            # would stay correct, which is why only this comment and
+            # the hole test notice). The k+1-th PROPOSAL is discarded.
             (d_caches, _), d = jax.lax.scan(
-                dstep, (d_caches, cur), jnp.arange(k))
-            d = d.T                                    # [B, k]
+                dstep, (d_caches, cur), jnp.arange(k + 1))
+            d = d.T[:, :k]                             # [B, k]
             window = jnp.concatenate([cur[:, None], d], axis=1)
             t_caches, lg = _decode_window(tp, t_caches, window, pos0,
                                           cfg)
@@ -1078,10 +1100,15 @@ def speculative_generate(params, cfg: TransformerConfig,
                 jnp.where(valid[None, :], t, 0), mode="drop")
             cur = jnp.take(t, a, axis=1)
             return (jnp.minimum(m + a + 1, max_new), cur, out,
-                    t_caches, d_caches)
+                    t_caches, d_caches, rounds + 1)
 
-        carry = (jnp.asarray(1), tok0, out, t_caches, d_caches)
-        return jax.lax.while_loop(cond, body, carry)[2]
+        carry = (jnp.asarray(1), tok0, out, t_caches, d_caches,
+                 jnp.asarray(0))
+        fin = jax.lax.while_loop(cond, body, carry)
+        # rounds = target window forwards run: the efficiency metric —
+        # a healthy draft takes ~ceil((max_new-1)/(k+1)), a degraded
+        # one (e.g. a KV hole) collapses toward max_new-1
+        return (fin[2], fin[5]) if return_stats else fin[2]
 
     return jax.jit(run)(params, draft_params, prompt)
 
@@ -1113,9 +1140,9 @@ def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,
                    jnp.zeros((b, smax, nkv, hd), cfg.dtype))
                   for _ in range(cfg.n_layers)]
 
-        caches, logits = _prefill_scan(
+        caches, logits = _prefill_window(
             params, cfg, caches, prompt,
-            jnp.zeros((b, cfg.vocab), jnp.float32))
+            logits0=jnp.zeros((b, cfg.vocab), jnp.float32))
 
         # tile beams: all start identical; only beam 0 is live so the
         # duplicates can't multiply into the topk

@@ -909,3 +909,22 @@ class TestSpeculativeDecoding:
         with pytest.raises(ValueError, match="vocab"):
             tfm.speculative_generate(params, CFG, draft, bad, prompt,
                                      max_new=4)
+
+    def test_full_acceptance_rounds_near_minimal(self):
+        """Self-draft must accept ~k+1 tokens per round for the WHOLE
+        run. Regression: a draft-cache KV hole after a fully-accepted
+        round silently collapses later acceptance (outputs stay
+        correct — only the round count shows it)."""
+        import math as _math
+        params = tfm.init_params(CFG, jax.random.PRNGKey(6))
+        prompt = jnp.array([[1, 2, 3]], jnp.int32)
+        max_new, k = 20, 3
+        out, rounds = tfm.speculative_generate(
+            params, CFG, params, CFG, prompt, max_new=max_new, k=k,
+            return_stats=True)
+        ref = tfm.generate(params, CFG, prompt, max_new=max_new)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        # 19 tokens after tok0 at k+1=4 per round -> 5 rounds minimum;
+        # allow +1 slack for a float argmax tie, never the collapse
+        assert int(rounds) <= _math.ceil((max_new - 1) / (k + 1)) + 1, \
+            f"acceptance collapsed: {int(rounds)} rounds"
