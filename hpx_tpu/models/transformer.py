@@ -890,20 +890,7 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
     tp = dp = 1
     tp_axis = None
     if mesh is not None:
-        if cfg.n_experts > 0:
-            raise NotImplementedError(
-                "sharded decode supports dense models; MoE decodes "
-                "single-device (drop-free routing)")
-        names = mesh.axis_names
-        if "dp" not in names or "tp" not in names:
-            raise ValueError(f"decode mesh needs ('dp','tp'); has {names}")
-        dp, tp = mesh.shape["dp"], mesh.shape["tp"]
-        if nh % tp or cfg.kv_heads % tp:
-            raise ValueError(
-                f"heads (q={nh}, kv={cfg.kv_heads}) not divisible by "
-                f"tp={tp}")
-        if b % dp:
-            raise ValueError(f"batch {b} not divisible by dp={dp}")
+        dp, tp = _decode_mesh_check(cfg, mesh, b)
         tp_axis = "tp"       # size-1 tp: the psums are no-ops
 
     def fresh_cache(b_local, nh_local):
@@ -986,13 +973,7 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         return jax.jit(lambda p, t: run(p, t))(params, prompt)
 
     from jax.sharding import NamedSharding
-    from .quant import QTensor
-    if any(isinstance(x, QTensor) for x in jax.tree.leaves(
-            params, is_leaf=lambda x: isinstance(x, QTensor))):
-        from .quant import quantized_param_specs
-        pspecs = quantized_param_specs(cfg)   # scales follow channels
-    else:
-        pspecs = param_specs(cfg)
+    pspecs = _decode_pspecs(params, cfg)      # scales follow channels
     data_spec = P("dp", None)
     prog = jax.jit(shard_map(
         run, mesh=mesh,
@@ -1002,10 +983,42 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
     return prog(params, prompt)
 
 
+def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):
+    """Shared decode-mesh contract for generate()/speculative_generate:
+    ("dp","tp") axes, dense model, heads/batch divisible. Returns
+    (dp, tp)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "sharded decode supports dense models; MoE decodes "
+            "single-device (drop-free routing)")
+    names = mesh.axis_names
+    if "dp" not in names or "tp" not in names:
+        raise ValueError(f"decode mesh needs ('dp','tp'); has {names}")
+    dp, tp = mesh.shape["dp"], mesh.shape["tp"]
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        raise ValueError(
+            f"heads (q={cfg.n_heads}, kv={cfg.kv_heads}) not divisible "
+            f"by tp={tp}")
+    if batch % dp:
+        raise ValueError(f"batch {batch} not divisible by dp={dp}")
+    return dp, tp
+
+
+def _decode_pspecs(params, cfg: TransformerConfig):
+    """Param specs for sharded decode; quantized targets place scales
+    with their channels."""
+    from .quant import QTensor
+    if any(isinstance(x, QTensor) for x in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor))):
+        from .quant import quantized_param_specs
+        return quantized_param_specs(cfg)
+    return param_specs(cfg)
+
+
 def speculative_generate(params, cfg: TransformerConfig,
                          draft_params, draft_cfg: TransformerConfig,
                          prompt: jax.Array, max_new: int = 32,
-                         k: int = 4,
+                         k: int = 4, mesh=None,
                          return_stats: bool = False) -> jax.Array:
     """Greedy speculative decoding (Leviathan et al. shape, greedy
     acceptance): a small DRAFT model proposes k tokens autoregressively,
@@ -1024,8 +1037,14 @@ def speculative_generate(params, cfg: TransformerConfig,
     (per-row counts would need per-row cache positions): correct for
     every row — tokens below the minimum agree everywhere, and the
     bonus token equals the draft token on rows that agreed further —
-    at reduced speedup for large batches. Single device; greedy only;
-    models must share the vocab (sizes may differ otherwise).
+    at reduced speedup for large batches. Greedy only; models must
+    share the vocab (sizes may differ otherwise).
+
+    mesh=None runs single-device. A Mesh(("dp","tp")) runs the same
+    sharded-serving layout as generate() (dense targets; the draft is
+    replicated); the row-agreement minimum is then PER dp SHARD, and
+    each shard's decode loop runs its own trip count — with
+    return_stats the per-row rounds report their shard's count.
 
     Cache staleness note: rejected draft entries stay in the caches
     PAST the accepted position; they are harmless because the next
@@ -1040,24 +1059,53 @@ def speculative_generate(params, cfg: TransformerConfig,
         empty = prompt[:, :0].astype(jnp.int32)
         return (empty, 0) if return_stats else empty
 
+    from ..ops.attention import _pvary
+
     b, plen = prompt.shape
     # target windows start at plen+m-1 (m <= max_new-1) and span k+1
     smax = plen + max_new + k
 
-    def fresh(c: TransformerConfig):
-        return [(jnp.zeros((b, smax, c.kv_heads, c.head_dim), c.dtype),
-                 jnp.zeros((b, smax, c.kv_heads, c.head_dim), c.dtype))
-                for _ in range(c.n_layers)]
+    tp_size = 1
+    tp_axis = None
+    if mesh is not None:
+        # same mesh contract as generate() (dense only, dp x tp). The
+        # DRAFT is replicated (small by construction; each tp rank
+        # drafts redundantly and identically). Acceptance is
+        # per-dp-shard local, so the while_loop trip counts
+        # legitimately DIVERGE across dp shards — no collective
+        # crosses dp inside the loop, and tp groups stay in lockstep
+        # because their logits are psum-complete.
+        _dp_size, tp_size = _decode_mesh_check(cfg, mesh, b)
+        tp_axis = "tp"
 
-    def run(tp, dp, prompt):
-        t_caches, t_last = _prefill_window(
-            tp, cfg, fresh(cfg), prompt,
-            logits0=jnp.zeros((b, cfg.vocab), jnp.float32))
+    def fresh(c: TransformerConfig, b_local, nh_local, axes):
+        caches = [(jnp.zeros((b_local, smax, nh_local, c.head_dim),
+                             c.dtype),
+                   jnp.zeros((b_local, smax, nh_local, c.head_dim),
+                             c.dtype))
+                  for _ in range(c.n_layers)]
+        if mesh is not None:
+            caches = jax.tree.map(lambda z: _pvary(z, axes), caches)
+        return caches
+
+    def run(tgt, dft, prompt):
+        b_local = prompt.shape[0]
+        logits0 = jnp.zeros((b_local, cfg.vocab), jnp.float32)
+        if mesh is not None:
+            logits0 = _pvary(logits0, ("dp",))
+        t_caches = fresh(cfg, b_local, cfg.kv_heads // tp_size,
+                         ("dp", "tp"))
+        d_caches = fresh(draft_cfg, b_local, draft_cfg.kv_heads,
+                         ("dp",))
+        t_caches, t_last = _prefill_window(tgt, cfg, t_caches, prompt,
+                                           tp_axis=tp_axis,
+                                           logits0=logits0)
         # draft prefill is cache-only: its prompt logits are never read
-        d_caches, _ = _prefill_window(dp, draft_cfg, fresh(draft_cfg),
+        d_caches, _ = _prefill_window(dft, draft_cfg, d_caches,
                                       prompt, need_logits=False)
         tok0 = jnp.argmax(t_last, axis=-1).astype(jnp.int32)
-        out = jnp.zeros((b, max_new), jnp.int32).at[:, 0].set(tok0)
+        out = jnp.zeros((b_local, max_new),
+                        jnp.int32).at[:, 0].set(tok0)
 
         def cond(carry):
             return carry[0] < max_new
@@ -1068,7 +1116,7 @@ def speculative_generate(params, cfg: TransformerConfig,
 
             def dstep(c, j):
                 dc, tok = c
-                dc, lg = _decode_forward(dp, dc, tok, pos0 + j,
+                dc, lg = _decode_forward(dft, dc, tok, pos0 + j,
                                          draft_cfg)
                 nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
                 return (dc, nxt), nxt
@@ -1084,8 +1132,8 @@ def speculative_generate(params, cfg: TransformerConfig,
                 dstep, (d_caches, cur), jnp.arange(k + 1))
             d = d.T[:, :k]                             # [B, k]
             window = jnp.concatenate([cur[:, None], d], axis=1)
-            t_caches, lg = _decode_window(tp, t_caches, window, pos0,
-                                          cfg)
+            t_caches, lg = _decode_window(tgt, t_caches, window, pos0,
+                                          cfg, tp_axis=tp_axis)
             t = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B, k+1]
             # longest all-rows-agree prefix; +1 bonus from the target.
             # Every EMITTED token is t[:, j]: for j < a the draft
@@ -1102,15 +1150,37 @@ def speculative_generate(params, cfg: TransformerConfig,
             return (jnp.minimum(m + a + 1, max_new), cur, out,
                     t_caches, d_caches, rounds + 1)
 
-        carry = (jnp.asarray(1), tok0, out, t_caches, d_caches,
-                 jnp.asarray(0))
+        m0, r0 = jnp.asarray(1), jnp.asarray(0)
+        if mesh is not None:
+            # per-dp-shard loop state (trip counts may diverge)
+            m0, r0 = _pvary(m0, ("dp",)), _pvary(r0, ("dp",))
+        carry = (m0, tok0, out, t_caches, d_caches, r0)
         fin = jax.lax.while_loop(cond, body, carry)
         # rounds = target window forwards run: the efficiency metric —
         # a healthy draft takes ~ceil((max_new-1)/(k+1)), a degraded
-        # one (e.g. a KV hole) collapses toward max_new-1
-        return (fin[2], fin[5]) if return_stats else fin[2]
+        # one (e.g. a KV hole) collapses toward max_new-1. Sharded:
+        # reported per ROW (each row carries its dp shard's count).
+        if not return_stats:
+            return fin[2]
+        rounds = fin[5]
+        if mesh is not None:
+            rounds = jnp.broadcast_to(rounds, (b_local,))
+        return fin[2], rounds
 
-    return jax.jit(run)(params, draft_params, prompt)
+    if mesh is None:
+        return jax.jit(run)(params, draft_params, prompt)
+
+    from jax.sharding import NamedSharding
+    pspecs = _decode_pspecs(params, cfg)
+    dspecs = jax.tree.map(lambda _: P(), draft_params)
+    data_spec = P("dp", None)
+    out_spec = (data_spec, P("dp")) if return_stats else data_spec
+    prog = jax.jit(shard_map(
+        run, mesh=mesh,
+        in_specs=(pspecs, dspecs, data_spec),
+        out_specs=out_spec))
+    prompt = jax.device_put(prompt, NamedSharding(mesh, data_spec))
+    return prog(params, draft_params, prompt)
 
 
 def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,

@@ -928,3 +928,26 @@ class TestSpeculativeDecoding:
         # allow +1 slack for a float argmax tie, never the collapse
         assert int(rounds) <= _math.ceil((max_new - 1) / (k + 1)) + 1, \
             f"acceptance collapsed: {int(rounds)} rounds"
+
+    def test_sharded_matches_single_device(self, devices):
+        """dp2/tp2 speculative decode emits the same tokens as the
+        single-device run (per-dp-shard loops may diverge in trip
+        count; content must not)."""
+        from jax.sharding import Mesh
+        cfg = dataclasses.replace(CFG, n_kv_heads=2, rope=True)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(6))
+        draft = tfm.init_params(self.DRAFT, jax.random.PRNGKey(7))
+        prompt = jnp.array([[1, 2, 3, 4], [9, 8, 7, 6],
+                            [5, 5, 5, 5], [2, 4, 6, 8]], jnp.int32)
+        single = tfm.speculative_generate(params, cfg, draft,
+                                          self.DRAFT, prompt,
+                                          max_new=9, k=3)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "tp"))
+        sharded, rounds = tfm.speculative_generate(
+            tfm.shard_params(params, cfg, mesh), cfg, draft,
+            self.DRAFT, prompt, max_new=9, k=3, mesh=mesh,
+            return_stats=True)
+        np.testing.assert_array_equal(np.asarray(sharded),
+                                      np.asarray(single))
+        assert rounds.shape == (4,) and (np.asarray(rounds) >= 1).all()
