@@ -1,9 +1,10 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8/int4 quantization for serving.
 
 Reference analog: none (HPX has no ML serving); this is the standard
 TPU serving memory/bandwidth lever — decode is weight-bandwidth-bound,
-so storing the big matrices as int8 with per-output-channel scales
-cuts their HBM footprint and read traffic 2x vs bf16 (4x vs f32).
+so storing the big matrices as int8 (or packed int4 — two values per
+byte) with per-output-channel scales cuts their HBM footprint and read
+traffic 2x (4x) vs bf16.
 
 Scheme: symmetric absmax per OUTPUT channel — scales are computed over
 the contraction axis of each weight's einsum (axis map below), so
@@ -33,8 +34,9 @@ from typing import Any, Dict, NamedTuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["QTensor", "quantize_params", "dequant", "quantized_bytes",
-           "quantized_param_specs", "shard_quantized"]
+__all__ = ["QTensor", "QTensor4", "quantize_params", "dequant",
+           "quantized_bytes", "quantized_param_specs",
+           "shard_quantized", "quantized_bits"]
 
 
 class QTensor(NamedTuple):
@@ -60,6 +62,15 @@ _CONTRACT_AXES = {"wqkv": (1,), "wq": (0,), "wkv": (1,),
 # precision decides WHICH experts run; it is tiny and quality-critical).
 _MOE_CONTRACT_AXES = {"w1": (1,), "w2": (1,)}
 
+# int4 packing axis per weight: a CONTRACTION axis (scales have size 1
+# on every contraction axis, so any of them keeps nibble pairs under
+# one scale), preferring one that is UNSHARDED in the decode specs —
+# wo packs head_dim (axis 1), not the tp-sharded heads axis. w1/w2's
+# only contraction axis (d_ff for w2) IS tp-sharded; shard_quantized
+# validates the per-shard packed size stays whole.
+_PACK_AXES = {"wqkv": 1, "wq": 0, "wkv": 1, "wo": 1, "w1": 0, "w2": 0}
+_MOE_PACK_AXES = {"w1": 1, "w2": 1}
+
 
 def _quantize(w: jax.Array, axes) -> QTensor:
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
@@ -71,34 +82,50 @@ def _quantize(w: jax.Array, axes) -> QTensor:
 
 
 def dequant(x: Any, dtype=jnp.bfloat16) -> Any:
-    """QTensor -> dense (fused into the consuming matmul under jit);
-    anything else passes through."""
+    """QTensor/QTensor4 -> dense (fused into the consuming matmul under
+    jit); anything else passes through."""
     if isinstance(x, QTensor):
         return (x.q.astype(jnp.float32) * x.s).astype(dtype)
+    if isinstance(x, QTensor4):
+        return (_unpack4(x.q, x.axis).astype(jnp.float32)
+                * x.s).astype(dtype)
     return x
 
 
-def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+def quantize_params(params: Dict[str, Any],
+                    bits: int = 8) -> Dict[str, Any]:
     """Quantize every layer matmul weight; ln scales, biases, and the
     embedding stay in the model dtype. (Layer layout — MHA vs GQA —
-    is discovered from the param dict keys.)"""
+    is discovered from the param dict keys.) bits=8 stores int8;
+    bits=4 packs two values per byte (4x smaller than bf16; coarser
+    15-level grid — measure quality on your model)."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+
+    def qz(w, axes, pack_axis):
+        if bits == 8:
+            return _quantize(w, axes)
+        return _quantize4(w, axes, pack_axis)
+
     out = {"emb": params["emb"], "ln_f": params["ln_f"], "layers": []}
     for lp in params["layers"]:
         qlp = {}
         for name, w in lp.items():
             if name == "moe":
                 qlp["moe"] = {
-                    mn: (_quantize(mw, _MOE_CONTRACT_AXES[mn])
+                    mn: (qz(mw, _MOE_CONTRACT_AXES[mn],
+                            _MOE_PACK_AXES[mn])
                          if mn in _MOE_CONTRACT_AXES else mw)
                     for mn, mw in w.items()}
                 continue
             axes = _CONTRACT_AXES.get(name)
-            qlp[name] = _quantize(w, axes) if axes is not None else w
+            qlp[name] = qz(w, axes, _PACK_AXES[name]) \
+                if axes is not None else w
         out["layers"].append(qlp)
     return out
 
 
-def quantized_param_specs(cfg) -> Dict[str, Any]:
+def quantized_param_specs(cfg, bits: int = 8) -> Dict[str, Any]:
     """PartitionSpecs matching quantize_params' tree: each quantized
     weight becomes QTensor(q=<dense weight spec>, s=<that spec with the
     contracted axes unsharded>). Scales keep dims of size 1 exactly on
@@ -108,34 +135,67 @@ def quantized_param_specs(cfg) -> Dict[str, Any]:
     shard-local and exact under tensor parallelism."""
     from jax.sharding import PartitionSpec as P
     from .transformer import param_specs
-    def qspec(wspec, axes):
+    def qspec(wspec, axes, pack_axis):
         dims = list(wspec)
         for ax in axes:
             if ax < len(dims):
                 dims[ax] = None
+        if bits == 4:
+            # packing halves the pack axis; where that axis is sharded
+            # (w2's d_ff) shard_quantized validates divisibility
+            return QTensor4(wspec, P(*dims), pack_axis)
         return QTensor(q=wspec, s=P(*dims))
 
     specs = param_specs(cfg)
     for lp in specs["layers"]:
         for name, axes in _CONTRACT_AXES.items():
             if name in lp:
-                lp[name] = qspec(lp[name], axes)
+                lp[name] = qspec(lp[name], axes, _PACK_AXES[name])
         if "moe" in lp:
             # param_specs shares ONE moe dict across layers (shallow
             # per-layer copies) — copy before mutating or every layer
             # re-wraps the same specs into nested QTensors
             m = dict(lp["moe"])
             for mn, axes in _MOE_CONTRACT_AXES.items():
-                m[mn] = qspec(m[mn], axes)
+                m[mn] = qspec(m[mn], axes, _MOE_PACK_AXES[mn])
             lp["moe"] = m
     return specs
 
 
+def quantized_bits(tree: Any) -> int:
+    """4 when the tree holds QTensor4 leaves, else 8."""
+    has4 = any(isinstance(x, QTensor4) for x in jax.tree.leaves(
+        tree, is_leaf=lambda x: isinstance(x, (QTensor, QTensor4))))
+    return 4 if has4 else 8
+
+
 def shard_quantized(qparams: Dict[str, Any], cfg, mesh) -> Dict[str, Any]:
-    """shard_params for quantized trees (int8 q and f32 s placed by
-    quantized_param_specs)."""
+    """shard_params for quantized trees (int8/packed-int4 q and f32 s
+    placed by quantized_param_specs). int4: where a packed axis is also
+    sharded (w2's d_ff over tp), every shard must hold a whole number
+    of nibble pairs — validated here with a clear error instead of a
+    device_put shape failure."""
     from .transformer import _place
-    return _place(qparams, quantized_param_specs(cfg), mesh)
+    specs = quantized_param_specs(cfg, quantized_bits(qparams))
+
+    def check(leaf, spec):
+        if not isinstance(leaf, QTensor4):
+            return
+        name = list(spec.q)[leaf.axis] if leaf.axis < len(spec.q) \
+            else None
+        if name is None:
+            return
+        shards = mesh.shape[name]
+        if leaf.q.shape[leaf.axis] % shards:
+            raise ValueError(
+                f"int4 packed axis {leaf.axis} (sharded over "
+                f"'{name}'={shards}) holds {leaf.q.shape[leaf.axis]} "
+                f"nibble pairs — not divisible; the original dim must "
+                f"be a multiple of 2*{shards} for int4 + tp")
+
+    jax.tree.map(check, qparams, specs,
+                 is_leaf=lambda x: isinstance(x, (QTensor, QTensor4)))
+    return _place(qparams, specs, mesh)
 
 
 def quantized_bytes(tree: Any) -> int:
@@ -144,3 +204,59 @@ def quantized_bytes(tree: Any) -> int:
     for leaf in jax.tree.leaves(tree):
         total += leaf.size * leaf.dtype.itemsize
     return total
+
+
+# ---------------------------------------------------------------------------
+# int4 weight-only (two nibbles per int8 byte)
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class QTensor4:
+    """Packed int4 values + broadcastable f32 scales. Adjacent pairs
+    along `axis` (a CONTRACTION axis — never tp-sharded in the decode
+    specs, so packing halves an unsharded dim) share one int8 byte:
+    element 2i in the low nibble, 2i+1 in the high — `axis` is chosen
+    per weight by _PACK_AXES (an unsharded contraction axis where one
+    exists; shard_quantized validates the rest). `axis` is pytree aux
+    data (static), q/s are leaves."""
+
+    def __init__(self, q, s, axis: int):
+        self.q, self.s, self.axis = q, s, axis
+
+    def tree_flatten(self):
+        return (self.q, self.s), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, axis, children):
+        return cls(children[0], children[1], axis)
+
+
+def _pack4(q: jax.Array, axis: int) -> jax.Array:
+    """int8 values in [-7, 7] -> packed nibbles along `axis`."""
+    n = q.shape[axis]
+    if n % 2:
+        raise ValueError(
+            f"int4 pack axis {axis} must be even-sized; got {n}")
+    pre = q.shape[:axis] + (n // 2, 2) + q.shape[axis + 1:]
+    qr = q.reshape(pre)
+    lo = jnp.take(qr, 0, axis=axis + 1)
+    hi = jnp.take(qr, 1, axis=axis + 1)
+    return ((lo & 0x0F) | (hi << 4)).astype(jnp.int8)
+
+
+def _unpack4(p: jax.Array, axis: int) -> jax.Array:
+    """packed nibbles -> int8 values (sign via arithmetic shifts)."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)   # sign-extend low
+    hi = jnp.right_shift(p, 4)                      # arithmetic: signed
+    st = jnp.stack([lo, hi], axis=axis + 1)
+    shape = p.shape[:axis] + (p.shape[axis] * 2,) + p.shape[axis + 1:]
+    return st.reshape(shape)
+
+
+def _quantize4(w: jax.Array, axes, pack_axis: int) -> QTensor4:
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
+                   keepdims=True)
+    s = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -7, 7
+                 ).astype(jnp.int8)
+    return QTensor4(_pack4(q, pack_axis), s, pack_axis)

@@ -1005,13 +1005,14 @@ def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):
 
 
 def _decode_pspecs(params, cfg: TransformerConfig):
-    """Param specs for sharded decode; quantized targets place scales
-    with their channels."""
-    from .quant import QTensor
-    if any(isinstance(x, QTensor) for x in jax.tree.leaves(
-            params, is_leaf=lambda x: isinstance(x, QTensor))):
+    """Param specs for sharded decode; quantized targets (int8 or
+    packed int4) place scales with their channels."""
+    from .quant import QTensor, QTensor4, quantized_bits
+    if any(isinstance(x, (QTensor, QTensor4)) for x in jax.tree.leaves(
+            params,
+            is_leaf=lambda x: isinstance(x, (QTensor, QTensor4)))):
         from .quant import quantized_param_specs
-        return quantized_param_specs(cfg)
+        return quantized_param_specs(cfg, quantized_bits(params))
     return param_specs(cfg)
 
 

@@ -951,3 +951,105 @@ class TestSpeculativeDecoding:
         np.testing.assert_array_equal(np.asarray(sharded),
                                       np.asarray(single))
         assert rounds.shape == (4,) and (np.asarray(rounds) >= 1).all()
+
+
+class TestInt4Quantization:
+    def test_pack_unpack_roundtrip(self):
+        from hpx_tpu.models import quant
+        rng = np.random.default_rng(0)
+        for shape, axis in [((8, 6), 0), ((3, 8, 4), 1), ((2, 4, 6), 2)]:
+            q = jnp.asarray(rng.integers(-7, 8, shape), jnp.int8)
+            packed = quant._pack4(q, axis)
+            assert packed.shape[axis] == shape[axis] // 2
+            np.testing.assert_array_equal(
+                np.asarray(quant._unpack4(packed, axis)), np.asarray(q))
+        with pytest.raises(ValueError, match="even"):
+            quant._pack4(jnp.zeros((3, 4), jnp.int8), 0)
+
+    def test_int4_error_bounded_and_4x_smaller(self):
+        from hpx_tpu.models import quant
+        cfg = tfm.TransformerConfig(vocab=64, d_model=64, n_heads=4,
+                                    head_dim=16, n_layers=2, d_ff=128)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(40))
+        q4 = quant.quantize_params(params, bits=4)
+        assert quant.quantized_bits(q4) == 4
+        # per-element roundtrip error <= s/2 (15-level symmetric grid)
+        w = params["layers"][0]["w1"]
+        t4 = q4["layers"][0]["w1"]
+        back = np.asarray(quant.dequant(t4, jnp.float32))
+        err = np.abs(back - np.asarray(w, np.float32))
+        assert (err <= np.asarray(t4.s) / 2 + 1e-6).all()
+        # storage: ~4x smaller than f32 weights (scales add a little)
+        dense_b = quant.quantized_bytes(params["layers"])
+        q4_b = quant.quantized_bytes(q4["layers"])
+        assert dense_b / q4_b > 3.0, (dense_b, q4_b)
+        q8_b = quant.quantized_bytes(
+            quant.quantize_params(params)["layers"])
+        assert q8_b / q4_b > 1.6, (q8_b, q4_b)
+
+    def test_int4_decode_runs_and_logits_close(self):
+        from hpx_tpu.models import quant
+        cfg = tfm.TransformerConfig(vocab=64, d_model=64, n_heads=4,
+                                    head_dim=16, n_layers=2, d_ff=128)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(40))
+        q4 = quant.quantize_params(params, bits=4)
+        prompt = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        out = tfm.generate(q4, cfg, prompt, max_new=6)
+        assert out.shape == (1, 6)
+        assert (np.asarray(out) >= 0).all() and \
+            (np.asarray(out) < cfg.vocab).all()
+
+    def test_int4_tp_decode_bit_identical(self, devices):
+        from jax.sharding import Mesh
+        from hpx_tpu.models import quant
+        mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "tp"))
+        cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                    head_dim=8, n_layers=2, d_ff=64)
+        q4 = quant.quantize_params(
+            tfm.init_params(cfg, jax.random.PRNGKey(50)), bits=4)
+        prompt = jnp.array([[1, 2, 3], [4, 5, 6], [7, 8, 9], [3, 1, 2]],
+                           jnp.int32)
+        ref = tfm.generate(q4, cfg, prompt, max_new=8)
+        sharded = quant.shard_quantized(q4, cfg, mesh)
+        got = tfm.generate(sharded, cfg, prompt, max_new=8, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+    def test_int4_moe_decode_runs(self):
+        from hpx_tpu.models import quant
+        cfg = tfm.TransformerConfig(vocab=32, d_model=32, n_heads=4,
+                                    head_dim=8, n_layers=1, d_ff=64,
+                                    n_experts=4)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(9))
+        q4 = quant.quantize_params(params, bits=4)
+        out = tfm.generate(q4, cfg,
+                           jnp.array([[1, 2]], jnp.int32), max_new=4)
+        assert out.shape == (1, 4)
+
+    def test_int4_odd_local_heads_pack_unsharded_axis(self, devices):
+        """wo packs head_dim, not the tp-sharded heads axis: n_heads=6
+        with tp=2 (odd local head count) must shard + decode fine."""
+        from jax.sharding import Mesh
+        from hpx_tpu.models import quant
+        mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "tp"))
+        cfg = tfm.TransformerConfig(vocab=64, d_model=24, n_heads=6,
+                                    head_dim=8, n_layers=1, d_ff=64)
+        q4 = quant.quantize_params(
+            tfm.init_params(cfg, jax.random.PRNGKey(51)), bits=4)
+        prompt = jnp.array([[1, 2], [3, 4], [5, 6], [7, 8]], jnp.int32)
+        ref = tfm.generate(q4, cfg, prompt, max_new=5)
+        got = tfm.generate(quant.shard_quantized(q4, cfg, mesh), cfg,
+                           prompt, max_new=5, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+    def test_int4_sharded_pack_axis_validated(self, devices):
+        """d_ff not a multiple of 2*tp: clear error, not a device_put
+        shape failure."""
+        from jax.sharding import Mesh
+        from hpx_tpu.models import quant
+        mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "tp"))
+        cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                    head_dim=8, n_layers=1, d_ff=66)
+        q4 = quant.quantize_params(
+            tfm.init_params(cfg, jax.random.PRNGKey(52)), bits=4)
+        with pytest.raises(ValueError, match="nibble pairs"):
+            quant.shard_quantized(q4, cfg, mesh)
