@@ -107,14 +107,13 @@ def fft1d_body(a, axis: str, n_shards: int, n: int,
 # whole-array helpers (one cached jitted program per shape/mesh)
 # ---------------------------------------------------------------------------
 
+from ..core.programs import cached_program
+
 _PROGRAMS: dict = {}
 
 
 def _program(key, build):
-    prog = _PROGRAMS.get(key)
-    if prog is None:
-        prog = _PROGRAMS[key] = build()
-    return prog
+    return cached_program(_PROGRAMS, key, build)
 
 
 def _shard_prog(mesh, spec, body):

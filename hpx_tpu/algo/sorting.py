@@ -340,12 +340,11 @@ def sort_sharded(v: Any, mesh, axis: str = "x",
     elif method not in ("sample", "odd_even"):
         raise ValueError(f"sort_sharded: unknown method {method!r} "
                          "(expected 'sample' or 'odd_even')")
-    key_ = (method, mesh, axis)
-    prog = _SHARDED_SORT_PROGRAMS.get(key_)
-    if prog is None:
-        build = (_build_sample_sort if method == "sample"
-                 else _build_odd_even)
-        prog = _SHARDED_SORT_PROGRAMS[key_] = build(mesh, axis)
+    from ..core.programs import cached_program
+    build = (_build_sample_sort if method == "sample"
+             else _build_odd_even)
+    prog = cached_program(_SHARDED_SORT_PROGRAMS, (method, mesh, axis),
+                          lambda: build(mesh, axis))
     return prog(v)
 
 
@@ -356,11 +355,10 @@ def sort_sharded_by_key(keys: Any, values: Any, mesh,
     every exchange as payload (lossless bit transport — payload NaN
     bit patterns survive). STABLE: the global-id tiebreak preserves
     original order for equal keys."""
-    key_ = ("sample_by_key", mesh, axis)
-    prog = _SHARDED_SORT_PROGRAMS.get(key_)
-    if prog is None:
-        prog = _SHARDED_SORT_PROGRAMS[key_] = _build_sample_sort(
-            mesh, axis, with_payload=True)
+    from ..core.programs import cached_program
+    prog = cached_program(
+        _SHARDED_SORT_PROGRAMS, ("sample_by_key", mesh, axis),
+        lambda: _build_sample_sort(mesh, axis, with_payload=True))
     return prog(keys, values)
 
 

@@ -1,0 +1,22 @@
+"""One get-or-build memo for compiled programs.
+
+Reference analog: none needed — this is the TPU-side consequence of
+XLA's trace-once model: any API that builds a traced closure per call
+(decode entry points, sharded algorithm builders, FFT plans) must memo
+the compiled program on the closure's BAKED constants or every call
+retraces. One shared helper so cache policy (say, eviction or a debug
+counter) has one home; each module keeps its own dict so keys never
+collide across subsystems.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+
+def cached_program(cache: Dict[Any, Any], key: Any,
+                   build: Callable[[], Any]) -> Any:
+    prog = cache.get(key)
+    if prog is None:
+        prog = cache[key] = build()
+    return prog

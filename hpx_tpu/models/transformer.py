@@ -37,6 +37,7 @@ __all__ = ["TransformerConfig", "init_params", "make_train_step",
            "make_opt_state", "generate", "make_pipelined_train_step",
            "stack_pipeline_params", "shard_pipeline_params",
            "pipelined_param_specs", "interleave_pipeline_params",
+           "speculative_generate", "speculative_sample",
            "deinterleave_pipeline_params", "prepare_pipeline_params",
            "beam_search"]
 
@@ -904,8 +905,10 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
                                   caches)
         return caches
 
-    def select(logits, pos, b_local):
-        """Next token from [B_local, V] logits at position `pos`."""
+    def select(logits, pos, b_local, karg):
+        """Next token from [B_local, V] logits at position `pos`.
+        `karg` is the PRNG key as a TRACED argument — baking it into
+        the closure would force a recompile per key."""
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
         scaled = logits.astype(jnp.float32) / temperature
@@ -915,7 +918,7 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         # keys fold in (position, GLOBAL row): sharded == single-device
         base = (jax.lax.axis_index("dp") * b_local if mesh is not None
                 else 0)
-        kp = jax.random.fold_in(key, pos)
+        kp = jax.random.fold_in(karg, pos)
         keys = jax.vmap(lambda r: jax.random.fold_in(kp, r))(
             base + jnp.arange(b_local))
         return jax.vmap(jax.random.categorical)(keys, scaled)
@@ -924,14 +927,14 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         return _decode_forward(params, caches, tok, pos, cfg,
                                tp_axis=tp_axis)
 
-    def step_token(params, carry, inp):
+    def step_token(params, karg, carry, inp):
         caches, _prev = carry
         tok, pos = inp
         caches, logits = forward_token(params, caches, tok, pos)
-        nxt = select(logits, pos, tok.shape[0])
+        nxt = select(logits, pos, tok.shape[0], karg)
         return (caches, nxt), nxt
 
-    def run(params, prompt):
+    def run(params, prompt, karg):
         b_local = prompt.shape[0]
         caches = fresh_cache(b_local, cfg.kv_heads // tp)
         # chunked prefill: windowed one-pass forwards at positions
@@ -946,8 +949,8 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
                                               logits0=logits0)
         # t0 = the prediction following the last prompt token, drawn at
         # position plen-1 (same key fold the in-scan path would use)
-        tok0 = select(last_logits, plen - 1, b_local)
-        step = functools.partial(step_token, params)
+        tok0 = select(last_logits, plen - 1, b_local, karg)
+        step = functools.partial(step_token, params, karg)
         # decode: feed back the selected token; each step emits the
         # token it FEEDS — emitting the step's own prediction instead
         # would drop t0 and shift the whole output by one.
@@ -969,18 +972,45 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
             gen, (caches, tok0, done0), jnp.arange(plen, smax))
         return toks.T                                  # [B_local, max_new]
 
+    karg = key if key is not None else jax.random.PRNGKey(0)
+    ck = ("generate", cfg, b, plen, max_new, temperature, top_k,
+          eos_id, mesh, _tree_key(params))
     if mesh is None:
-        return jax.jit(lambda p, t: run(p, t))(params, prompt)
+        prog = _cached_program(ck, lambda: jax.jit(run))
+        return prog(params, prompt, karg)
 
     from jax.sharding import NamedSharding
-    pspecs = _decode_pspecs(params, cfg)      # scales follow channels
     data_spec = P("dp", None)
-    prog = jax.jit(shard_map(
-        run, mesh=mesh,
-        in_specs=(pspecs, data_spec),
-        out_specs=data_spec))
+
+    def build():
+        pspecs = _decode_pspecs(params, cfg)  # scales follow channels
+        return jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=(pspecs, data_spec, P()),
+            out_specs=data_spec))
+
+    prog = _cached_program(ck, build)
     prompt = jax.device_put(prompt, NamedSharding(mesh, data_spec))
-    return prog(params, prompt)
+    return prog(params, prompt, karg)
+
+
+# Compiled serving programs, keyed by everything the traced closures
+# BAKE IN (config, shapes, decode options, mesh, param-tree structure).
+# Without this, every generate()/beam_search()/speculative_* call
+# builds a fresh closure and jit RETRACES — repeated serving calls pay
+# a full compile each time. jit still retraces internally if the traced
+# ARG shapes change under one cache key, so the key only needs the
+# closure constants.
+_PROGRAMS: Dict[Any, Any] = {}
+
+
+def _cached_program(key_, build):
+    from ..core.programs import cached_program
+    return cached_program(_PROGRAMS, key_, build)
+
+
+def _tree_key(tree) -> Any:
+    return jax.tree_util.tree_structure(tree)
 
 
 def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):
@@ -1014,6 +1044,21 @@ def _decode_pspecs(params, cfg: TransformerConfig):
         from .quant import quantized_param_specs
         return quantized_param_specs(cfg, quantized_bits(params))
     return param_specs(cfg)
+
+
+
+def _accept_scatter(out, m, a, emis, k, max_new):
+    """Shared accept-and-emit step for both speculative decoders: write
+    emissions 0..a at columns m..m+a of `out` (the max_new sentinel
+    index + mode='drop' is the out-of-bounds clamp), return the new
+    cursor token and advanced count. emis: [B, k+1]."""
+    idx = m + jnp.arange(k + 1)
+    valid = (jnp.arange(k + 1) <= a) & (idx < max_new)
+    idx_safe = jnp.where(valid, idx, max_new)      # max_new: dropped
+    out = out.at[:, idx_safe].set(
+        jnp.where(valid[None, :], emis, 0), mode="drop")
+    cur = jnp.take(emis, a, axis=1)
+    return out, cur, jnp.minimum(m + a + 1, max_new)
 
 
 def speculative_generate(params, cfg: TransformerConfig,
@@ -1142,14 +1187,8 @@ def speculative_generate(params, cfg: TransformerConfig,
             # the target's correction — so the scatter writes t itself.
             matches = (d == t[:, :k]).astype(jnp.int32)
             a = jnp.cumprod(matches, axis=1).sum(axis=1).min()
-            idx = m + jnp.arange(k + 1)
-            valid = (jnp.arange(k + 1) <= a) & (idx < max_new)
-            idx_safe = jnp.where(valid, idx, max_new)  # max_new: dropped
-            out = out.at[:, idx_safe].set(
-                jnp.where(valid[None, :], t, 0), mode="drop")
-            cur = jnp.take(t, a, axis=1)
-            return (jnp.minimum(m + a + 1, max_new), cur, out,
-                    t_caches, d_caches, rounds + 1)
+            out, cur, m = _accept_scatter(out, m, a, t, k, max_new)
+            return (m, cur, out, t_caches, d_caches, rounds + 1)
 
         m0, r0 = jnp.asarray(1), jnp.asarray(0)
         if mesh is not None:
@@ -1168,20 +1207,156 @@ def speculative_generate(params, cfg: TransformerConfig,
             rounds = jnp.broadcast_to(rounds, (b_local,))
         return fin[2], rounds
 
+    ck = ("spec_gen", cfg, draft_cfg, b, plen, max_new, k, mesh,
+          return_stats, _tree_key(params), _tree_key(draft_params))
     if mesh is None:
-        return jax.jit(run)(params, draft_params, prompt)
+        prog = _cached_program(ck, lambda: jax.jit(run))
+        return prog(params, draft_params, prompt)
 
     from jax.sharding import NamedSharding
-    pspecs = _decode_pspecs(params, cfg)
-    dspecs = jax.tree.map(lambda _: P(), draft_params)
     data_spec = P("dp", None)
-    out_spec = (data_spec, P("dp")) if return_stats else data_spec
-    prog = jax.jit(shard_map(
-        run, mesh=mesh,
-        in_specs=(pspecs, dspecs, data_spec),
-        out_specs=out_spec))
+
+    def build():
+        pspecs = _decode_pspecs(params, cfg)
+        dspecs = jax.tree.map(lambda _: P(), draft_params)
+        out_spec = (data_spec, P("dp")) if return_stats else data_spec
+        return jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=(pspecs, dspecs, data_spec),
+            out_specs=out_spec))
+
+    prog = _cached_program(ck, build)
     prompt = jax.device_put(prompt, NamedSharding(mesh, data_spec))
     return prog(params, draft_params, prompt)
+
+
+
+def speculative_sample(params, cfg: TransformerConfig,
+                       draft_params, draft_cfg: TransformerConfig,
+                       prompt: jax.Array, max_new: int = 32,
+                       k: int = 4, temperature: float = 1.0,
+                       key: Optional[jax.Array] = None,
+                       return_stats: bool = False) -> jax.Array:
+    """SAMPLED speculative decoding — the exact acceptance-rejection
+    algorithm (speculative sampling): draft j proposes d_j ~ q_j, the
+    target scores the window in one forward, d_j is accepted with
+    probability min(1, p_j(d_j)/q_j(d_j)), and the first rejection
+    resamples from norm(relu(p_a - q_a)). The emitted sequence is
+    distributed EXACTLY as sampling the target alone (the residual
+    construction cancels the draft's bias; with q padded to zero past
+    the proposals, the all-accepted bonus draw from p_k is the same
+    formula). Each round folds its round index into the PRNG key, so a
+    position redrafted after a rejection gets FRESH randomness — key
+    reuse across rounds would correlate draws and break exactness.
+
+    Single device, batch == 1 (the latency-sensitive single-stream
+    case: per-row acceptance counts would need per-row cache
+    positions). Greedy/batched/sharded speculation: see
+    speculative_generate."""
+    if key is None:
+        raise ValueError("speculative_sample needs a PRNG key")
+    if temperature <= 0.0:
+        raise ValueError(
+            "speculative_sample is the sampled algorithm; temperature "
+            "must be > 0 (greedy: speculative_generate)")
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"speculative_sample is single-stream (batch == 1); got "
+            f"batch {prompt.shape[0]}")
+    if k < 1:
+        raise ValueError(f"speculative_sample: k must be >= 1, got {k}")
+    if draft_cfg.vocab != cfg.vocab:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
+    if max_new <= 0:
+        empty = prompt[:, :0].astype(jnp.int32)
+        return (empty, 0) if return_stats else empty
+
+    plen = prompt.shape[1]
+    smax = plen + max_new + k
+    V = cfg.vocab
+
+    def fresh(c: TransformerConfig):
+        return [(jnp.zeros((1, smax, c.kv_heads, c.head_dim), c.dtype),
+                 jnp.zeros((1, smax, c.kv_heads, c.head_dim), c.dtype))
+                for _ in range(c.n_layers)]
+
+    def probs(logits):
+        return jax.nn.softmax(logits.astype(jnp.float32) / temperature,
+                              axis=-1)
+
+    def run(tgt, dft, prompt, karg):
+        t_caches, t_last = _prefill_window(
+            tgt, cfg, fresh(cfg), prompt,
+            logits0=jnp.zeros((1, V), jnp.float32))
+        d_caches, _ = _prefill_window(dft, draft_cfg, fresh(draft_cfg),
+                                      prompt, need_logits=False)
+        tok0 = jax.random.categorical(
+            jax.random.fold_in(karg, 0),
+            t_last[0] / temperature).astype(jnp.int32)[None]
+        out = jnp.zeros((1, max_new), jnp.int32).at[:, 0].set(tok0)
+
+        def cond(carry):
+            return carry[0] < max_new
+
+        def body(carry):
+            m, cur, out, t_caches, d_caches, rounds = carry
+            pos0 = plen + m - 1
+            kr = jax.random.fold_in(karg, rounds + 1)  # fresh per round
+
+            def dstep(c, j):
+                dc, tok = c
+                dc, lg = _decode_forward(dft, dc, tok, pos0 + j,
+                                         draft_cfg)
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(jax.random.fold_in(kr, 1), j),
+                    lg[0] / temperature).astype(jnp.int32)[None]
+                return (dc, nxt), (nxt, lg)
+
+            # k+1 steps: the extra one lands d_{k-1}'s KV (see
+            # speculative_generate's KV-hole note); its proposal and
+            # logits are discarded
+            (d_caches, _), (dtoks, dlogits) = jax.lax.scan(
+                dstep, (d_caches, cur), jnp.arange(k + 1))
+            d = dtoks[:k, 0]                           # [k]
+            q = probs(dlogits[:k, 0])                  # [k, V]
+
+            window = jnp.concatenate([cur[:, None], d[None, :]], axis=1)
+            t_caches, lg = _decode_window(tgt, t_caches, window, pos0,
+                                          cfg)
+            p = probs(lg[0])                           # [k+1, V]
+
+            pd = p[jnp.arange(k), d]
+            qd = q[jnp.arange(k), d]
+            u = jax.random.uniform(jax.random.fold_in(kr, 2), (k,))
+            accept = u < jnp.minimum(1.0, pd / qd)
+            a = jnp.where(accept.all(), k,
+                          jnp.argmin(accept))         # first rejection
+            # rejection resample from norm(relu(p_a - q_a)); with q
+            # padded to a zero row at k, a == k (all accepted) makes
+            # the SAME formula the bonus draw from p_k
+            q_pad = jnp.concatenate([q, jnp.zeros((1, V))], axis=0)
+            resid = jnp.maximum(p[a] - q_pad[a], 0.0)
+            z = resid.sum()
+            dist = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30), p[a])
+            e_a = jax.random.categorical(
+                jax.random.fold_in(kr, 3),
+                jnp.log(dist)).astype(jnp.int32)
+            d_pad = jnp.concatenate([d, jnp.zeros(1, jnp.int32)])
+            emis = jnp.where(jnp.arange(k + 1) < a, d_pad, e_a)
+            out, cur, m = _accept_scatter(out, m, a, emis[None, :], k,
+                                          max_new)
+            return (m, cur, out, t_caches, d_caches, rounds + 1)
+
+        carry = (jnp.asarray(1), tok0, out, t_caches, d_caches,
+                 jnp.asarray(0))
+        fin = jax.lax.while_loop(cond, body, carry)
+        return (fin[2], fin[5]) if return_stats else fin[2]
+
+    ck = ("spec_sample", cfg, draft_cfg, plen, max_new, k, temperature,
+          return_stats, _tree_key(params), _tree_key(draft_params))
+    prog = _cached_program(ck, lambda: jax.jit(run))
+    return prog(params, draft_params, prompt, key)
 
 
 def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,
@@ -1204,7 +1379,6 @@ def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,
     smax = plen + max_new
     hd = cfg.head_dim
 
-    @jax.jit
     def run(params, prompt):
         nkv = cfg.kv_heads
         caches = [(jnp.zeros((b, smax, nkv, hd), cfg.dtype),
@@ -1247,7 +1421,9 @@ def beam_search(params, cfg: TransformerConfig, prompt: jax.Array,
         scores = jnp.take_along_axis(scores, order, axis=1)
         return hist, scores
 
-    hist, scores = run(params, prompt)
+    ck = ("beam", cfg, b, plen, max_new, w, _tree_key(params))
+    prog = _cached_program(ck, lambda: jax.jit(run))
+    hist, scores = prog(params, prompt)
     if return_all:
         return hist, scores
     return hist[:, 0, :]
