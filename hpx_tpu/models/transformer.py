@@ -1047,6 +1047,19 @@ def _decode_pspecs(params, cfg: TransformerConfig):
 
 
 
+
+def _pin_after_eos(out, eos_id):
+    """Pin every position AFTER a row's first eos to eos — the same
+    observable behavior as generate()'s done-row pinning (a finished
+    row keeps emitting eos), applied as a post-pass so the speculative
+    loops stay eos-free inside."""
+    hit = (out == eos_id)
+    after = jnp.cumsum(hit.astype(jnp.int32), axis=1) >= 1
+    prev = jnp.concatenate(
+        [jnp.zeros_like(after[:, :1]), after[:, :-1]], axis=1)
+    return jnp.where(prev, jnp.int32(eos_id), out)
+
+
 def _accept_scatter(out, m, a, emis, k, max_new):
     """Shared accept-and-emit step for both speculative decoders: write
     emissions 0..a at columns m..m+a of `out` (the max_new sentinel
@@ -1065,6 +1078,7 @@ def speculative_generate(params, cfg: TransformerConfig,
                          draft_params, draft_cfg: TransformerConfig,
                          prompt: jax.Array, max_new: int = 32,
                          k: int = 4, mesh=None,
+                         eos_id: Optional[int] = None,
                          return_stats: bool = False) -> jax.Array:
     """Greedy speculative decoding (Leviathan et al. shape, greedy
     acceptance): a small DRAFT model proposes k tokens autoregressively,
@@ -1196,19 +1210,22 @@ def speculative_generate(params, cfg: TransformerConfig,
             m0, r0 = _pvary(m0, ("dp",)), _pvary(r0, ("dp",))
         carry = (m0, tok0, out, t_caches, d_caches, r0)
         fin = jax.lax.while_loop(cond, body, carry)
+        toks = fin[2] if eos_id is None else _pin_after_eos(fin[2],
+                                                            eos_id)
         # rounds = target window forwards run: the efficiency metric —
         # a healthy draft takes ~ceil((max_new-1)/(k+1)), a degraded
         # one (e.g. a KV hole) collapses toward max_new-1. Sharded:
         # reported per ROW (each row carries its dp shard's count).
         if not return_stats:
-            return fin[2]
+            return toks
         rounds = fin[5]
         if mesh is not None:
             rounds = jnp.broadcast_to(rounds, (b_local,))
-        return fin[2], rounds
+        return toks, rounds
 
     ck = ("spec_gen", cfg, draft_cfg, b, plen, max_new, k, mesh,
-          return_stats, _tree_key(params), _tree_key(draft_params))
+          eos_id, return_stats, _tree_key(params),
+          _tree_key(draft_params))
     if mesh is None:
         prog = _cached_program(ck, lambda: jax.jit(run))
         return prog(params, draft_params, prompt)
@@ -1236,6 +1253,7 @@ def speculative_sample(params, cfg: TransformerConfig,
                        prompt: jax.Array, max_new: int = 32,
                        k: int = 4, temperature: float = 1.0,
                        key: Optional[jax.Array] = None,
+                       eos_id: Optional[int] = None,
                        return_stats: bool = False) -> jax.Array:
     """SAMPLED speculative decoding — the exact acceptance-rejection
     algorithm (speculative sampling): draft j proposes d_j ~ q_j, the
@@ -1351,10 +1369,13 @@ def speculative_sample(params, cfg: TransformerConfig,
         carry = (jnp.asarray(1), tok0, out, t_caches, d_caches,
                  jnp.asarray(0))
         fin = jax.lax.while_loop(cond, body, carry)
-        return (fin[2], fin[5]) if return_stats else fin[2]
+        toks = fin[2] if eos_id is None else _pin_after_eos(fin[2],
+                                                            eos_id)
+        return (toks, fin[5]) if return_stats else toks
 
     ck = ("spec_sample", cfg, draft_cfg, plen, max_new, k, temperature,
-          return_stats, _tree_key(params), _tree_key(draft_params))
+          eos_id, return_stats, _tree_key(params),
+          _tree_key(draft_params))
     prog = _cached_program(ck, lambda: jax.jit(run))
     return prog(params, draft_params, prompt, key)
 

@@ -1138,3 +1138,26 @@ class TestSpeculativeSampling:
             ref[int(np.asarray(r)[0, 1])] += 1
         tv = 0.5 * np.abs(spec / n - ref / n).sum()
         assert tv < 0.15, (tv, spec, ref)
+
+
+def test_speculative_eos_matches_generate():
+    """eos pinning through speculative decode matches generate's
+    done-row pinning exactly (greedy)."""
+    params = tfm.init_params(CFG, jax.random.PRNGKey(6))
+    draft = tfm.init_params(TestSpeculativeDecoding.DRAFT,
+                            jax.random.PRNGKey(7))
+    prompt = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    plain = np.asarray(tfm.generate(params, CFG, prompt, max_new=10))
+    eos = int(plain[0, 2])            # a token greedy actually emits
+    ref = tfm.generate(params, CFG, prompt, max_new=10, eos_id=eos)
+    out = tfm.speculative_generate(params, CFG, draft,
+                                   TestSpeculativeDecoding.DRAFT,
+                                   prompt, max_new=10, k=3, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # sampled path: tail after first eos is pinned
+    o2 = np.asarray(tfm.speculative_sample(
+        params, CFG, draft, TestSpeculativeDecoding.DRAFT, prompt[:1],
+        max_new=10, k=3, key=jax.random.PRNGKey(3), eos_id=eos))
+    hits = np.where(o2[0] == eos)[0]
+    if hits.size:
+        assert (o2[0, hits[0]:] == eos).all()
