@@ -80,6 +80,14 @@ class TransformerConfig:
     # position space.
     rope: bool = False
     rope_theta: float = 10000.0
+    # striped sequence parallelism (Striped Attention): shard r of the
+    # sp ring holds tokens r, r+sp, ... instead of a contiguous chunk,
+    # so causal ring steps do balanced half-work (~2x wall clock on
+    # causal rings; see ops/attention.stripe_sequence). make_train_step
+    # stripes the batch itself (one all_to_all each way per step);
+    # positions stay GLOBAL so weights are layout-independent — decode
+    # and checkpoints are unaffected.
+    striped_ring: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -266,14 +274,20 @@ def _block(x, lp, cfg: TransformerConfig, sp_size: int, dp_size: int):
     h = _ln(x, lp["ln1"])
     q, k, v = _qkv_proj(h, lp)
     if cfg.rope:
-        # GLOBAL positions: this shard owns tokens
-        # [idx*S_local, (idx+1)*S_local) of the ring's position space
+        # GLOBAL positions: contiguous shards own [idx*S_local, ...);
+        # striped shards own idx, idx+sp, ...
         s_local = q.shape[1]
-        pos = jax.lax.axis_index("sp") * s_local + jnp.arange(s_local)
+        if cfg.striped_ring:
+            pos = jax.lax.axis_index("sp") + sp_size * jnp.arange(
+                s_local)
+        else:
+            pos = jax.lax.axis_index("sp") * s_local + jnp.arange(
+                s_local)
         q, k = _rope(q, pos, cfg), _rope(k, pos, cfg)
     # GQA layouts pass straight through: ring_attention_sharded
     # broadcasts grouped K/V itself on the paths that need it
-    att = ring_attention_sharded(q, k, v, "sp", sp_size, causal=True)
+    att = ring_attention_sharded(q, k, v, "sp", sp_size, causal=True,
+                                 striped=cfg.striped_ring)
     o = jnp.einsum("bsnh,nhd->bsd", att, lp["wo"])
     o = jax.lax.psum(o, "tp")              # Megatron row-parallel close
     x = x + o
@@ -384,10 +398,10 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer: Any = None):
                 params, grads)
             return new_params, loss
 
-        return jax.jit(shard_map(
-            step, mesh=mesh,
-            in_specs=(pspecs, data_spec, data_spec),
-            out_specs=(pspecs, P())))
+        prog = shard_map(step, mesh=mesh,
+                         in_specs=(pspecs, data_spec, data_spec),
+                         out_specs=(pspecs, P()))
+        return _jit_maybe_striped(prog, cfg, sp_size)
 
     ospecs = _opt_state_specs(cfg, optimizer)
 
@@ -398,10 +412,27 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer: Any = None):
             lambda p, u: p + u.astype(p.dtype), params, updates)
         return new_params, opt_state, loss
 
-    return jax.jit(shard_map(
+    prog_opt = shard_map(
         step_opt, mesh=mesh,
         in_specs=(pspecs, ospecs, data_spec, data_spec),
-        out_specs=(pspecs, ospecs, P())))
+        out_specs=(pspecs, ospecs, P()))
+    return _jit_maybe_striped(prog_opt, cfg, sp_size)
+
+
+def _jit_maybe_striped(prog, cfg: TransformerConfig, sp_size: int):
+    """jit `prog`, striping the LAST TWO args (tokens, targets) over
+    the sp ring first when cfg.striped_ring — one wrapper for the SGD
+    and optimizer step shapes so the two paths cannot diverge."""
+    if not (cfg.striped_ring and sp_size > 1):
+        return jax.jit(prog)
+    from ..ops.attention import stripe_sequence
+
+    def outer(*args):
+        head, (tokens, targets) = args[:-2], args[-2:]
+        return prog(*head, stripe_sequence(tokens, sp_size),
+                    stripe_sequence(targets, sp_size))
+
+    return jax.jit(outer)
 
 
 def _opt_state_specs(cfg: TransformerConfig, optimizer: Any):
@@ -589,6 +620,8 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
     (params, loss) with plain-SGD update, matching make_train_step's
     optimizer=None contract.
 
+    striped_ring is not wired here (no sp axis to stripe) and raises.
+
     The schedule stashes final-stage outputs into an [M, ...] buffer
     and runs the loss head ONCE per device after the scan; the only
     dead head work is that single post-scan pass on the pp-1 non-last
@@ -606,6 +639,10 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
     come back in that layout; invert with
     deinterleave_pipeline_params).
     """
+    if cfg.striped_ring:
+        raise NotImplementedError(
+            "striped_ring is wired for make_train_step's sp ring; the "
+            "pipelined step has no sp axis to stripe")
     if cfg.n_experts > 0:
         raise NotImplementedError(
             "pipeline-parallel MoE is not supported; use make_train_step "

@@ -372,3 +372,68 @@ class TestBlockResolution:
         # q from env, k from the tuned table — not a hardcoded 1024
         assert ap.resolve_blocks(4096, 4096, True) == (256, 512)
         monkeypatch.setattr(ap, "_blocks_table", None)
+
+
+class TestStripedRing:
+    """Striped Attention: stripe_sequence layout + per-step offsets in
+    {0, -1} balance causal ring work. Results must match the
+    contiguous ring / reference exactly (same math, reordered)."""
+
+    def test_stripe_roundtrip_and_layout(self):
+        from hpx_tpu.ops.attention import (stripe_sequence,
+                                           unstripe_sequence)
+        x = jnp.arange(24).reshape(1, 24)
+        y = stripe_sequence(x, 4)
+        # shard r of 4 holds tokens r, r+4, ...
+        np.testing.assert_array_equal(
+            np.asarray(y)[0, :6], [0, 4, 8, 12, 16, 20])
+        np.testing.assert_array_equal(np.asarray(
+            unstripe_sequence(y, 4)), np.asarray(x))
+        with pytest.raises(ValueError, match="divisible"):
+            stripe_sequence(x, 5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_striped_ring_matches_reference(self, causal, mesh1d):
+        from hpx_tpu.ops.attention import ring_attention
+        mesh = make_mesh((8,), ("sp",))
+        q, k, v = _qkv(seed=11)
+        want = reference_attention(q, k, v, causal)
+        got = ring_attention(q, k, v, mesh, "sp", causal, striped=True)
+        _close(got, want, jnp.float32)
+
+    def test_striped_flash_chunk_offsets(self):
+        """The flash path's striped offsets, simulated on the host the
+        same way test_flash_chunk_ring_matches_reference does: chunk
+        (i, j) folds with d = 0 (j <= i) or -1 — the result, after
+        unstriping, is the reference."""
+        from hpx_tpu.ops.attention import (stripe_sequence,
+                                           unstripe_sequence)
+        from hpx_tpu.ops.attention_pallas import flash_attention_chunk
+        q, k, v = _qkv(seed=12)
+        want = reference_attention(q, k, v, True)
+        nsh, sq = 4, S // 4
+        qs = stripe_sequence(q, nsh)
+        ks = stripe_sequence(k, nsh)
+        vs = stripe_sequence(v, nsh)
+        outs = []
+        for i in range(nsh):
+            qc = jnp.moveaxis(qs[:, i * sq:(i + 1) * sq], 2, 1
+                              ).reshape(B * N, sq, H)
+            acc = jnp.zeros((B * N, sq, H), jnp.float32)
+            m = jnp.full((B * N, sq, 128), -1e30, jnp.float32)
+            l = jnp.zeros((B * N, sq, 128), jnp.float32)
+            for j in range(nsh):
+                kc = jnp.moveaxis(ks[:, j * sq:(j + 1) * sq], 2, 1
+                                  ).reshape(B * N, sq, H)
+                vc = jnp.moveaxis(vs[:, j * sq:(j + 1) * sq], 2, 1
+                                  ).reshape(B * N, sq, H)
+                acc, m, l = flash_attention_chunk(
+                    qc, kc, vc, acc, m, l,
+                    jnp.int32(0 if j <= i else -1),
+                    causal=True, block_q=8, block_k=8)
+            den = jnp.where(l[:, :, :1] > 0, l[:, :, :1], 1.0)
+            o = (acc / den).reshape(B, N, sq, H)
+            outs.append(jnp.moveaxis(o, 1, 2))
+        got = unstripe_sequence(
+            jnp.concatenate(outs, axis=1), nsh).astype(q.dtype)
+        _close(got, want, jnp.float32)
