@@ -274,15 +274,10 @@ def _block(x, lp, cfg: TransformerConfig, sp_size: int, dp_size: int):
     h = _ln(x, lp["ln1"])
     q, k, v = _qkv_proj(h, lp)
     if cfg.rope:
-        # GLOBAL positions: contiguous shards own [idx*S_local, ...);
-        # striped shards own idx, idx+sp, ...
-        s_local = q.shape[1]
-        if cfg.striped_ring:
-            pos = jax.lax.axis_index("sp") + sp_size * jnp.arange(
-                s_local)
-        else:
-            pos = jax.lax.axis_index("sp") * s_local + jnp.arange(
-                s_local)
+        # GLOBAL positions from THE layout definition the ring uses
+        from ..ops.attention import ring_positions
+        pos = ring_positions(jax.lax.axis_index("sp"), sp_size,
+                             q.shape[1], cfg.striped_ring)
         q, k = _rope(q, pos, cfg), _rope(k, pos, cfg)
     # GQA layouts pass straight through: ring_attention_sharded
     # broadcasts grouped K/V itself on the paths that need it

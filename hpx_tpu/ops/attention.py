@@ -29,7 +29,7 @@ from jax.sharding import PartitionSpec as P
 __all__ = [
     "auto_attention", "reference_attention", "blockwise_attention",
     "ring_attention", "ring_attention_sharded", "ulysses_attention",
-    "stripe_sequence", "unstripe_sequence",
+    "stripe_sequence", "unstripe_sequence", "ring_positions",
 ]
 
 
@@ -216,6 +216,25 @@ def unstripe_sequence(x: jax.Array, p: int, axis: int = 1) -> jax.Array:
     return stripe_sequence(x, n // p, axis=axis)
 
 
+def ring_positions(rank, nshards: int, sq: int, striped: bool):
+    """GLOBAL token positions of ring shard `rank`: contiguous shards
+    own [rank*sq, (rank+1)*sq); striped shards own rank, rank+p, ...
+    THE one definition — the ring paths and RoPE all use it, so the
+    layouts can never diverge."""
+    if striped:
+        return rank + nshards * jnp.arange(sq)
+    return rank * sq + jnp.arange(sq)
+
+
+def ring_offset(idx, src, sq: int, striped: bool):
+    """The kernels' causal offset d for chunk (q-rank idx, k-rank src):
+    contiguous d = q_global_start - k_global_start; striped layouts
+    reduce to d = 0 (src <= idx) or -1 — see stripe_sequence."""
+    if striped:
+        return jnp.where(src <= idx, 0, -1).astype(jnp.int32)
+    return (idx - src) * sq
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Any,
                    axis: str = "sp", causal: bool = False,
                    striped: bool = False) -> jax.Array:
@@ -295,22 +314,15 @@ def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
             # handles GQA natively (grouped K/V tiles)
             from .attention_pallas import flash_attention
             return flash_attention(qc, kc, vc, causal)
-        # the ring-chunk kernel (and its custom_vjp backward, which
-        # rotates dK/dV partials with their chunks) folds matching head
-        # counts only, so the FLASH ring pre-broadcasts grouped K/V and
-        # pays the expanded ppermute volume. Teaching the chunk+bwd
-        # kernels grouped tiles (as plain flash_attention has) would
-        # recover the wire saving; until then long-ring GQA trades ICI
-        # bytes for kernel speed here, while the XLA branch below keeps
-        # chunks grouped on the wire.
-        kc, vc = _expand_kv(qc, kc, vc)
+        # GQA rides the ring GROUPED: the chunk kernel reads shared
+        # K/V tiles via the same BlockSpec row remap plain flash uses,
+        # and the backward's dK/dV partials accumulate (group-summed)
+        # in the kv-head layout — every ppermute hop moves only the
+        # kv heads, the whole wire saving of GQA.
         return _ring_flash(qc, kc, vc, axis, nshards, causal, striped)
     b, sq, n, h = qc.shape
     idx = jax.lax.axis_index(axis)
-    # global positions: striped shard r holds r, r+p, ...; contiguous
-    # holds [r*sq, (r+1)*sq)
-    q_pos = (idx + nshards * jnp.arange(sq)) if striped else \
-        (idx * sq + jnp.arange(sq))
+    q_pos = ring_positions(idx, nshards, sq, striped)
 
     # accumulators derive from qc (already device-varying), so the scan
     # carry's varying manual axes match the updated values whatever
@@ -326,8 +338,7 @@ def ring_attention_sharded(qc: jax.Array, kc: jax.Array, vc: jax.Array,
         acc, m, l, kc, vc = carry
         # chunk arriving at step t started at ring position idx-t
         src = (idx - t) % nshards
-        k_pos = (src + nshards * jnp.arange(sq)) if striped else \
-            (src * sq + jnp.arange(sq))
+        k_pos = ring_positions(src, nshards, sq, striped)
         if causal:
             bias = jnp.where(k_pos[None, :] <= q_pos[:, None],
                              0.0, -jnp.inf)
@@ -373,6 +384,7 @@ def _ring_flash_fwd_impl(qc, kc, vc, axis, nshards, causal,
     from .attention_pallas import _kernel_layout, flash_attention_chunk
 
     b, sq, n, h = qc.shape
+    nkv = kc.shape[2]
     blk = _ring_blk(sq, 1024)
     idx = jax.lax.axis_index(axis)
 
@@ -392,16 +404,11 @@ def _ring_flash_fwd_impl(qc, kc, vc, axis, nshards, causal,
     def step(carry, t):
         acc, m, l, kc_, vc_ = carry
         src = (idx - t) % nshards
-        if striped:
-            # striped layout: q_pos = idx + p*i, k_pos = src + p*j, so
-            # k_pos <= q_pos  <=>  j <= i (src <= idx) or j <= i-1 —
-            # the kernels' traced offset handles it as d in {0, -1}
-            d = jnp.where(src <= idx, 0, -1).astype(jnp.int32)
-        else:
-            d = (idx - src) * sq       # q_global_start - k_global_start
+        d = ring_offset(idx, src, sq, striped)
         acc, m, l = flash_attention_chunk(qt, kc_, vc_, acc, m, l, d,
                                           causal=causal, block_q=blk,
-                                          block_k=blk)
+                                          block_k=blk, q_heads=n,
+                                          kv_heads=nkv)
         kc_ = jax.lax.ppermute(kc_, axis, perm)
         vc_ = jax.lax.ppermute(vc_, axis, perm)
         return (acc, m, l, kc_, vc_), None
@@ -442,6 +449,7 @@ def _ring_flash_bwd(axis, nshards, causal, striped, res, g):
 
     qt, kt, vt, ot, lse = res
     b, sq, n, h = g.shape                      # public [B, S/P, N, H]
+    nkv = kt.shape[0] // b                     # kv heads (grouped wire)
     blk = _ring_blk(sq, 512)
     idx = jax.lax.axis_index(axis)
     dot_ = _kernel_layout(g).astype(qt.dtype)
@@ -449,17 +457,15 @@ def _ring_flash_bwd(axis, nshards, causal, striped, res, g):
 
     perm = [(i, (i + 1) % nshards) for i in range(nshards)]
     zf = qt.astype(jnp.float32) * 0.0
+    zkv = kt.astype(jnp.float32) * 0.0
 
     def step(carry, t):
         dq, dk, dv, kr, vr = carry
         src = (idx - t) % nshards
-        if striped:
-            d = jnp.where(src <= idx, 0, -1).astype(jnp.int32)
-        else:
-            d = (idx - src) * sq
+        d = ring_offset(idx, src, sq, striped)
         dq_p, dk_p, dv_p = flash_attention_bwd(
             qt, kr, vr, dot_, delta128, lse128, d, causal=causal,
-            block_q=blk, block_k=blk)
+            block_q=blk, block_k=blk, q_heads=n, kv_heads=nkv)
         dq = dq + dq_p
         dk = dk + dk_p
         dv = dv + dv_p
@@ -470,12 +476,14 @@ def _ring_flash_bwd(axis, nshards, causal, striped, res, g):
         return (dq, dk, dv, kr, vr), None
 
     (dq, dk, dv, _kr, _vr), _ = jax.lax.scan(
-        step, (zf, zf, zf, kt, vt), jnp.arange(nshards))
+        step, (zf, zkv, zkv, kt, vt), jnp.arange(nshards))
 
-    def back(x, dtype):
-        return jnp.moveaxis(x.reshape(b, n, sq, h), 1, 2).astype(dtype)
+    def back(x, heads, dtype):
+        return jnp.moveaxis(x.reshape(b, heads, sq, h), 1,
+                            2).astype(dtype)
 
-    return back(dq, qt.dtype), back(dk, kt.dtype), back(dv, vt.dtype)
+    return (back(dq, n, qt.dtype), back(dk, nkv, kt.dtype),
+            back(dv, nkv, vt.dtype))
 
 
 _ring_flash.defvjp(_ring_flash_fwd_impl, _ring_flash_bwd)

@@ -685,15 +685,21 @@ def _flash_chunk_kernel(d_ref, q_ref, k_ref, v_ref, acc_in, m_in, l_in,
 def flash_attention_chunk(q, k, v, acc, m, l, d,
                           causal: bool = False, block_q: int = 1024,
                           block_k: int = 1024,
-                          interpret: Optional[bool] = None):
+                          interpret: Optional[bool] = None,
+                          q_heads: int = 1, kv_heads: int = 1):
     """Fold one K/V chunk into an online-softmax carry (pallas).
 
     Layouts (kernel-native, NO [B,S,N,H] public shape here — the ring
-    transposes once outside its scan): q [bn, sq, h]; k/v [bn, sk, h];
-    acc [bn, sq, h] f32; m/l [bn, sq, 128] f32 (lane-replicated).
+    transposes once outside its scan): q [bn, sq, h]; k/v [bn_kv, sk,
+    h]; acc [bn, sq, h] f32; m/l [bn, sq, 128] f32 (lane-replicated).
     `d` is a traced int32 scalar: q_global_start - k_global_start.
     Returns updated (acc, m, l), unnormalized. Finalize with
     acc / max(l, eps) outside (ops/attention._finish agrees).
+
+    GQA: q_heads > kv_heads reads shared K/V tiles via the same
+    BlockSpec row remap plain flash uses (_kv_row_map) — grouped
+    chunks stay grouped, which is what keeps the ring's ppermute
+    volume at the kv-head size.
 
     sq and sk must be multiples of the (clamped) block sizes — ring
     chunks are equal by construction.
@@ -701,6 +707,15 @@ def flash_attention_chunk(q, k, v, acc, m, l, d,
     import math as _math
     bn, sq, h = q.shape
     sk = k.shape[1]
+    want = bn // q_heads * kv_heads
+    if k.shape[0] != want:
+        # loud in the equal-heads case too: grouped K/V passed with the
+        # default params would otherwise be silently misread (pallas
+        # clamps out-of-range block rows instead of raising)
+        raise ValueError(
+            f"chunk rows: k has {k.shape[0]}, expected {want} "
+            f"(q rows {bn}, q_heads {q_heads}, kv_heads {kv_heads})")
+    kv_of = _kv_row_map(q_heads, kv_heads)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, sq)
@@ -722,8 +737,10 @@ def flash_attention_chunk(q, k, v, acc, m, l, d,
         grid=(bn, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, h), lambda bn_, iq, ik, *_: (bn_, iq, 0)),
-            pl.BlockSpec((1, block_k, h), lambda bn_, iq, ik, *_: (bn_, ik, 0)),
-            pl.BlockSpec((1, block_k, h), lambda bn_, iq, ik, *_: (bn_, ik, 0)),
+            pl.BlockSpec((1, block_k, h),
+                         lambda bn_, iq, ik, *_: (kv_of(bn_), ik, 0)),
+            pl.BlockSpec((1, block_k, h),
+                         lambda bn_, iq, ik, *_: (kv_of(bn_), ik, 0)),
             pl.BlockSpec((1, block_q, h), lambda bn_, iq, ik, *_: (bn_, iq, 0)),
             pl.BlockSpec((1, block_q, 128),
                          lambda bn_, iq, ik, *_: (bn_, iq, 0)),
