@@ -402,6 +402,22 @@ def _register_builtins() -> None:
     put("tpu", "memory/bytes_in_use", CallbackCounter(hbm_in_use),
         "device#0")
 
+    # host process memory (the reference's /runtime/memory/resident +
+    # virtual counters); /proc/self/statm is linux-only — counters
+    # read 0 elsewhere rather than failing discovery
+    def _statm(field: int) -> Callable[[], float]:
+        def read() -> float:
+            try:
+                import os as _os
+                page = _os.sysconf("SC_PAGE_SIZE")
+                with open("/proc/self/statm") as f:
+                    return float(f.read().split()[field]) * page
+            except (OSError, IndexError, ValueError, AttributeError):
+                return 0.0
+        return read
+    put("runtime", "memory/virtual", CallbackCounter(_statm(0)))
+    put("runtime", "memory/resident", CallbackCounter(_statm(1)))
+
     # parcel layer (only once the distributed runtime is up). Read the
     # CURRENT runtime at query time: closing over the runtime object
     # alive at first registration would report frozen values (and pin a

@@ -5,6 +5,7 @@ import os
 import time
 
 import pytest
+import sys
 
 import hpx_tpu as hpx
 from hpx_tpu.svc import performance_counters as pc
@@ -122,9 +123,14 @@ class TestPrinting:
     def test_print_counters_format(self):
         buf = io.StringIO()
         pc.print_counters("/runtime{*", file=buf)
-        line = buf.getvalue().strip()
-        HPX_TEST(line.startswith("/runtime{locality#0/total}/uptime,"))
-        HPX_TEST_EQ(len(line.split(",")), 4)
+        lines = buf.getvalue().strip().splitlines()
+        HPX_TEST(lines[0].startswith(
+            "/runtime{locality#0/total}/memory/resident,"))
+        HPX_TEST_EQ(len(lines[0].split(",")), 4)
+        # /runtime now carries uptime + the process memory counters
+        names = [ln.split(",")[0] for ln in lines]
+        HPX_TEST("/runtime{locality#0/total}/uptime" in names)
+        HPX_TEST("/runtime{locality#0/total}/memory/virtual" in names)
 
     def test_interval_printer_stops(self):
         buf = io.StringIO()
@@ -294,3 +300,17 @@ def test_idle_rate_counters():
         assert 0.5 <= v <= 1.0, v     # an idle pool must READ as idle
     finally:
         pool.shutdown()
+
+
+@pytest.mark.skipif(sys.platform != "linux",
+                    reason="statm counters read 0 off-linux by design")
+def test_host_memory_counters():
+    """/runtime/memory/{resident,virtual}: the reference's process
+    memory counters, read from /proc/self/statm."""
+    from hpx_tpu.svc import performance_counters as pc
+    res = pc.query_counter(
+        "/runtime{locality#0/total}/memory/resident")
+    virt = pc.query_counter(
+        "/runtime{locality#0/total}/memory/virtual")
+    assert res.value > 1_000_000    # a python process is >1 MB resident
+    assert virt.value >= res.value
