@@ -347,3 +347,77 @@ class TestTunedBlocks:
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             atol=3e-2, rtol=3e-2)
         assert bq >= 8 and bk >= 8
+
+
+class TestStripedAndGQAChunks:
+    """Round-5 ring upgrades through Mosaic on the real chip: striped
+    offsets (d in {0,-1}) and GQA row-remapped K/V tiles in
+    flash_attention_chunk."""
+
+    def test_striped_chunk_fold(self):
+        from hpx_tpu.ops.attention import (reference_attention,
+                                           stripe_sequence,
+                                           unstripe_sequence)
+        from hpx_tpu.ops.attention_pallas import flash_attention_chunk
+        B, S, N, H = 1, 512, 2, 64
+        q, k, v = _qkv(B, S, N, H, dtype=jnp.float32, seed=9)
+        want = reference_attention(q, k, v, True)
+        nsh, sq = 4, S // 4
+        qs, ks, vs = (stripe_sequence(x, nsh) for x in (q, k, v))
+        outs = []
+        for i in range(nsh):
+            qc = jnp.moveaxis(qs[:, i * sq:(i + 1) * sq], 2, 1
+                              ).reshape(B * N, sq, H)
+            acc = jnp.zeros((B * N, sq, H), jnp.float32)
+            m = jnp.full((B * N, sq, 128), -1e30, jnp.float32)
+            l = jnp.zeros((B * N, sq, 128), jnp.float32)
+            for j in range(nsh):
+                kc = jnp.moveaxis(ks[:, j * sq:(j + 1) * sq], 2, 1
+                                  ).reshape(B * N, sq, H)
+                vc = jnp.moveaxis(vs[:, j * sq:(j + 1) * sq], 2, 1
+                                  ).reshape(B * N, sq, H)
+                acc, m, l = flash_attention_chunk(
+                    qc, kc, vc, acc, m, l,
+                    jnp.int32(0 if j <= i else -1), causal=True,
+                    block_q=128, block_k=128)
+            den = jnp.where(l[:, :, :1] > 0, l[:, :, :1], 1.0)
+            o = (acc / den).reshape(B, N, sq, H)
+            outs.append(jnp.moveaxis(o, 1, 2))
+        got = unstripe_sequence(jnp.concatenate(outs, axis=1),
+                                nsh).astype(q.dtype)
+        _close(got, want, 3e-4)
+
+    def test_gqa_grouped_chunk_fold(self):
+        """Grouped K/V rows through the chunk kernel's BlockSpec remap
+        (the grouped-wire ring path) vs the repeat oracle."""
+        from hpx_tpu.ops.attention import reference_attention
+        from hpx_tpu.ops.attention_pallas import flash_attention_chunk
+        B, S, NQ, NKV, H = 1, 512, 4, 2, 64
+        q, _, _ = _qkv(B, S, NQ, H, dtype=jnp.float32, seed=10)
+        _, k, v = _qkv(B, S, NKV, H, dtype=jnp.float32, seed=11)
+        want = reference_attention(
+            q, jnp.repeat(k, NQ // NKV, 2), jnp.repeat(v, NQ // NKV, 2),
+            True)
+        nsh, sq = 4, S // 4
+        outs = []
+        for i in range(nsh):
+            qc = jnp.moveaxis(q[:, i * sq:(i + 1) * sq], 2, 1
+                              ).reshape(B * NQ, sq, H)
+            acc = jnp.zeros((B * NQ, sq, H), jnp.float32)
+            m = jnp.full((B * NQ, sq, 128), -1e30, jnp.float32)
+            l = jnp.zeros((B * NQ, sq, 128), jnp.float32)
+            for j in range(nsh):
+                kc = jnp.moveaxis(k[:, j * sq:(j + 1) * sq], 2, 1
+                                  ).reshape(B * NKV, sq, H)
+                vc = jnp.moveaxis(v[:, j * sq:(j + 1) * sq], 2, 1
+                                  ).reshape(B * NKV, sq, H)
+                acc, m, l = flash_attention_chunk(
+                    qc, kc, vc, acc, m, l,
+                    jnp.int32(i * sq - j * sq), causal=True,
+                    block_q=128, block_k=128, q_heads=NQ,
+                    kv_heads=NKV)
+            den = jnp.where(l[:, :, :1] > 0, l[:, :, :1], 1.0)
+            o = (acc / den).reshape(B, NQ, sq, H)
+            outs.append(jnp.moveaxis(o, 1, 2))
+        got = jnp.concatenate(outs, axis=1).astype(q.dtype)
+        _close(got, want, 3e-4)
