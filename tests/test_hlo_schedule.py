@@ -182,3 +182,83 @@ def test_compiled_dp_grads_no_gather_bounded_reduces(devices):
     n_leaves = len(jax.tree.leaves(params))
     assert n_ag == 0, n_ag
     assert 1 <= n_ar <= n_leaves + 6, (n_ar, n_leaves)
+
+
+def test_striped_train_step_schedule(devices):
+    """striped_ring: SAME ring collectives as contiguous (ppermute
+    sites and ring scan lengths unchanged — striping must never add
+    hops), plus the batch stripe before the shard_map (lowered by XLA
+    from the reshape-transpose; asserted structurally: the jaxpr gains
+    no extra collective primitives)."""
+    import dataclasses
+    mesh = tfm.make_mesh_3d(8)
+    sp = mesh.shape["sp"]
+    cfg_c = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                  head_dim=8, n_layers=2, d_ff=32,
+                                  lr=0.05, rope=True)
+    cfg_s = dataclasses.replace(cfg_c, striped_ring=True)
+    results = {}
+    for name, cfg in (("contig", cfg_c), ("striped", cfg_s)):
+        params = tfm.shard_params(
+            tfm.init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
+        step = tfm.make_train_step(cfg, mesh)
+        dp = mesh.shape["dp"]
+        toks, tgts = tfm.sample_batch(cfg, batch=2 * dp, seq=8 * sp,
+                                      key=jax.random.PRNGKey(1))
+        toks, tgts = tfm.shard_batch(toks, tgts, mesh)
+        counts, scans = collective_counts(step, params, toks, tgts)
+        results[name] = (counts, scans)
+    cc, sc = results["contig"]
+    cs, ss = results["striped"]
+    assert cs.get("ppermute", 0) == cc.get("ppermute", 0), (cs, cc)
+    assert [s for s in ss if s == sp] == [s for s in sc if s == sp]
+    assert _all_gathers(cs) == _all_gathers(cc) == 0
+    assert cs.get("all_to_all", 0) == cc.get("all_to_all", 0) == 0
+
+
+def test_sharded_speculative_decode_schedule(devices):
+    """dp x tp speculative decode: tp psums close the Megatron
+    contractions; params are never all-gathered; NO collective crosses
+    dp (each dp shard's acceptance loop runs free — a dp collective
+    inside the loop would deadlock diverging trip counts)."""
+    mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("dp", "tp"))
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                head_dim=8, n_layers=2, d_ff=64)
+    dcfg = tfm.TransformerConfig(vocab=64, d_model=16, n_heads=2,
+                                 head_dim=8, n_layers=1, d_ff=32)
+    params = tfm.shard_params(tfm.init_params(cfg, jax.random.PRNGKey(0)),
+                              cfg, mesh)
+    draft = tfm.init_params(dcfg, jax.random.PRNGKey(1))
+    prompt = jnp.ones((4, 4), jnp.int32)
+
+    def run(params, draft, prompt):
+        return tfm.speculative_generate(params, cfg, draft, dcfg,
+                                        prompt, max_new=6, k=2,
+                                        mesh=mesh)
+
+    counts, _ = collective_counts(run, params, draft, prompt)
+    assert _all_gathers(counts) == 0, counts
+    assert _psums(counts) > 0, counts        # Megatron tp closes
+    assert counts.get("all_to_all", 0) == 0, counts
+    # axis-name walk: every psum must name ONLY tp (dp-crossing
+    # collectives inside diverging loops would deadlock)
+    def axes_used(fn, *args):
+        names = set()
+
+        def walk(jaxpr):
+            for eqn in jaxpr.eqns:
+                ax = eqn.params.get("axes") or eqn.params.get(
+                    "axis_name")
+                if eqn.primitive.name.startswith(("psum", "ppermute",
+                                                  "all_")):
+                    if ax is not None:
+                        names.update(ax if isinstance(ax, (tuple, list))
+                                     else [ax])
+                for v in eqn.params.values():
+                    for sj in _subjaxprs(v):
+                        walk(sj)
+
+        walk(jax.make_jaxpr(fn)(*args).jaxpr)
+        return names
+
+    assert axes_used(run, params, draft, prompt) <= {"tp"}
