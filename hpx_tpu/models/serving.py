@@ -1,0 +1,298 @@
+"""Continuous batching: slot-based serving with per-slot positions.
+
+Reference analog: none (HPX ships no serving runtime); this is the
+standard TPU serving-loop shape — a FIXED batch of decode slots, each
+at its OWN sequence position, stepping together in one jitted program.
+Requests admit into free slots between steps (their prompt prefills on
+the side as one window forward, then SPLICES into the slot's cache
+rows) and retire on eos/max_new, so short requests never wait for long
+ones and the chip never idles on a ragged batch. Static shapes
+throughout: the per-row cache write is a batched scatter at the slot's
+position vector, the causal mask compares against per-row positions,
+and dead slots simply compute masked work (the XLA way — uniform work,
+no dynamic batch).
+
+Differential contract (the test): every request's tokens are EXACTLY
+what transformer.generate() emits for that prompt alone — continuous
+batching changes THROUGHPUT, never content.
+
+Build on the single-sequence machinery in models/transformer.py; the
+per-row-position block lives here (the scalar-position `_block_decode`
+stays the lean fast path for uniform decode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (
+    TransformerConfig,
+    _cached_program,
+    _dq,
+    _ln,
+    _prefill_window,
+    _qkv_proj,
+    _tree_key,
+)
+
+__all__ = ["ContinuousServer"]
+
+
+def _rope_rows(x, pos, cfg: TransformerConfig):
+    """Rotate-half RoPE with PER-ROW positions: x [B, 1, N, H],
+    pos [B] int32 (transformer._rope takes one shared [S] vector)."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freq = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32)
+                              / half)
+    ang = pos.astype(jnp.float32)[:, None] * freq[None, :]  # [B, half]
+    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def _block_decode_rows(x, lp, kv, pos, cfg: TransformerConfig):
+    """One decoder block for ONE new token per slot with PER-SLOT cache
+    positions. x: [B, 1, D]; kv: (k_cache, v_cache) [B, Smax, Nkv, H];
+    pos: [B] int32 — slot b's token lands at pos[b], and its query
+    attends cache positions <= pos[b]. The write is a batched scatter
+    (row b at pos[b]); everything else mirrors _block_decode."""
+    kc, vc = kv
+    b = x.shape[0]
+    h = _ln(x, lp["ln1"])
+    q, k, v = _qkv_proj(h, lp)
+    if cfg.rope:
+        q = _rope_rows(q, pos, cfg)
+        k = _rope_rows(k, pos, cfg)
+    rows = jnp.arange(b)
+    kc = kc.at[rows, pos].set(k[:, 0])
+    vc = vc.at[rows, pos].set(v[:, 0])
+    nq, hd = q.shape[2], q.shape[3]
+    nkv = kc.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, 1, nkv, g, hd)
+    s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
+    kpos = jnp.arange(kc.shape[1])
+    live = kpos[None, :] <= pos[:, None]               # [B, Smax]
+    s = jnp.where(live[:, None, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+    att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, 1, nq, hd)
+    o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
+    x = x + o
+    h = _ln(x, lp["ln2"])
+    if "moe" in lp:
+        from .moe import moe_ffn
+        from .transformer import _moe_cfg
+        d = h.shape[-1]
+        mcfg = dataclasses.replace(_moe_cfg(cfg),
+                                   capacity_factor=float(cfg.n_experts))
+        out, _aux = moe_ffn(h.reshape(b, d), lp["moe"], mcfg)
+        return x + out.reshape(b, 1, d), (kc, vc)
+    h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
+    return x + h, (kc, vc)
+
+
+def _decode_rows(params, caches, tok, pos, cfg):
+    """One token per slot through every block at per-slot positions;
+    returns (caches, f32 logits [B, V])."""
+    x = params["emb"][tok][:, None, :]
+    new_caches = []
+    for lp, kv in zip(params["layers"], caches):
+        x, kv = _block_decode_rows(x, lp, kv, pos, cfg)
+        new_caches.append(kv)
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+    return new_caches, logits[:, 0, :].astype(jnp.float32)
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: Any                    # [plen] int32 host array
+    max_new: int
+    eos_id: Optional[int]
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+class ContinuousServer:
+    """Slot-based continuous batching for greedy decode.
+
+    ::
+
+        srv = ContinuousServer(params, cfg, slots=4, smax=256)
+        a = srv.submit([3, 1, 4], max_new=16)
+        b = srv.submit([2, 7], max_new=8, eos_id=0)
+        out = srv.run()            # {a: [tokens...], b: [tokens...]}
+
+    One jitted step decodes every live slot at its own position;
+    finished slots retire and queued requests admit between steps
+    (prompt prefilled as one window forward on a b=1 cache, K/V rows
+    spliced into the slot). Dead slots compute masked no-op work
+    (static shapes). Greedy only — per-request sampling composes the
+    same way but is not wired. Programs are memoized per (cfg, slots,
+    smax) and per prompt length (bucket prompts in production)."""
+
+    def __init__(self, params, cfg: TransformerConfig, slots: int = 4,
+                 smax: int = 512):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.smax = smax
+        nkv, hd = cfg.kv_heads, cfg.head_dim
+        self._caches = [
+            (jnp.zeros((slots, smax, nkv, hd), cfg.dtype),
+             jnp.zeros((slots, smax, nkv, hd), cfg.dtype))
+            for _ in range(cfg.n_layers)]
+        # host-side slot state
+        self._slot_req: List[Optional[_Request]] = [None] * slots
+        self._pos = [0] * slots         # next write position per slot
+        self._cur = [0] * slots         # token to feed next, per slot
+        self._queue: deque = deque()
+        self._done: Dict[int, List[int]] = {}
+        self._next_rid = 0
+
+    # -- jitted pieces (memoized on the baked constants) ----------------
+
+    def _step_prog(self):
+        cfg, slots, smax = self.cfg, self.slots, self.smax
+        ck = ("cb_step", cfg, slots, smax, _tree_key(self.params))
+
+        def build():
+            def step(params, caches, tok, pos):
+                return _decode_rows(params, caches, tok, pos, cfg)
+            return jax.jit(step, donate_argnums=(1,))
+        return _cached_program(ck, build)
+
+    def _prefill_prog(self, plen: int):
+        cfg, smax = self.cfg, self.smax
+        ck = ("cb_prefill", cfg, plen, smax, _tree_key(self.params))
+
+        def build():
+            def prefill(params, prompt):
+                nkv, hd = cfg.kv_heads, cfg.head_dim
+                fresh = [
+                    (jnp.zeros((1, smax, nkv, hd), cfg.dtype),
+                     jnp.zeros((1, smax, nkv, hd), cfg.dtype))
+                    for _ in range(cfg.n_layers)]
+                # THE shared chunked prefill (same code path as
+                # generate/beam/speculative): 128-token windows,
+                # unembedding only on the last chunk
+                return _prefill_window(params, cfg, fresh, prompt)
+            return jax.jit(prefill)
+        return _cached_program(ck, build)
+
+    def _splice_prog(self, plen: int):
+        slots, smax = self.slots, self.smax
+        ck = ("cb_splice", self.cfg, plen, slots, smax,
+              _tree_key(self.params))
+
+        def build():
+            def splice(caches, one, slot):
+                out = []
+                for (kc, vc), (k1, v1) in zip(caches, one):
+                    kc = jax.lax.dynamic_update_slice(
+                        kc, k1[:, :plen].astype(kc.dtype),
+                        (slot, 0, 0, 0))
+                    vc = jax.lax.dynamic_update_slice(
+                        vc, v1[:, :plen].astype(vc.dtype),
+                        (slot, 0, 0, 0))
+                    out.append((kc, vc))
+                return out
+            return jax.jit(splice, donate_argnums=(0,))
+        return _cached_program(ck, build)
+
+    # -- public API ------------------------------------------------------
+
+    def submit(self, prompt, max_new: int, eos_id: Optional[int] = None
+               ) -> int:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("continuous batching needs a non-empty "
+                             "prompt (unconditional generation: "
+                             "transformer.generate)")
+        if len(prompt) + max_new > self.smax:
+            raise ValueError(
+                f"plen {len(prompt)} + max_new {max_new} exceeds "
+                f"smax {self.smax}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, prompt, max_new, eos_id))
+        return rid
+
+    def _admit(self) -> None:
+        """Fill free slots from the queue: prefill the prompt on a b=1
+        cache (one window forward), splice its K/V rows into the slot,
+        seed the slot's first generated token."""
+        for slot in range(self.slots):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            plen = len(req.prompt)
+            prompt = jnp.asarray([req.prompt], jnp.int32)
+            one, last_logits = self._prefill_prog(plen)(self.params,
+                                                        prompt)
+            self._caches = self._splice_prog(plen)(
+                self._caches, one, jnp.int32(slot))
+            tok0 = int(jnp.argmax(last_logits[0]))
+            req.tokens.append(tok0)
+            self._slot_req[slot] = req
+            self._pos[slot] = plen
+            self._cur[slot] = tok0
+            self._maybe_retire(slot)
+
+    def _maybe_retire(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        hit_eos = (req.eos_id is not None
+                   and req.tokens[-1] == req.eos_id)
+        if len(req.tokens) >= req.max_new or hit_eos:
+            if hit_eos:
+                # generate() keeps emitting pinned eos to max_new; the
+                # slot retires early and pads the same tail
+                req.tokens = req.tokens + [req.eos_id] * (
+                    req.max_new - len(req.tokens))
+            self._done[req.rid] = req.tokens
+            self._slot_req[slot] = None
+
+    def step(self) -> bool:
+        """Admit + one decode step for every live slot. Returns True
+        while any work remains (live slots or queued requests)."""
+        self._admit()
+        live = [s for s in range(self.slots)
+                if self._slot_req[s] is not None]
+        if not live:
+            return bool(self._queue)
+        tok = jnp.asarray(self._cur, jnp.int32)
+        # dead slots re-write their own last position (harmless: they
+        # are never read — admission overwrites rows 0..plen first)
+        pos = jnp.asarray(self._pos, jnp.int32)
+        self._caches, logits = self._step_prog()(
+            self.params, self._caches, tok, pos)
+        nxt = jnp.argmax(logits, axis=-1)
+        nxt_host = np.asarray(nxt).tolist()    # ONE device->host read
+        for s in live:
+            req = self._slot_req[s]
+            assert req is not None
+            req.tokens.append(nxt_host[s])
+            self._pos[s] += 1
+            self._cur[s] = nxt_host[s]
+            self._maybe_retire(s)
+        return True
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive step() until every submitted request finishes; returns
+        {request_id: tokens} (each exactly generate()'s output)."""
+        while self.step():
+            pass
+        out, self._done = self._done, {}
+        return out

@@ -1200,16 +1200,15 @@ class TestStripedRingTraining:
 
 def test_speculative_with_quantized_target():
     """int8 target through speculative decode == int8 greedy decode
-    (the draft never changes which weights produce tokens)."""
+    (the draft never changes which weights produce tokens). Same
+    fixed-seed tie caveat as TestSpeculativeDecoding."""
     from hpx_tpu.models import quant
-    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
-                                head_dim=8, n_layers=2, d_ff=64)
-    qp = quant.quantize_params(tfm.init_params(cfg, jax.random.PRNGKey(2)))
+    qp = quant.quantize_params(tfm.init_params(CFG, jax.random.PRNGKey(2)))
     draft = tfm.init_params(TestSpeculativeDecoding.DRAFT,
                             jax.random.PRNGKey(3))
     prompt = jnp.array([[5, 6, 7]], jnp.int32)
-    ref = tfm.generate(qp, cfg, prompt, max_new=8)
-    out = tfm.speculative_generate(qp, cfg, draft,
+    ref = tfm.generate(qp, CFG, prompt, max_new=8)
+    out = tfm.speculative_generate(qp, CFG, draft,
                                    TestSpeculativeDecoding.DRAFT,
                                    prompt, max_new=8, k=3)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
