@@ -10,7 +10,9 @@ Exercises the whole serving surface on one tiny GQA+RoPE model:
      tokens as greedy by construction — return_stats counts the
      verification rounds, which shrink as the draft gets better at
      agreeing with the target);
-  6. sharded decode over a Mesh(dp, tp) — bit-matched against (1).
+  6. sharded decode over a Mesh(dp, tp) — bit-matched against (1);
+  7. continuous batching: mixed-length requests through decode slots,
+     each result identical to its solo greedy run.
 
 Usage: python examples/serving_demo.py [--cpu-mesh N]
 """
@@ -106,6 +108,21 @@ def main() -> int:
         qmatch = np.array_equal(np.asarray(qsharded), np.asarray(qout))
         print(f"int8 sharded dp2/tp2: bit-match={qmatch}")
         ok = ok and qmatch
+
+    from hpx_tpu.models.serving import ContinuousServer
+    srv = ContinuousServer(host, cfg, slots=2, smax=32)
+    reqs = {srv.submit([3, 1, 4, 1], max_new=6): [3, 1, 4, 1],
+            srv.submit([2, 7], max_new=9): [2, 7],
+            srv.submit([5, 5, 5], max_new=4): [5, 5, 5]}
+    served = srv.run()
+    cb_ok = all(
+        served[rid] == np.asarray(tfm.generate(
+            host, cfg, jnp.asarray([p], jnp.int32),
+            max_new=len(served[rid])))[0].tolist()
+        for rid, p in reqs.items())
+    print(f"continuous batching: 3 requests / 2 slots, "
+          f"all == solo greedy: {cb_ok}")
+    ok = ok and cb_ok
 
     hits = np.where(np.asarray(pinned)[0] == eos)[0]
     ok = ok and hits.size > 0 and \
