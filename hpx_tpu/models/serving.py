@@ -39,6 +39,7 @@ from .transformer import (
     _ln,
     _prefill_window,
     _qkv_proj,
+    _sample_row,
     _tree_key,
 )
 
@@ -120,11 +121,13 @@ class _Request:
     prompt: Any                    # [plen] int32 host array
     max_new: int
     eos_id: Optional[int]
+    temperature: float = 0.0       # 0: greedy; >0: sample with `key`
+    key: Any = None
     tokens: List[int] = dataclasses.field(default_factory=list)
 
 
 class ContinuousServer:
-    """Slot-based continuous batching for greedy decode.
+    """Slot-based continuous batching, per-request greedy or sampled.
 
     ::
 
@@ -137,9 +140,14 @@ class ContinuousServer:
     finished slots retire and queued requests admit between steps
     (prompt prefilled as one window forward on a b=1 cache, K/V rows
     spliced into the slot). Dead slots compute masked no-op work
-    (static shapes). Greedy only — per-request sampling composes the
-    same way but is not wired. Programs are memoized per (cfg, slots,
-    smax) and per prompt length (bucket prompts in production)."""
+    (static shapes). PER-REQUEST decoding mode: greedy by default, or
+    submit(..., temperature=t, key=k) to sample — the key folds follow
+    generate()'s exactly (fold position, then row 0), so a sampled
+    request emits the SAME tokens it would get from a solo
+    generate(temperature=t, key=k) run. top_k truncation is not wired
+    (it is a static shape choice; bucket by top_k if needed). Programs
+    are memoized per (cfg, slots, smax) and per prompt length (bucket
+    prompts in production)."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int = 4,
                  smax: int = 512):
@@ -156,6 +164,8 @@ class ContinuousServer:
         self._slot_req: List[Optional[_Request]] = [None] * slots
         self._pos = [0] * slots         # next write position per slot
         self._cur = [0] * slots         # token to feed next, per slot
+        self._temp = [0.0] * slots      # per-slot temperature
+        self._key = [jax.random.PRNGKey(0)] * slots
         self._queue: deque = deque()
         self._done: Dict[int, List[int]] = {}
         self._next_rid = 0
@@ -167,8 +177,18 @@ class ContinuousServer:
         ck = ("cb_step", cfg, slots, smax, _tree_key(self.params))
 
         def build():
-            def step(params, caches, tok, pos):
-                return _decode_rows(params, caches, tok, pos, cfg)
+            def step(params, caches, tok, pos, temp, keys):
+                caches, logits = _decode_rows(params, caches, tok, pos,
+                                              cfg)
+
+                def pick(row, key, t, p):
+                    greedy = jnp.argmax(row)
+                    sampled = _sample_row(row, jnp.maximum(t, 1e-6),
+                                          key, p, 0)
+                    return jnp.where(t > 0, sampled, greedy)
+
+                nxt = jax.vmap(pick)(logits, keys, temp, pos)
+                return caches, nxt
             return jax.jit(step, donate_argnums=(1,))
         return _cached_program(ck, build)
 
@@ -212,8 +232,8 @@ class ContinuousServer:
 
     # -- public API ------------------------------------------------------
 
-    def submit(self, prompt, max_new: int, eos_id: Optional[int] = None
-               ) -> int:
+    def submit(self, prompt, max_new: int, eos_id: Optional[int] = None,
+               temperature: float = 0.0, key=None) -> int:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("continuous batching needs a non-empty "
@@ -223,9 +243,19 @@ class ContinuousServer:
             raise ValueError(
                 f"plen {len(prompt)} + max_new {max_new} exceeds "
                 f"smax {self.smax}")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new} "
+                             "(generate() handles max_new == 0)")
+        if temperature > 0.0 and key is None:
+            raise ValueError("temperature > 0 needs a PRNG key")
+        if temperature <= 0.0 and key is not None:
+            raise ValueError(
+                "key has no effect at temperature=0 (greedy); pass "
+                "temperature > 0 to sample")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt, max_new, eos_id))
+        self._queue.append(_Request(rid, prompt, max_new, eos_id,
+                                    temperature, key))
         return rid
 
     def _admit(self) -> None:
@@ -242,11 +272,19 @@ class ContinuousServer:
                                                         prompt)
             self._caches = self._splice_prog(plen)(
                 self._caches, one, jnp.int32(slot))
-            tok0 = int(jnp.argmax(last_logits[0]))
+            if req.temperature > 0.0:
+                # generate()'s tok0 draw: position plen-1, row 0
+                tok0 = int(_sample_row(last_logits[0], req.temperature,
+                                       req.key, plen - 1, 0))
+            else:
+                tok0 = int(jnp.argmax(last_logits[0]))
             req.tokens.append(tok0)
             self._slot_req[slot] = req
             self._pos[slot] = plen
             self._cur[slot] = tok0
+            self._temp[slot] = req.temperature
+            self._key[slot] = (req.key if req.key is not None
+                               else jax.random.PRNGKey(0))
             self._maybe_retire(slot)
 
     def _maybe_retire(self, slot: int) -> None:
@@ -276,9 +314,10 @@ class ContinuousServer:
         # dead slots re-write their own last position (harmless: they
         # are never read — admission overwrites rows 0..plen first)
         pos = jnp.asarray(self._pos, jnp.int32)
-        self._caches, logits = self._step_prog()(
-            self.params, self._caches, tok, pos)
-        nxt = jnp.argmax(logits, axis=-1)
+        temp = jnp.asarray(self._temp, jnp.float32)
+        keys = jnp.stack(self._key)
+        self._caches, nxt = self._step_prog()(
+            self.params, self._caches, tok, pos, temp, keys)
         nxt_host = np.asarray(nxt).tolist()    # ONE device->host read
         for s in live:
             req = self._slot_req[s]

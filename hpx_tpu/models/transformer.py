@@ -943,17 +943,19 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
         the closure would force a recompile per key."""
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
-        scaled = logits.astype(jnp.float32) / temperature
+        raw = logits.astype(jnp.float32)
         if top_k > 0:
-            thr = jax.lax.top_k(scaled, top_k)[0][..., -1:]
-            scaled = jnp.where(scaled < thr, -jnp.inf, scaled)
+            # top-k set is scale-invariant: mask the raw logits, the
+            # shared sampler scales after
+            thr = jax.lax.top_k(raw, top_k)[0][..., -1:]
+            raw = jnp.where(raw < thr, -jnp.inf, raw)
         # keys fold in (position, GLOBAL row): sharded == single-device
         base = (jax.lax.axis_index("dp") * b_local if mesh is not None
                 else 0)
-        kp = jax.random.fold_in(karg, pos)
-        keys = jax.vmap(lambda r: jax.random.fold_in(kp, r))(
-            base + jnp.arange(b_local))
-        return jax.vmap(jax.random.categorical)(keys, scaled)
+        return jax.vmap(
+            lambda row_logits, r: _sample_row(row_logits, temperature,
+                                              karg, pos, r))(
+            raw, base + jnp.arange(b_local))
 
     def forward_token(params, caches, tok, pos):
         return _decode_forward(params, caches, tok, pos, cfg,
@@ -1043,6 +1045,18 @@ def _cached_program(key_, build):
 
 def _tree_key(tree) -> Any:
     return jax.tree_util.tree_structure(tree)
+
+
+
+def _sample_row(logits_row, temperature, key, pos, row):
+    """THE per-row sampling contract every decoder shares (generate's
+    select, the continuous-batching server's step and admission):
+    temperature-scale, fold (position, row) into the key, categorical.
+    Keeping one copy is what makes 'batched == solo' token equality a
+    theorem rather than a hope."""
+    k = jax.random.fold_in(jax.random.fold_in(key, pos), row)
+    return jax.random.categorical(
+        k, logits_row.astype(jnp.float32) / temperature)
 
 
 def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):

@@ -81,3 +81,38 @@ def test_rejects_bad_submits(params):
         srv.submit([], max_new=4)
     with pytest.raises(ValueError, match="smax"):
         srv.submit([1, 2, 3], max_new=14)
+
+
+def test_per_request_sampling_matches_solo(params):
+    """Sampled requests reproduce their SOLO generate(temperature, key)
+    tokens exactly (the key folds match), mixed in one batch with
+    greedy requests."""
+    k1, k2 = jax.random.PRNGKey(11), jax.random.PRNGKey(22)
+    srv = ContinuousServer(params, CFG, slots=3, smax=64)
+    a = srv.submit([3, 1, 4], max_new=8, temperature=0.8, key=k1)
+    b = srv.submit([2, 7], max_new=6)                       # greedy
+    c = srv.submit([5, 6, 7, 8], max_new=7, temperature=1.3, key=k2)
+    out = srv.run()
+
+    def solo(prompt, m, t=0.0, key=None):
+        o = tfm.generate(params, CFG, jnp.asarray([prompt], jnp.int32),
+                         max_new=m, temperature=t, key=key)
+        return [int(x) for x in np.asarray(o)[0]]
+
+    assert out[a] == solo([3, 1, 4], 8, 0.8, k1)
+    assert out[b] == solo([2, 7], 6)
+    assert out[c] == solo([5, 6, 7, 8], 7, 1.3, k2)
+
+
+def test_sampling_requires_key(params):
+    srv = ContinuousServer(params, CFG, slots=1, smax=32)
+    with pytest.raises(ValueError, match="PRNG key"):
+        srv.submit([1, 2], max_new=4, temperature=0.5)
+
+
+def test_submit_arg_validation(params):
+    srv = ContinuousServer(params, CFG, slots=1, smax=32)
+    with pytest.raises(ValueError, match="max_new"):
+        srv.submit([1, 2], max_new=0)
+    with pytest.raises(ValueError, match="no effect"):
+        srv.submit([1, 2], max_new=4, key=jax.random.PRNGKey(0))
