@@ -116,3 +116,16 @@ def test_submit_arg_validation(params):
         srv.submit([1, 2], max_new=0)
     with pytest.raises(ValueError, match="no effect"):
         srv.submit([1, 2], max_new=4, key=jax.random.PRNGKey(0))
+
+
+def test_quantized_params_serve(params):
+    """int8 weights through the slot server == int8 solo generate (the
+    per-row block dequantizes at use like the scalar-position one)."""
+    from hpx_tpu.models import quant
+    qp = quant.quantize_params(params)
+    srv = ContinuousServer(qp, CFG, slots=2, smax=48)
+    rids = {srv.submit(p, max_new=m): (p, m)
+            for p, m in [([3, 1, 4], 7), ([2, 7], 5), ([9, 9], 6)]}
+    out = srv.run()
+    for rid, (p, m) in rids.items():
+        assert out[rid] == _ref(qp, CFG, p, m), (rid, p)
