@@ -150,16 +150,45 @@ class ContinuousServer:
     prompts in production)."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int = 4,
-                 smax: int = 512):
-        self.params = params
+                 smax: int = 512, mesh=None):
         self.cfg = cfg
         self.slots = slots
         self.smax = smax
+        self.mesh = mesh
         nkv, hd = cfg.kv_heads, cfg.head_dim
-        self._caches = [
-            (jnp.zeros((slots, smax, nkv, hd), cfg.dtype),
-             jnp.zeros((slots, smax, nkv, hd), cfg.dtype))
-            for _ in range(cfg.n_layers)]
+        cache_sh = None
+        if mesh is not None:
+            # GSPMD sharded serving: slots over dp, heads over tp. The
+            # step/prefill/splice programs are UNCHANGED — placement
+            # alone makes XLA partition them (einsum contractions over
+            # the tp-sharded head dim close with compiler-inserted
+            # all-reduces; no shard_map needed because nothing here
+            # depends on per-device identity).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .transformer import (_decode_mesh_check,
+                                      _decode_pspecs, _place)
+            # the shared decode-mesh contract (axes, dense-only, head
+            # divisibility); slots play the batch role
+            try:
+                _decode_mesh_check(cfg, mesh, slots)
+            except ValueError as e:
+                raise ValueError(str(e).replace("batch", "slots")) \
+                    from None
+            params = _place(params, _decode_pspecs(params, cfg), mesh)
+            cache_sh = NamedSharding(mesh, P("dp", None, "tp", None))
+        self.params = params
+        self._cache_sh = cache_sh
+
+        def zeros():
+            # allocate DIRECTLY in the sharded layout: a full buffer on
+            # device 0 followed by a redistribute would peak at the
+            # unsharded size there — the exact OOM sharding avoids
+            if cache_sh is not None:
+                return jnp.zeros((slots, smax, nkv, hd), cfg.dtype,
+                                 device=cache_sh)
+            return jnp.zeros((slots, smax, nkv, hd), cfg.dtype)
+        self._caches = [(zeros(), zeros())
+                        for _ in range(cfg.n_layers)]
         # host-side slot state
         self._slot_req: List[Optional[_Request]] = [None] * slots
         self._pos = [0] * slots         # next write position per slot
@@ -174,10 +203,17 @@ class ContinuousServer:
 
     def _step_prog(self):
         cfg, slots, smax = self.cfg, self.slots, self.smax
-        ck = ("cb_step", cfg, slots, smax, _tree_key(self.params))
+        ck = ("cb_step", cfg, slots, smax, self.mesh,
+              _tree_key(self.params))
 
         def build():
+            cache_sh = self._cache_sh
+
             def step(params, caches, tok, pos, temp, keys):
+                if cache_sh is not None:
+                    caches = jax.tree.map(
+                        lambda c: jax.lax.with_sharding_constraint(
+                            c, cache_sh), caches)
                 caches, logits = _decode_rows(params, caches, tok, pos,
                                               cfg)
 
@@ -194,7 +230,8 @@ class ContinuousServer:
 
     def _prefill_prog(self, plen: int):
         cfg, smax = self.cfg, self.smax
-        ck = ("cb_prefill", cfg, plen, smax, _tree_key(self.params))
+        ck = ("cb_prefill", cfg, plen, smax, self.mesh,
+              _tree_key(self.params))
 
         def build():
             def prefill(params, prompt):
@@ -212,11 +249,17 @@ class ContinuousServer:
 
     def _splice_prog(self, plen: int):
         slots, smax = self.slots, self.smax
-        ck = ("cb_splice", self.cfg, plen, slots, smax,
+        ck = ("cb_splice", self.cfg, plen, slots, smax, self.mesh,
               _tree_key(self.params))
 
         def build():
+            cache_sh = self._cache_sh
+
             def splice(caches, one, slot):
+                if cache_sh is not None:
+                    caches = jax.tree.map(
+                        lambda c: jax.lax.with_sharding_constraint(
+                            c, cache_sh), caches)
                 out = []
                 for (kc, vc), (k1, v1) in zip(caches, one):
                     kc = jax.lax.dynamic_update_slice(

@@ -129,3 +129,39 @@ def test_quantized_params_serve(params):
     out = srv.run()
     for rid, (p, m) in rids.items():
         assert out[rid] == _ref(qp, CFG, p, m), (rid, p)
+
+
+def test_sharded_server_matches_single_device(params):
+    """GSPMD sharded serving (slots over dp, heads over tp): placement
+    alone — identical step program — must reproduce the single-device
+    server token for token."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    reqs = [([3, 1, 4], 7), ([2, 7], 5), ([5, 6, 7, 8], 9), ([1], 4),
+            ([9, 2], 6)]
+
+    def serve(mesh_arg):
+        srv = ContinuousServer(params, CFG, slots=4, smax=64,
+                               mesh=mesh_arg)
+        rids = {srv.submit(p, max_new=m): i
+                for i, (p, m) in enumerate(reqs)}
+        out = srv.run()
+        return {rids[r]: out[r] for r in out}
+
+    single = serve(None)
+    sharded = serve(mesh)
+    assert sharded == single
+
+
+def test_sharded_server_validates(params):
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    with pytest.raises(ValueError, match="slots"):
+        ContinuousServer(params, CFG, slots=3, smax=32, mesh=mesh)
+    # the shared decode-mesh contract applies: MoE serves single-device
+    import dataclasses
+    moe_cfg = dataclasses.replace(CFG, n_experts=4)
+    moe_params = tfm.init_params(moe_cfg, jax.random.PRNGKey(8))
+    with pytest.raises(NotImplementedError, match="dense"):
+        ContinuousServer(moe_params, moe_cfg, slots=4, smax=32,
+                         mesh=mesh)
