@@ -90,6 +90,8 @@ rotate = _seg(_so.rotate, preserves_shape=True)
 unique = _seg(_so.unique)
 partition = _seg(_so.partition)
 partition_copy = _seg(_so.partition_copy)
+is_heap = _seg(_so.is_heap)
+is_heap_until = _seg(_so.is_heap_until)
 partial_sort = _seg(_so.partial_sort, preserves_shape=True)
 partial_sort_copy = _seg(_so.partial_sort_copy)
 nth_element = _seg(_so.nth_element, preserves_shape=True)
@@ -135,6 +137,7 @@ __all__ = [
     "set_union", "set_intersection", "set_difference",
     "set_symmetric_difference", "includes",
     "partition_copy", "partial_sort", "partial_sort_copy", "nth_element",
+    "is_heap", "is_heap_until",
     "shift_left", "shift_right", "swap_ranges",
     "unique_copy", "remove_copy", "remove_copy_if", "replace_copy",
     "replace_copy_if", "move", "reduce_by_key",

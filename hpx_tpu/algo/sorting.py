@@ -697,3 +697,46 @@ def partition_copy(policy: ExecutionPolicy, rng: Any,
     if policy.is_task:
         return res.then(lambda f: split(f.get()))
     return split(res)
+
+
+def is_heap_until(policy: ExecutionPolicy, rng: Any) -> Any:
+    """Index of the first element that breaks the max-heap property
+    (a[(i-1)//2] >= a[i]), or len(rng) when the whole range is a heap
+    (std::is_heap_until as an index). One vectorized parent-compare —
+    the heap property is embarrassingly parallel."""
+    if is_device_policy(policy, rng):
+        import jax.numpy as jnp
+        ex = device_executor(policy)
+
+        def kernel(a):
+            f = a.reshape(-1)
+            n = f.shape[0]
+            if n <= 1:                 # static shape
+                return jnp.asarray(n)
+            i = jnp.arange(1, n)
+            bad = f[(i - 1) // 2] < f[i]
+            return jnp.where(bad.any(), jnp.argmax(bad) + 1, n)
+        fut = ex.async_execute(kernel, rng)
+        if policy.is_task:
+            return fut.then(lambda f: int(f.get()))
+        return int(fut.get())
+    arr = to_numpy_view(rng)
+
+    def run():
+        import numpy as np
+        n = len(arr)
+        if n <= 1:
+            return n
+        i = np.arange(1, n)
+        bad = np.flatnonzero(arr[(i - 1) // 2] < arr[i])
+        return int(bad[0]) + 1 if bad.size else n
+
+    return finish(policy, run)
+
+
+def is_heap(policy: ExecutionPolicy, rng: Any) -> Any:
+    """True when the range is a max-heap (std::is_heap)."""
+    res = is_heap_until(policy, rng)
+    if policy.is_task:
+        return res.then(lambda f: f.get() == len(rng))
+    return res == len(rng)

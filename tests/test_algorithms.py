@@ -732,3 +732,19 @@ def test_reduce_by_key(pol_idx):
     uk4, rv4 = unwrap(reduce_by_key(pol, mk(np.array([], np.int32)),
                                     mk(np.array([], np.float32))))
     assert len(asnp(uk4)) == 0 and len(asnp(rv4)) == 0
+
+
+@pytest.mark.parametrize("pol_idx", range(3))
+def test_is_heap_and_until(pol_idx):
+    from hpx_tpu.algo import is_heap, is_heap_until
+    pol = policies()[pol_idx]
+    mk = (lambda a: jnp.asarray(a)) if pol_idx == 2 else \
+        (lambda a: np.asarray(a))
+    heap = mk(np.array([9, 5, 8, 1, 2, 7], np.int32))
+    assert unwrap(is_heap(pol, heap)) is True
+    assert unwrap(is_heap_until(pol, heap)) == 6
+    broken = mk(np.array([9, 5, 8, 6, 2, 7], np.int32))   # 6 > 5
+    assert unwrap(is_heap(pol, broken)) is False
+    assert unwrap(is_heap_until(pol, broken)) == 3
+    assert unwrap(is_heap(pol, mk(np.array([4], np.int32)))) is True
+    assert unwrap(is_heap_until(pol, mk(np.array([], np.int32)))) == 0
