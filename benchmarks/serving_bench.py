@@ -1,0 +1,110 @@
+"""Serving throughput harness: tokens/s for the three decode engines.
+
+Measures, on whatever backend jax resolves (the real TPU on the bench
+host; CPU for smoke runs with --cpu):
+
+  1. generate            — batched uniform greedy decode
+  2. ContinuousServer    — slot-based continuous batching over a ragged
+                           request mix (the steady-state serving shape)
+  3. speculative_generate — draft-assisted greedy (reports rounds too:
+                           tokens per target window forward is the
+                           speedup lever)
+
+Prints one JSON line per engine. This is an operator harness, not part
+of bench.py's driver metrics — serving throughput depends on the
+request mix, so the mix is printed with the number.
+
+Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    if "--cpu" in sys.argv:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from hpx_tpu.models import transformer as tfm
+    from hpx_tpu.models.serving import ContinuousServer
+
+    scale = int(sys.argv[sys.argv.index("--scale") + 1]) \
+        if "--scale" in sys.argv else (4 if "--cpu" in sys.argv else 16)
+    on_tpu = jax.default_backend() == "tpu"
+
+    d = 64 * scale
+    cfg = tfm.TransformerConfig(
+        vocab=1024, d_model=d, n_heads=8, head_dim=d // 8,
+        n_layers=4, d_ff=4 * d,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    draft_cfg = tfm.TransformerConfig(
+        vocab=1024, d_model=d // 4, n_heads=2, head_dim=d // 8,
+        n_layers=1, d_ff=d, dtype=cfg.dtype)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    draft = tfm.init_params(draft_cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(0)
+
+    def emit(name, toks, secs, **extra):
+        line = {"engine": name, "tokens": toks,
+                "seconds": round(secs, 4),
+                "tokens_per_s": round(toks / secs, 1)}
+        line.update(extra)
+        print(json.dumps(line), flush=True)
+
+    # 1. uniform batched greedy
+    B, plen, max_new = 8, 32, 64
+    prompt = jnp.asarray(rng.integers(1, 1000, (B, plen)), jnp.int32)
+    tfm.generate(params, cfg, prompt, max_new=4)       # compile
+    t0 = time.perf_counter()
+    out = tfm.generate(params, cfg, prompt, max_new=max_new)
+    jax.block_until_ready(out)
+    emit("generate", B * max_new, time.perf_counter() - t0,
+         mix=f"B{B} plen{plen} new{max_new}")
+
+    # 2. continuous batching over a ragged mix
+    # prompt lengths bucketed to multiples of 8: the server memoizes
+    # prefill programs per plen, so buckets bound compile count (the
+    # production discipline the ContinuousServer docstring names)
+    reqs = [(rng.integers(1, 1000, 8 * int(rng.integers(1, 7))).tolist(),
+             int(rng.integers(16, 96))) for _ in range(12)]
+    total_new = sum(m for _, m in reqs)
+    srv = ContinuousServer(params, cfg, slots=4, smax=160)
+    for p, m in reqs[:1]:
+        srv.submit(p, max_new=m)
+    srv.run()                                          # compile slots
+    srv = ContinuousServer(params, cfg, slots=4, smax=160)
+    for p, m in reqs:
+        srv.submit(p, max_new=m)
+    t0 = time.perf_counter()
+    srv.run()
+    emit("continuous_batching", total_new, time.perf_counter() - t0,
+         mix="12 reqs plen8-48(x8 buckets) new16-96 over 4 slots")
+
+    # 3. speculative greedy (single stream: the latency case)
+    sp = jnp.asarray(rng.integers(1, 1000, (1, plen)), jnp.int32)
+    tfm.speculative_generate(params, cfg, draft, draft_cfg, sp,
+                             max_new=4, k=4)           # compile
+    t0 = time.perf_counter()
+    out, rounds = tfm.speculative_generate(
+        params, cfg, draft, draft_cfg, sp, max_new=max_new, k=4,
+        return_stats=True)
+    jax.block_until_ready(out)
+    emit("speculative", max_new, time.perf_counter() - t0,
+         rounds=int(rounds),
+         tokens_per_target_forward=round(max_new / int(rounds), 2))
+    t0 = time.perf_counter()
+    out = tfm.generate(params, cfg, sp, max_new=max_new)
+    jax.block_until_ready(out)
+    emit("generate_single_stream", max_new, time.perf_counter() - t0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
