@@ -93,3 +93,25 @@ def test_future_overhead_benchmark():
     assert ("post+latch", "default-pool") in names, names
     assert ("post_many+latch (batched)", "default-pool") in names, names
     assert all(row["tasks_per_s"] > 0 for row in rows)
+
+
+@pytest.mark.slow
+def test_serving_benchmark_smoke():
+    """benchmarks/serving_bench.py --cpu: all four engines report a
+    tokens/s line and speculation reports its rounds."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join("benchmarks", "serving_bench.py"),
+         "--cpu", "--scale", "1"],
+        cwd=REPO, capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    import json
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    engines = {row["engine"] for row in rows}
+    assert engines == {"generate", "continuous_batching", "speculative",
+                       "generate_single_stream"}, engines
+    assert all(row["tokens_per_s"] > 0 for row in rows)
+    spec = next(row for row in rows if row["engine"] == "speculative")
+    assert spec["rounds"] >= 1
