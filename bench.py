@@ -698,6 +698,7 @@ def main() -> None:
     done = set()
     buf = b""
     timed_out = False
+    live_headline = []      # the headline line, if the child emitted it
 
     def _flush_lines(data: bytes):
         for raw in data.split(b"\n"):
@@ -706,9 +707,12 @@ def main() -> None:
             line = raw.decode(errors="replace")
             print(line, flush=True)
             try:
-                done.add(json.loads(line)["metric"])
+                metric = json.loads(line)["metric"]
             except (ValueError, KeyError, TypeError):
-                pass
+                continue
+            done.add(metric)
+            if metric == _METRIC_ORDER[-1]:
+                live_headline[:] = [line]
 
     while True:
         left = deadline - time.monotonic()
@@ -745,8 +749,15 @@ def main() -> None:
             # driver a complete, headline-LAST record: fill what was
             # filtered out from the banked fallback (live lines from
             # this run were already merged into it by the child)
+            filled = False
             for line in _load_fallback(skip=done):
                 print(json.dumps(line), flush=True)
+                filled = True
+            if filled and live_headline:
+                # the child measured the headline live, but the gap
+                # lines just pushed it off the last stdout line (the one
+                # the driver parses) — re-emit it so fresh data wins
+                print(live_headline[0], flush=True)
         return
     # child died or hung mid-run: fill the gaps from the last good run,
     # keeping the original emission order (headline last). The marker
@@ -764,6 +775,11 @@ def main() -> None:
             "error": note}), flush=True)
         for line in gaps:
             print(json.dumps(line), flush=True)
+        if live_headline:
+            # headline was measured live before the child died; the gap
+            # lines displaced it from the last stdout line — re-emit the
+            # live measurement so the driver parses it, not a stale one
+            print(live_headline[0], flush=True)
     elif done:
         # everything was measured live before the child died (e.g. it
         # was killed during its own bookkeeping): stdout already ends

@@ -9,6 +9,10 @@ set -u
 cd "$(dirname "$0")/.."
 ts=$(date -u +%Y%m%dT%H%M%S)
 log="benchmarks/watch_${ts}.log"
+# freshness floor for the exit check: full ISO second resolution, taken
+# at watch START — a date-only floor would count metrics banked earlier
+# the same day (before this watch) as fresh and exit without measuring
+since=$(date -u +%Y-%m-%dT%H:%M:%S)
 deadline=$(( $(date +%s) + ${HPX_WATCH_BUDGET_S:-32400} ))   # 9h default
 
 metrics=(flash_attention_tflops transformer_step_ms \
@@ -40,7 +44,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # one more full pass with tuned blocks, then exit if it all banked
     HPX_BENCH_PROBE_BUDGET=120 HPX_BENCH_CHILD_TIMEOUT=2700 \
         timeout 3000 python bench.py >> "$log" 2>&1
-    if HPX_WATCH_SINCE="$(date -u -d "@$((deadline - ${HPX_WATCH_BUDGET_S:-32400}))" +%Y-%m-%d 2>/dev/null || date -u +%Y-%m-%d)" \
+    if HPX_WATCH_SINCE="$since" \
         python - <<'EOF'
 import json, os, sys
 try:

@@ -30,12 +30,16 @@ def _host_scan(arr, init, op, inclusive: bool, transform=None):
     if transform is None:
         # widen to the accumulator's dtype (init may promote, e.g. int
         # input with float init) — matches device-path/std semantics
+        # hpxlint: disable-next=HPX002 — init is a host scalar;
+        # asarray here is a dtype probe, not a device sync
         out = np.empty(len(arr), dtype=np.result_type(arr, np.asarray(init)))
         first = arr[0] if len(arr) else None
     else:
         # transform element 0 once: dtype probe AND iteration value
         first = transform(arr[0]) if len(arr) else None
         out = np.empty(len(arr),
+                       # hpxlint: disable-next=HPX002 — dtype probe on the
+                       # host-transformed first element, not a device sync
                        dtype=np.result_type(np.asarray(first))
                        if len(arr) else float)
     acc = init

@@ -1,0 +1,57 @@
+"""hpxlint — AST-based static analysis for the hpx_tpu runtime.
+
+The dynamic VERIFY_LOCKS analog (`hpx_tpu.synchronization`) only fires
+on the paths a test happens to execute; this package is its static
+complement.  A small stdlib-`ast` framework (rule registry, per-rule
+severity, file/line findings, inline ``# hpxlint: disable=RULE``
+suppressions, committed baseline) runs a rule pack targeting the
+runtime's real hazard classes:
+
+* HPX001 lock-held-wait      — future/latch/CV waits lexically inside a
+  ``with Mutex():`` region (the classic AMT deadlock, SURVEY.md §5.2).
+* HPX002 host-sync-hot-path  — ``np.asarray`` / ``.item()`` /
+  ``block_until_ready`` / ``jax.device_get`` in executor/continuation
+  code under ``hpx_tpu/{futures,exec,algo,ops}`` (the "task granularity
+  chasm": a hidden device sync stalls the whole dispatch pipeline).
+* HPX003 dropped-future      — ``async_()/async_many()/dataflow()`` or
+  ``.then()`` results discarded as expression statements (the captured
+  exception is silently lost; ``post()`` is the fire-and-forget API and
+  is deliberately not flagged — it returns ``None`` by design).
+* HPX004 raw-sync-primitive  — raw ``threading.Lock``/``time.sleep``/
+  ``queue.Queue`` in runtime layers above ``hpx_tpu.synchronization``
+  (which futures/, runtime/ and core/ sit *below* — they stay on the raw
+  substrate and are exempt).
+* HPX005 jit-in-loop         — ``jax.jit`` constructed inside a loop
+  body (a fresh jitted callable per iteration defeats the trace cache).
+* HPX006 bare-except         — ``except:`` swallows future exceptions
+  (and KeyboardInterrupt/SystemExit) on the completion path.
+
+Run it: ``python -m hpx_tpu.analysis [paths...]`` (defaults to
+``hpx_tpu/``; run from the repo root so baseline paths line up).
+"""
+
+from .engine import (
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    apply_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    register,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "apply_baseline",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "register",
+    "write_baseline",
+]

@@ -1,0 +1,93 @@
+"""hpxlint CLI: ``python -m hpx_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (all findings suppressed or baselined), 1 new
+findings, 2 usage error.  Run from the repo root so the committed
+baseline's relative paths match.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .engine import (
+    DEFAULT_BASELINE,
+    all_rules,
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+
+def _list_rules() -> str:
+    lines = []
+    for rule in all_rules():
+        doc = (type(rule).__doc__ or "").strip().splitlines()
+        head = doc[0].split(": ", 1)[-1] if doc else ""
+        lines.append(f"{rule.id}  {rule.name:<20} [{rule.severity}]  "
+                     f"{head}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hpxlint",
+        description="AST-based async-misuse & TPU-hot-path linter for "
+                    "the hpx_tpu runtime.")
+    ap.add_argument("paths", nargs="*", default=["hpx_tpu"],
+                    help="files/directories to lint (default: hpx_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default: the committed "
+                         "hpx_tpu/analysis/hpxlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into --baseline "
+                         "and exit 0")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids/names to run "
+                         "(default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    select = [s.strip() for s in args.select.split(",") if s.strip()]
+    try:
+        rules = all_rules(select or None)
+        result = lint_paths(args.paths, rules)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"hpxlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(result.findings, args.baseline)
+        print(f"hpxlint: wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    budget = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined = apply_baseline(result.findings, budget)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "baselined": baselined, "suppressed": result.suppressed,
+            "checked_files": result.checked_files}, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        print(f"hpxlint: {result.checked_files} file(s), "
+              f"{len(new)} new finding(s), {baselined} baselined, "
+              f"{result.suppressed} suppressed")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,369 @@
+"""hpxlint engine: findings, rule registry, suppressions, baseline.
+
+Pure stdlib (`ast` + `tokenize` + `json`): the linter must be runnable
+in CI images that have no accelerator stack at all, and importing it
+must never pull in jax — rules reason about *source*, not live objects.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Type
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: rule id, severity, location, stable message.
+
+    Messages must be deterministic and free of line numbers — the
+    baseline matches on ``(path, rule, message)`` so findings survive
+    unrelated edits that shift lines.
+    """
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.message)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}")
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Type["Rule"]] = {}
+
+
+def register(cls: Type["Rule"]) -> Type["Rule"]:
+    """Class decorator adding a rule to the global registry."""
+    if not re.fullmatch(r"HPX\d{3}", cls.id):
+        raise ValueError(f"rule id must look like HPX001, got {cls.id!r}")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"{cls.id}: severity must be one of {SEVERITIES}")
+    if cls.id in _REGISTRY and _REGISTRY[cls.id] is not cls:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+class Rule:
+    """Base class: subclass, set the class attrs, implement check().
+
+    check() receives a :class:`FileContext` and yields findings via
+    ``self.finding(ctx, node, message)``.  Keep messages line-number
+    free (see Finding) and make each rule's docstring say how to fix
+    the violation — the CLI prints it for ``--list-rules``.
+    """
+
+    id: str = "HPX000"
+    name: str = ""
+    severity: str = "error"
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST,
+                message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity,
+                       path=ctx.display_path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message)
+
+
+def all_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
+    """Instances of every registered rule (or the selected subset, by
+    id or name), in id order."""
+    from . import rules as _rules  # noqa: F401  (registers on import)
+
+    chosen = []
+    for rid in sorted(_REGISTRY):
+        cls = _REGISTRY[rid]
+        if select and rid not in select and cls.name not in select:
+            continue
+        chosen.append(cls())
+    if select and not chosen:
+        known = [f"{r} ({_REGISTRY[r].name})" for r in sorted(_REGISTRY)]
+        raise ValueError(f"--select matched no rules; known: {known}")
+    return chosen
+
+
+# ---------------------------------------------------------------------------
+# Per-file context: parsed tree, import aliases, suppressions
+# ---------------------------------------------------------------------------
+
+class FileContext:
+    """Everything a rule needs about one file, computed once."""
+
+    def __init__(self, source: str, display_path: str) -> None:
+        self.source = source
+        # posix-style path as shown in findings and matched by the
+        # baseline; callers pass paths relative to the scan root (repo
+        # root in CI) so records are machine-independent
+        self.display_path = display_path.replace(os.sep, "/")
+        self.tree = ast.parse(source)
+        self._aliases = _import_aliases(self.tree)
+
+    def resolve_call(self, func: ast.AST) -> str:
+        """Canonical dotted name of a call target, import-aliases
+        resolved: ``np.asarray`` -> ``numpy.asarray`` under
+        ``import numpy as np``; ``Lock`` -> ``threading.Lock`` under
+        ``from threading import Lock``.  Unresolvable shapes
+        (subscripts, calls-of-calls) give ''."""
+        parts: List[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return ""
+        parts.append(node.id)
+        parts.reverse()
+        head = parts[0]
+        if head in self._aliases:
+            parts[0:1] = self._aliases[head].split(".")
+        return ".".join(parts)
+
+    def in_subpath(self, *fragments: str) -> bool:
+        return any(f in self.display_path for f in fragments)
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name != "*":
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+# ---------------------------------------------------------------------------
+# Inline suppressions
+# ---------------------------------------------------------------------------
+
+_DIRECTIVE = re.compile(
+    r"#\s*hpxlint:\s*(disable|disable-next|disable-file)"
+    r"\s*=\s*([A-Za-z0-9_,\-\s]+)")
+
+
+class Suppressions:
+    """Parsed ``# hpxlint:`` directives for one file.
+
+    * ``# hpxlint: disable=HPX003``        — this line (trailing comment);
+      on a comment-only line it behaves like ``disable-next``
+    * ``# hpxlint: disable-next=HPX003``   — the next *code* line
+      (continuation comment lines in between are skipped, so a
+      justification may span several comment lines)
+    * ``# hpxlint: disable-file=HPX004``   — the whole file
+    * ``all`` suppresses every rule; ids and rule names both work.
+
+    A justification belongs in the same comment, after the directive:
+    ``# hpxlint: disable=HPX002 — boundary sync, see docstring``.
+    """
+
+    def __init__(self, source: str) -> None:
+        self.by_line: Dict[int, set] = {}
+        self.whole_file: set = set()
+        code_lines: set = set()
+        _skip = (tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE,
+                 tokenize.INDENT, tokenize.DEDENT, tokenize.ENDMARKER,
+                 tokenize.ENCODING)
+        try:
+            comments = []
+            for t in tokenize.generate_tokens(io.StringIO(source).readline):
+                if t.type == tokenize.COMMENT:
+                    comments.append((t.start[0], t.string))
+                elif t.type not in _skip:
+                    for ln in range(t.start[0], t.end[0] + 1):
+                        code_lines.add(ln)
+        except (tokenize.TokenError, IndentationError):
+            comments = []
+        for lineno, text in comments:
+            m = _DIRECTIVE.search(text)
+            if not m:
+                continue
+            kind = m.group(1)
+            names = {n.strip() for n in
+                     m.group(2).split("—")[0].split(",") if n.strip()}
+            if kind == "disable-file":
+                self.whole_file |= names
+                continue
+            if kind == "disable" and lineno in code_lines:
+                target = lineno            # trailing comment on a code line
+            else:
+                # disable-next, or a standalone disable comment: apply to
+                # the next code line so justifications can span lines
+                target = next((ln for ln in sorted(code_lines)
+                               if ln > lineno), lineno + 1)
+            self.by_line.setdefault(target, set()).update(names)
+
+    def suppresses(self, finding: Finding) -> bool:
+        rule_cls = _REGISTRY.get(finding.rule)
+        labels = {finding.rule, "all"}
+        if rule_cls is not None:
+            labels.add(rule_cls.name)
+        if labels & self.whole_file:
+            return True
+        return bool(labels & self.by_line.get(finding.line, set()))
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int = 0
+    checked_files: int = 0
+
+
+def lint_source(source: str, display_path: str,
+                rules: Optional[Sequence[Rule]] = None) -> LintResult:
+    """Lint one in-memory source blob (the unit the fixture tests use)."""
+    rules = list(rules) if rules is not None else all_rules()
+    try:
+        ctx = FileContext(source, display_path)
+    except SyntaxError as e:
+        return LintResult(findings=[Finding(
+            rule="HPX000", severity="error",
+            path=display_path.replace(os.sep, "/"),
+            line=e.lineno or 1, col=(e.offset or 0) or 1,
+            message=f"syntax error: {e.msg}")], checked_files=1)
+    sup = Suppressions(source)
+    kept: List[Finding] = []
+    n_sup = 0
+    for rule in rules:
+        for f in rule.check(ctx):
+            if sup.suppresses(f):
+                n_sup += 1
+            else:
+                kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings=kept, suppressed=n_sup, checked_files=1)
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+        else:
+            raise FileNotFoundError(p)
+
+
+def lint_paths(paths: Sequence[str],
+               rules: Optional[Sequence[Rule]] = None) -> LintResult:
+    rules = list(rules) if rules is not None else all_rules()
+    total = LintResult(findings=[])
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))  # parent of hpx_tpu/
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        absolute = os.path.abspath(path)
+        if absolute.startswith(root + os.sep):
+            # anchor at the repo root so baseline paths match no matter
+            # what cwd or path spelling the linter was invoked with
+            display = os.path.relpath(absolute, root)
+        else:
+            rel = os.path.relpath(path)
+            # keep display paths rooted at the scan target, never "../.."
+            display = path if rel.startswith("..") else rel
+        res = lint_source(source, display, rules)
+        total.findings.extend(res.findings)
+        total.suppressed += res.suppressed
+        total.checked_files += 1
+    total.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Baseline: committed record of accepted pre-existing findings
+# ---------------------------------------------------------------------------
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "hpxlint_baseline.json")
+
+
+def load_baseline(path: str = DEFAULT_BASELINE
+                  ) -> Dict[Tuple[str, str, str], int]:
+    """{(path, rule, message): allowed_count}. Missing file = empty."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+    except OSError:
+        return {}
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in rec.get("entries", []):
+        key = (e["path"], e["rule"], e["message"])
+        budget[key] = budget.get(key, 0) + int(e.get("count", 1))
+    return budget
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   budget: Dict[Tuple[str, str, str], int],
+                   ) -> Tuple[List[Finding], int]:
+    """Split findings into (new, n_baselined): each baseline entry
+    absorbs up to `count` findings with the same (path, rule, message)."""
+    remaining = dict(budget)
+    new: List[Finding] = []
+    matched = 0
+    for f in findings:
+        k = f.baseline_key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    return new, matched
+
+
+def write_baseline(findings: Sequence[Finding], path: str,
+                   justification: str = "accepted pre-existing finding "
+                   "(hpxlint --write-baseline)") -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    lines: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        k = f.baseline_key()
+        counts[k] = counts.get(k, 0) + 1
+        lines.setdefault(k, f.line)
+    entries = [{"path": p, "rule": r, "message": m, "count": c,
+                "near_line": lines[(p, r, m)],
+                "justification": justification}
+               for (p, r, m), c in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"comment": "hpxlint baseline — pre-existing findings "
+                   "accepted with justification; new findings beyond "
+                   "these counts fail the gate. near_line is advisory "
+                   "only (matching ignores it).",
+                   "entries": entries}, f, indent=1)
+        f.write("\n")

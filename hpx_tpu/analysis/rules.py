@@ -1,0 +1,371 @@
+"""The hpxlint rule pack — this runtime's real hazard classes.
+
+Each rule is a small `ast` walk over one file.  Rules are heuristic by
+design: they trade a few suppressible false positives for catching the
+failure modes that are silent at runtime (SURVEY.md §5.2 suspension
+deadlocks, §7 host/device sync stalls).  Every rule's docstring states
+the hazard and the fix — the CLI prints these for ``--list-rules``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from .engine import FileContext, Finding, Rule, register
+
+# layers containing executor/continuation code where a hidden device
+# sync stalls the dispatch pipeline (HPX002's scope)
+HOT_SUBPATHS = ("hpx_tpu/futures", "hpx_tpu/exec",
+                "hpx_tpu/algo", "hpx_tpu/ops")
+
+# layers *above* hpx_tpu.synchronization where raw primitives are banned
+# (HPX004's scope).  futures/, runtime/ and core/ sit BELOW it in the
+# import graph (synchronization.py itself imports futures.future) and
+# are the raw substrate; native/ is C++; analysis/ is host tooling.
+RAW_PRIMITIVE_EXEMPT = (
+    "hpx_tpu/synchronization.py", "hpx_tpu/runtime/", "hpx_tpu/core/",
+    "hpx_tpu/futures/", "hpx_tpu/native/", "hpx_tpu/utils/",
+    "hpx_tpu/testing.py", "hpx_tpu/analysis/",
+)
+
+_LOCK_TYPES = {"Mutex", "Spinlock", "SharedMutex"}
+
+
+def _lock_symbols(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """Names / self-attributes assigned from Mutex()/Spinlock()/
+    SharedMutex() anywhere in the module."""
+    names: Set[str] = set()
+    attrs: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, (ast.Name, ast.Attribute))):
+            continue
+        callee = (value.func.id if isinstance(value.func, ast.Name)
+                  else value.func.attr)
+        if callee not in _LOCK_TYPES:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                attrs.add(t.attr)
+    return names, attrs
+
+
+def _is_lock_expr(expr: ast.AST, names: Set[str], attrs: Set[str]) -> str:
+    """'' or the display name of a registered-lock `with` item."""
+    # `with m.shared():` — SharedMutex read side registers too
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr == "shared":
+        inner = _is_lock_expr(expr.func.value, names, attrs)
+        return f"{inner}.shared()" if inner else ""
+    if isinstance(expr, ast.Name) and expr.id in names:
+        return expr.id
+    if isinstance(expr, ast.Attribute) and expr.attr in attrs:
+        base = expr.value
+        prefix = f"{base.id}." if isinstance(base, ast.Name) else ""
+        return f"{prefix}{expr.attr}"
+    return ""
+
+
+_WAIT_ATTRS = {"wait", "arrive_and_wait", "acquire", "result"}
+_WAIT_NAMES = {"wait_all", "wait_any", "wait_some", "wait_each"}
+
+
+@register
+class LockHeldWaitRule(Rule):
+    """HPX001: a blocking wait lexically inside a ``with`` block on a
+    registered `hpx_tpu.synchronization` Mutex/Spinlock/SharedMutex.
+
+    Suspending while holding a lock is the classic AMT deadlock the
+    runtime's VERIFY_LOCKS mode aborts on — but only on executed paths;
+    this catches it before any chip time is spent.  Fix: narrow the
+    critical section so the wait happens after ``unlock()`` (snapshot
+    state under the lock, wait outside), or restructure with a
+    continuation (``future.then``) instead of a blocking ``get()``.
+    """
+
+    id = "HPX001"
+    name = "lock-held-wait"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names, attrs = _lock_symbols(ctx.tree)
+        if not names and not attrs:
+            return
+        out: List[Finding] = []
+
+        def scan_block(body: List[ast.stmt], lock_name: str) -> None:
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    func = node.func
+                    if isinstance(func, ast.Attribute):
+                        attr = func.attr
+                        blocking = attr in _WAIT_ATTRS or (
+                            # zero-arg .get() is a future get; dict.get
+                            # always takes at least the key
+                            attr == "get" and not node.args
+                            and not node.keywords)
+                        if blocking:
+                            out.append(self.finding(
+                                ctx, node,
+                                f".{attr}() reachable while registered "
+                                f"lock `{lock_name}` is held — "
+                                "suspension under a lock deadlocks the "
+                                "scheduler (VERIFY_LOCKS aborts here at "
+                                "runtime); wait after unlock or use a "
+                                "continuation"))
+                    elif isinstance(func, ast.Name) \
+                            and func.id in _WAIT_NAMES:
+                        out.append(self.finding(
+                            ctx, node,
+                            f"{func.id}() reachable while registered "
+                            f"lock `{lock_name}` is held — suspension "
+                            "under a lock deadlocks the scheduler"))
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                lock_name = _is_lock_expr(item.context_expr, names, attrs)
+                if lock_name:
+                    scan_block(node.body, lock_name)
+                    break
+        yield from out
+
+
+@register
+class HostSyncHotPathRule(Rule):
+    """HPX002: host-device synchronization in executor/continuation
+    code (``hpx_tpu/{futures,exec,algo,ops}``).
+
+    ``np.asarray`` / ``jax.device_get`` / ``.block_until_ready()`` /
+    ``.item()`` / ``float(x[i])`` all block the host until the device
+    catches up, stalling every queued dispatch behind them — the "task
+    granularity chasm" (SURVEY.md §7).  Fix: keep values as jax.Arrays
+    (dispatch is already async), move the materialization to the
+    consumer boundary, or route it through ``exec.tpu``'s watcher so a
+    future completes off-thread.  Intentional boundary syncs get an
+    inline ``# hpxlint: disable=HPX002 — <why>``.
+    """
+
+    id = "HPX002"
+    name = "host-sync-hot-path"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_subpath(*HOT_SUBPATHS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.resolve_call(node.func)
+            if dotted == "numpy.asarray":
+                yield self.finding(
+                    ctx, node, "np.asarray() forces a device->host "
+                    "transfer in hot-path code — keep the value a "
+                    "jax.Array or sync at the consumer boundary")
+            elif dotted == "jax.device_get":
+                yield self.finding(
+                    ctx, node, "jax.device_get() blocks on the device "
+                    "in hot-path code — sync at the consumer boundary")
+            elif dotted == "jax.block_until_ready" or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"):
+                yield self.finding(
+                    ctx, node, "block_until_ready() stalls the dispatch "
+                    "pipeline in hot-path code — route through the "
+                    "exec.tpu watcher so a future completes off-thread")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                yield self.finding(
+                    ctx, node, ".item() materializes a device scalar on "
+                    "the host in hot-path code — defer to the consumer "
+                    "boundary")
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int") \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Subscript):
+                yield self.finding(
+                    ctx, node, f"{node.func.id}(x[...]) materializes a "
+                    "device element on the host in hot-path code — "
+                    "defer to the consumer boundary")
+
+
+_FUTURE_FACTORIES = {"async_", "async_many", "dataflow"}
+
+
+@register
+class DroppedFutureRule(Rule):
+    """HPX003: the future returned by ``async_()``, ``async_many()``,
+    ``dataflow()`` or ``.then()`` discarded as an expression statement.
+
+    A dropped future silently swallows the exception it may carry and
+    severs the dependency graph (nothing can wait on the work).  Fix:
+    keep the future (wait/compose it), or use ``post()`` /
+    ``post_many()`` — the deliberate fire-and-forget API, which returns
+    ``None`` and is therefore not flagged.
+    """
+
+    id = "HPX003"
+    name = "dropped-future"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            func = node.value.func
+            label = ""
+            if isinstance(func, ast.Name) and func.id in _FUTURE_FACTORIES:
+                label = f"{func.id}()"
+            elif isinstance(func, ast.Attribute):
+                if func.attr in _FUTURE_FACTORIES:
+                    label = f"{func.attr}()"
+                elif func.attr == "then":
+                    label = ".then()"
+            if label:
+                yield self.finding(
+                    ctx, node,
+                    f"result of {label} is discarded — the future (and "
+                    "any exception it carries) is lost; keep it, or use "
+                    "post() for fire-and-forget")
+
+
+_RAW_PRIMITIVES = {
+    "threading.Lock": "hpx_tpu.synchronization.Mutex",
+    "threading.RLock": "hpx_tpu.synchronization.Mutex (non-reentrant: "
+                       "restructure, or justify keeping RLock)",
+    "time.sleep": "exec.execution_base yield/backoff helpers or a "
+                  "Latch/Event wait with timeout",
+    "queue.Queue": "lcos.local.Channel (futures-returning) or "
+                   "runtime.threadpool work queues",
+}
+
+
+@register
+class RawPrimitiveRule(Rule):
+    """HPX004: raw ``threading.Lock``/``threading.RLock``/
+    ``time.sleep``/``queue.Queue`` in runtime layers above
+    ``hpx_tpu.synchronization``.
+
+    Raw primitives bypass the VERIFY_LOCKS held-lock registration, so
+    the dynamic deadlock guard cannot see them, and raw sleeps/queues
+    block OS threads the work-helping scheduler could otherwise use.
+    Fix: use the ``hpx_tpu.synchronization`` equivalents (Mutex,
+    ConditionVariable, Latch, Event, semaphores) or the lcos channels.
+    The substrate below synchronization.py (futures/, runtime/, core/)
+    is exempt — it is what those primitives are built from.
+    """
+
+    id = "HPX004"
+    name = "raw-sync-primitive"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if "hpx_tpu/" not in ctx.display_path \
+                or ctx.in_subpath(*RAW_PRIMITIVE_EXEMPT):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.resolve_call(node.func)
+            replacement = _RAW_PRIMITIVES.get(dotted)
+            if replacement:
+                yield self.finding(
+                    ctx, node,
+                    f"raw {dotted}() in a runtime module — invisible to "
+                    f"VERIFY_LOCKS; use {replacement}")
+
+
+@register
+class JitInLoopRule(Rule):
+    """HPX005: ``jax.jit`` constructed inside a loop body.
+
+    Each ``jax.jit(f)`` call creates a fresh jitted callable with an
+    empty trace cache, so a loop that rebuilds one recompiles every
+    iteration (the recompile trap).  Fix: hoist the jit out of the
+    loop, or memoize the built program on its static configuration
+    (see ``models.transformer._cached_program``).
+    """
+
+    id = "HPX005"
+    name = "jit-in-loop"
+    severity = "warning"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+
+        def is_jit(node: ast.AST) -> bool:
+            return isinstance(node, (ast.Name, ast.Attribute)) and \
+                ctx.resolve_call(node) in ("jax.jit", "jax.pjit")
+
+        def walk(node: ast.AST, in_loop: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_in_loop = in_loop
+                if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                    child_in_loop = True
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda)):
+                    # a def inside a loop still *runs* jit per iteration
+                    # via its decorators; its body runs only when called
+                    if in_loop and not isinstance(child, ast.Lambda):
+                        for dec in child.decorator_list:
+                            target = dec.func if isinstance(dec, ast.Call) \
+                                else dec
+                            if is_jit(target):
+                                out.append(self._hit(ctx, dec))
+                    child_in_loop = False
+                if isinstance(child, ast.Call) and in_loop:
+                    if is_jit(child.func):
+                        out.append(self._hit(ctx, child))
+                    elif ctx.resolve_call(child.func) == \
+                            "functools.partial" and child.args \
+                            and is_jit(child.args[0]):
+                        out.append(self._hit(ctx, child))
+                walk(child, child_in_loop)
+
+        walk(ctx.tree, False)
+        yield from out
+
+    def _hit(self, ctx: FileContext, node: ast.AST) -> Finding:
+        return self.finding(
+            ctx, node, "jax.jit constructed inside a loop — a fresh "
+            "jitted callable per iteration defeats the trace cache "
+            "(recompile trap); hoist it or memoize on the static "
+            "config (models.transformer._cached_program)")
+
+
+@register
+class BareExceptRule(Rule):
+    """HPX006: bare ``except:``.
+
+    A bare except catches ``BaseException`` — including
+    ``KeyboardInterrupt``/``SystemExit`` and the runtime's own
+    ``DeadlockError`` — so a failing continuation is silently swallowed
+    instead of poisoning its future.  Fix: catch a concrete exception
+    type, or ``except BaseException:`` + re-raise/``set_exception`` if
+    the handler really must see everything (as the future completion
+    paths do).
+    """
+
+    id = "HPX006"
+    name = "bare-except"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield self.finding(
+                    ctx, node, "bare except: swallows future exceptions "
+                    "(and KeyboardInterrupt/DeadlockError) — catch a "
+                    "concrete type or re-raise into the future")

@@ -22,12 +22,12 @@ propagates to every participating site's future.
 from __future__ import annotations
 
 import operator
-import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..dist.actions import async_action, plain_action
 from ..dist.runtime import find_here, get_num_localities
 from ..futures.future import Future, SharedState
+from ..synchronization import Mutex
 
 # ---------------------------------------------------------------------------
 # Root-side exchange state. One generic primitive: every site contributes a
@@ -35,7 +35,7 @@ from ..futures.future import Future, SharedState
 # combine computes each site's result and releases all futures.
 # ---------------------------------------------------------------------------
 
-_lock = threading.Lock()
+_lock = Mutex()
 _exchanges: Dict[Tuple[str, str, int], dict] = {}
 _hosted_total = 0     # exchanges whose root state lived HERE (cumulative)
 
@@ -159,7 +159,7 @@ class Communicator:
                           else find_here())
         self.root_locality = root_locality
         self._gen: Dict[str, int] = {}
-        self._gen_lock = threading.Lock()
+        self._gen_lock = Mutex()
 
     def _next_gen(self, kind: str, generation: Optional[int]) -> int:
         with self._gen_lock:

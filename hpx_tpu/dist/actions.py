@@ -16,14 +16,14 @@ callable is scheduled directly (SURVEY.md §3.4).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, Optional
 
 from ..core.errors import BadParameter
 from ..futures.future import Future
+from ..synchronization import Mutex
 
 _registry: Dict[str, Callable] = {}
-_registry_lock = threading.Lock()
+_registry_lock = Mutex()
 
 
 def _qualname(fn: Callable) -> str:

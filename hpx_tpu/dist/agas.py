@@ -15,14 +15,14 @@ All functions return Futures (AGAS requests are remote actions).
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Optional
 
 from ..futures.future import Future, make_ready_future
 from .actions import async_action, plain_action
+from ..synchronization import Mutex
 
 _symbols: Dict[str, Any] = {}
-_symbols_lock = threading.Lock()
+_symbols_lock = Mutex()
 _waiters: Dict[str, list] = {}
 
 

@@ -41,6 +41,7 @@ from ..core.errors import Error, HpxError
 from ..futures.future import Future, make_ready_future
 from .actions import async_action, plain_action, post_action
 from .runtime import find_here, get_num_localities
+from ..synchronization import Mutex
 
 # ---------------------------------------------------------------------------
 # gid / id_type
@@ -87,7 +88,7 @@ class IdType:
 # ---------------------------------------------------------------------------
 
 _types: Dict[str, Type] = {}
-_types_lock = threading.Lock()
+_types_lock = Mutex()
 
 
 def register_component_type(cls: Type, name: Optional[str] = None) -> Type:
@@ -152,7 +153,7 @@ class _Entry:
 
 _instances: Dict[Tuple[int, str, int], _Entry] = {}
 _forwards: Dict[Tuple[int, str, int], int] = {}   # gid key -> locality
-_inst_lock = threading.Lock()
+_inst_lock = Mutex()
 _next_lid = [0]
 
 

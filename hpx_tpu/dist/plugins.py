@@ -21,6 +21,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core.errors import Error, HpxError
+from ..synchronization import Mutex
 
 __all__ = [
     "register_plugin", "get_plugin", "list_plugins",
@@ -32,7 +33,7 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 _plugins: Dict[Tuple[str, str], Any] = {}
-_plugins_lock = threading.Lock()
+_plugins_lock = Mutex()
 
 
 def register_plugin(kind: str, name: str, factory: Any,
@@ -166,6 +167,8 @@ class Coalescer:
         self.max_count = max_count
         self.max_bytes = max_bytes
         self.interval_s = interval_s
+        # hpxlint: disable-next=HPX004 — threading.Condition below needs
+        # the raw lock object (Mutex has no acquire/release interface)
         self._lock = threading.Lock()
         self._queues: Dict[int, List[Any]] = {}
         self._bytes: Dict[int, int] = {}

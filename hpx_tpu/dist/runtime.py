@@ -466,6 +466,8 @@ class Runtime:
                                 self._reply(src_loc, req_id, True, f.get())
                     finally:
                         done()
+                # hpxlint: disable=HPX003 — on_ready() is the sink: it
+                # replies or forwards the exception; then-future unused
                 value.then(on_ready)
                 return
             if req_id is not None:

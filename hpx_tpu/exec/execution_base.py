@@ -58,6 +58,8 @@ def yield_() -> bool:
     pool = current_worker_pool()
     if pool is not None:
         return bool(pool.help_one())
+    # hpxlint: disable-next=HPX004 — this module IS the yield/backoff
+    # substrate the rule points users to; sleep(0) is the OS yield
     time.sleep(0)
     return False
 
@@ -69,7 +71,9 @@ def suspend(seconds: float) -> None:
     verify_no_locks_held("suspend")
     pool = current_worker_pool()
     if pool is None:
-        time.sleep(seconds)          # nothing to help — one plain wait
+        # hpxlint: disable-next=HPX004 — substrate: nothing to help,
+        # one plain wait
+        time.sleep(seconds)
         return
     deadline = time.monotonic() + seconds
     while True:
@@ -77,6 +81,7 @@ def suspend(seconds: float) -> None:
         if remaining <= 0:
             return
         if not pool.help_one():
+            # hpxlint: disable-next=HPX004 — substrate micro-park
             time.sleep(min(remaining, 0.0005))
 
 
@@ -95,6 +100,7 @@ def yield_while(pred: Callable[[], bool],
             return False
         helped = bool(pool.help_one()) if pool is not None else False
         if not helped:
+            # hpxlint: disable-next=HPX004 — substrate yield_k backoff
             time.sleep(0 if k < 16 else 0.0002)
         k += 1
     return True

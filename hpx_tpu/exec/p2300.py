@@ -28,6 +28,7 @@ import threading
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..futures.future import Future, SharedState
+from ..synchronization import Mutex
 
 __all__ = [
     "Sender", "schedule", "just", "just_error", "just_stopped", "then",
@@ -331,7 +332,7 @@ class _WhenAllSender(Sender):
             # empty when_all completes immediately (P2300 semantics)
             return _FnOp(receiver.set_value)
         state = {"left": n, "vals": [None] * n, "done": False}
-        lock = threading.Lock()
+        lock = Mutex()
 
         def finish_error(exc: BaseException) -> None:
             with lock:

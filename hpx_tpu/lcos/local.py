@@ -13,11 +13,11 @@ neighbor's boundary for timestep t.
 from __future__ import annotations
 
 import collections
-import threading
 from typing import Any, Callable, Deque, Dict, Generic, List, Optional, TypeVar
 
 from ..core.errors import Error, HpxError
 from ..futures.future import Future, Promise, SharedState, make_ready_future
+from ..synchronization import Mutex
 
 T = TypeVar("T")
 
@@ -31,7 +31,7 @@ class Channel(Generic[T]):
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = Mutex()
         self._values: Deque[Any] = collections.deque()
         self._waiters: Deque[SharedState] = collections.deque()
         self._closed = False
@@ -84,7 +84,7 @@ class OneElementChannel(Generic[T]):
     """Single-slot channel: set blocks (fails) while a value is pending."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = Mutex()
         self._slot: Optional[SharedState] = None  # ready value waiting
         self._waiter: Optional[SharedState] = None
 
@@ -122,7 +122,7 @@ class ReceiveBuffer(Generic[T]):
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = Mutex()
         self._slots: Dict[int, SharedState] = {}
 
     def _slot(self, step: int) -> SharedState:
@@ -180,7 +180,7 @@ class AndGate:
 
     def __init__(self, count: int) -> None:
         self._count = count
-        self._lock = threading.Lock()
+        self._lock = Mutex()
         self._generation = 0
         self._set: set = set()
         self._state = SharedState()
@@ -216,7 +216,7 @@ class AndGate:
         return self._generation
 
 
-_guard_swap_lock = threading.Lock()
+_guard_swap_lock = Mutex()
 
 
 class CompositeGuard:
@@ -265,5 +265,7 @@ def run_guarded(guards: List[CompositeGuard], fn: Callable[[], Any]) -> Future:
         except BaseException as e:  # noqa: BLE001
             result.set_exception(e)
 
+    # hpxlint: disable=HPX003 — fire() is the sink: it captures the
+    # result/exception into `result`; the then-future is unused by design
     when_all(prevs).then(fire)
     return done

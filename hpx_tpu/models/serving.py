@@ -46,6 +46,31 @@ from .transformer import (
 __all__ = ["ContinuousServer"]
 
 
+def _normalize_key(key):
+    """Coerce a user PRNG key to the raw uint32 layout the batched
+    sampler needs: step() stacks the per-slot keys with jnp.stack, which
+    fails (or silently mis-samples) on a mix of typed jax.random.key
+    arrays and raw PRNGKey arrays. Typed keys are unwrapped via
+    key_data; raw uint32 arrays pass through; anything else is rejected
+    here at submit() instead of surfacing as a stack/shape error deep in
+    step()."""
+    try:
+        arr = jnp.asarray(key)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"key is not a PRNG key (got {type(key).__name__}); pass "
+            "jax.random.key(seed) or jax.random.PRNGKey(seed)") from e
+    if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key):
+        arr = jax.random.key_data(arr)
+    raw = jax.random.PRNGKey(0)
+    if arr.shape != raw.shape or arr.dtype != raw.dtype:
+        raise ValueError(
+            "key must be a typed jax.random.key(...) or a raw uint32 "
+            f"jax.random.PRNGKey(...) of shape {raw.shape}; got shape "
+            f"{arr.shape} dtype {arr.dtype}")
+    return arr
+
+
 def _rope_rows(x, pos, cfg: TransformerConfig):
     """Rotate-half RoPE with PER-ROW positions: x [B, 1, N, H],
     pos [B] int32 (transformer._rope takes one shared [S] vector)."""
@@ -295,6 +320,8 @@ class ContinuousServer:
             raise ValueError(
                 "key has no effect at temperature=0 (greedy); pass "
                 "temperature > 0 to sample")
+        if key is not None:
+            key = _normalize_key(key)
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(rid, prompt, max_new, eos_id,

@@ -14,12 +14,12 @@ Usage:
 from __future__ import annotations
 
 import sys
-import threading
 from typing import Any, List
 
 from ..dist.actions import async_action, plain_action
 from ..dist.runtime import find_here, find_root_locality
 from ..futures.future import Future
+from ..synchronization import Mutex
 
 
 @plain_action(name="iostreams.write")
@@ -38,7 +38,7 @@ class _DistStream:
         self._stream = stream
         self._buf: List[str] = []
         self._pending: List[Future] = []
-        self._lock = threading.Lock()
+        self._lock = Mutex()
 
     def write(self, text: Any) -> "_DistStream":
         s = str(text)

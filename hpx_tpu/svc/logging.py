@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import logging
 import sys
-import threading
+from ..synchronization import Mutex
 from typing import Optional
 
 _LEVELS = {
@@ -24,7 +24,7 @@ _LEVELS = {
 }
 
 _configured = False
-_lock = threading.Lock()
+_lock = Mutex()
 
 
 class _LocalityFilter(logging.Filter):

@@ -18,6 +18,8 @@ import dataclasses
 import fnmatch
 import re
 import threading
+
+from ..synchronization import Mutex
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -83,7 +85,7 @@ class GaugeCounter(Counter):
 
     def __init__(self, initial: float = 0.0) -> None:
         self._v = initial
-        self._lock = threading.Lock()
+        self._lock = Mutex()
 
     def add(self, delta: float = 1.0) -> None:
         with self._lock:
@@ -147,7 +149,7 @@ class AverageCounter(Counter):
     def __init__(self) -> None:
         self._sum = 0.0
         self._n = 0
-        self._lock = threading.Lock()
+        self._lock = Mutex()
 
     def sample(self, value: float) -> None:
         with self._lock:
@@ -167,6 +169,9 @@ class AverageCounter(Counter):
 # Registry
 # ---------------------------------------------------------------------------
 
+# hpxlint: disable-next=HPX004 — defensively reentrant: counter
+# callbacks and refresh hooks may register/query while discovery holds
+# the lock; a non-reentrant Mutex would self-deadlock
 _registry_lock = threading.RLock()
 _registry: Dict[str, Counter] = {}
 _refresh_hooks: List[Callable[[], None]] = []

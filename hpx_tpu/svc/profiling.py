@@ -16,7 +16,7 @@ TPU-first: two planes —
 from __future__ import annotations
 
 import contextlib
-import threading
+from ..synchronization import Mutex
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 # external-timer registry (APEX hook analog)
 # ---------------------------------------------------------------------------
 
-_hooks_lock = threading.Lock()
+_hooks_lock = Mutex()
 _hooks: List[Any] = []      # objects with optional on_submit/on_start/on_stop
 
 
@@ -87,7 +87,7 @@ class TaskTimer:
     """Bundled external timer: per-function task counts + total seconds."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = Mutex()
         self.stats: Dict[str, list] = {}   # name -> [count, total_s]
 
     @staticmethod

@@ -22,7 +22,7 @@ import threading
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..core.errors import Error, HpxError
-from ..futures.async_ import async_
+from ..futures.async_ import async_, post as _post
 from ..futures.combinators import when_all
 from ..futures.future import Future, SharedState
 
@@ -210,7 +210,9 @@ class ReplayExecutor:
         return self._attempts(fn, args, kwargs)
 
     def post(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> None:
-        async_(self._attempts, fn, args, kwargs)
+        # real fire-and-forget: async_ here would drop the future AND
+        # the exception it carries (hpxlint HPX003 caught this)
+        _post(self._attempts, fn, args, kwargs)
 
 
 class ReplicateExecutor:

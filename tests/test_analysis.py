@@ -1,0 +1,395 @@
+"""hpxlint static-analysis framework tests.
+
+Each rule gets a minimal fixture that fires exactly once, plus the
+corrected form of the same code that stays silent — the pair pins both
+the detection AND the fix the rule's message recommends. The suite also
+covers the suppression directives, the baseline mechanism, and (as the
+lint gate) runs the real CLI over the real tree: a new finding anywhere
+in hpx_tpu/ fails this file.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hpx_tpu.analysis import (
+    Finding,
+    all_rules,
+    apply_baseline,
+    lint_paths,
+    lint_source,
+)
+from hpx_tpu.analysis.cli import main as cli_main
+from hpx_tpu.analysis.engine import Suppressions, load_baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings(source, path="hpx_tpu/exec/fixture.py", select=None):
+    res = lint_source(source, path, rules=all_rules(select))
+    return res.findings
+
+
+def rules_of(fs):
+    return [f.rule for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# HPX001 — future wait under a registered lock
+# ---------------------------------------------------------------------------
+
+HPX001_BAD = """\
+from hpx_tpu.synchronization import Mutex
+
+_lock = Mutex()
+
+def drain(f):
+    with _lock:
+        return f.get()
+"""
+
+HPX001_GOOD = """\
+from hpx_tpu.synchronization import Mutex
+
+_lock = Mutex()
+
+def drain(f):
+    with _lock:
+        pending = f
+    return pending.get()
+"""
+
+
+def test_hpx001_fires_once():
+    fs = findings(HPX001_BAD)
+    assert rules_of(fs) == ["HPX001"]
+    assert "_lock" in fs[0].message
+
+
+def test_hpx001_silent_after_fix():
+    assert findings(HPX001_GOOD) == []
+
+
+def test_hpx001_self_attribute_lock():
+    src = (
+        "from hpx_tpu.synchronization import Spinlock\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._mu = Spinlock()\n"
+        "    def pop(self, f):\n"
+        "        with self._mu:\n"
+        "            f.wait()\n"
+    )
+    assert rules_of(findings(src)) == ["HPX001"]
+
+
+def test_hpx001_ignores_unregistered_lock():
+    # only Mutex/Spinlock/SharedMutex register with VERIFY_LOCKS; a
+    # plain object with a context manager is out of scope (HPX004's job)
+    src = "with open('x') as fh:\n    f.get()\n"
+    assert findings(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HPX002 — host-device sync in hot-path modules
+# ---------------------------------------------------------------------------
+
+HPX002_BAD = """\
+import numpy as np
+
+def gather(device_arr):
+    return np.asarray(device_arr)
+"""
+
+HPX002_GOOD = """\
+import jax.numpy as jnp
+
+def gather(device_arr):
+    return jnp.asarray(device_arr)
+"""
+
+
+def test_hpx002_fires_once():
+    fs = findings(HPX002_BAD, path="hpx_tpu/algo/fixture.py")
+    assert rules_of(fs) == ["HPX002"]
+
+
+def test_hpx002_jnp_asarray_is_not_numpy():
+    # alias resolution must distinguish np->numpy from jnp->jax.numpy
+    assert findings(HPX002_GOOD, path="hpx_tpu/algo/fixture.py") == []
+
+
+def test_hpx002_only_in_hot_subpaths():
+    assert findings(HPX002_BAD, path="hpx_tpu/svc/fixture.py") == []
+
+
+def test_hpx002_block_until_ready_and_item():
+    src = "def f(x):\n    x.block_until_ready()\n    return x.item()\n"
+    fs = findings(src, path="hpx_tpu/futures/fixture.py")
+    assert rules_of(fs) == ["HPX002", "HPX002"]
+
+
+# ---------------------------------------------------------------------------
+# HPX003 — dropped future
+# ---------------------------------------------------------------------------
+
+HPX003_BAD = """\
+from hpx_tpu.futures.async_ import async_
+
+def kick(fn):
+    async_(fn)
+"""
+
+HPX003_GOOD = """\
+from hpx_tpu.futures.async_ import async_
+
+def kick(fn):
+    return async_(fn)
+"""
+
+
+def test_hpx003_fires_once():
+    assert rules_of(findings(HPX003_BAD)) == ["HPX003"]
+
+
+def test_hpx003_silent_when_kept():
+    assert findings(HPX003_GOOD) == []
+
+
+def test_hpx003_dropped_then():
+    src = "def chain(f):\n    f.then(print)\n"
+    assert rules_of(findings(src)) == ["HPX003"]
+
+
+def test_hpx003_post_is_fire_and_forget():
+    # post() returns None by design — not a dropped future
+    src = ("from hpx_tpu.futures.async_ import post\n"
+           "def kick(fn):\n"
+           "    post(fn)\n")
+    assert findings(src) == []
+
+
+# ---------------------------------------------------------------------------
+# HPX004 — raw primitives where registered ones are required
+# ---------------------------------------------------------------------------
+
+HPX004_BAD = """\
+import threading
+
+_lock = threading.Lock()
+"""
+
+HPX004_GOOD = """\
+from hpx_tpu.synchronization import Mutex
+
+_lock = Mutex()
+"""
+
+
+def test_hpx004_fires_once():
+    fs = findings(HPX004_BAD, path="hpx_tpu/svc/fixture.py")
+    assert rules_of(fs) == ["HPX004"]
+    assert "Mutex" in fs[0].message
+
+
+def test_hpx004_silent_after_fix():
+    assert findings(HPX004_GOOD, path="hpx_tpu/svc/fixture.py") == []
+
+
+def test_hpx004_exempt_below_synchronization():
+    # futures/runtime/core sit BELOW synchronization in the import graph
+    # and must keep raw primitives
+    assert findings(HPX004_BAD, path="hpx_tpu/futures/fixture.py") == []
+    assert findings(HPX004_BAD, path="hpx_tpu/runtime/fixture.py") == []
+
+
+def test_hpx004_time_sleep():
+    src = "import time\n\ndef nap():\n    time.sleep(1)\n"
+    fs = findings(src, path="hpx_tpu/dist/fixture.py")
+    assert rules_of(fs) == ["HPX004"]
+
+
+# ---------------------------------------------------------------------------
+# HPX005 — jit in a loop
+# ---------------------------------------------------------------------------
+
+HPX005_BAD = """\
+import jax
+
+def run(xs):
+    for x in xs:
+        y = jax.jit(lambda v: v + 1)(x)
+    return y
+"""
+
+HPX005_GOOD = """\
+import jax
+
+def run(xs):
+    step = jax.jit(lambda v: v + 1)
+    for x in xs:
+        y = step(x)
+    return y
+"""
+
+
+def test_hpx005_fires_once():
+    fs = findings(HPX005_BAD)
+    assert rules_of(fs) == ["HPX005"]
+    assert fs[0].severity == "warning"
+
+
+def test_hpx005_silent_when_hoisted():
+    assert findings(HPX005_GOOD) == []
+
+
+# ---------------------------------------------------------------------------
+# HPX006 — bare except
+# ---------------------------------------------------------------------------
+
+HPX006_BAD = "try:\n    x = 1\nexcept:\n    pass\n"
+HPX006_GOOD = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+
+
+def test_hpx006_fires_once():
+    assert rules_of(findings(HPX006_BAD)) == ["HPX006"]
+
+
+def test_hpx006_silent_with_type():
+    assert findings(HPX006_GOOD) == []
+
+
+# ---------------------------------------------------------------------------
+# engine: suppressions, syntax errors, baseline
+# ---------------------------------------------------------------------------
+
+def test_suppress_same_line():
+    src = "try:\n    x = 1\nexcept:  # hpxlint: disable=HPX006 — why\n    pass\n"
+    assert findings(src) == []
+
+
+def test_suppress_next_line():
+    src = ("try:\n    x = 1\n"
+           "# hpxlint: disable-next=HPX006 — reason\n"
+           "except:\n    pass\n")
+    assert findings(src) == []
+
+
+def test_suppress_next_skips_continuation_comments():
+    # a multi-line justification must not swallow the directive
+    src = ("try:\n    x = 1\n"
+           "# hpxlint: disable-next=HPX006 — a justification that\n"
+           "# spans several comment lines before the code\n"
+           "except:\n    pass\n")
+    assert findings(src) == []
+
+
+def test_suppress_whole_file():
+    src = "# hpxlint: disable-file=HPX006\ntry:\n    x=1\nexcept:\n    pass\n"
+    assert findings(src) == []
+
+
+def test_suppress_by_rule_name_and_all():
+    by_name = "try:\n    x=1\nexcept:  # hpxlint: disable=bare-except\n    pass\n"
+    assert findings(by_name) == []
+    by_all = "try:\n    x=1\nexcept:  # hpxlint: disable=all\n    pass\n"
+    assert findings(by_all) == []
+
+
+def test_suppress_wrong_rule_does_not_apply():
+    src = "try:\n    x=1\nexcept:  # hpxlint: disable=HPX004\n    pass\n"
+    assert rules_of(findings(src)) == ["HPX006"]
+
+
+def test_suppressions_counted():
+    src = "try:\n    x=1\nexcept:  # hpxlint: disable=HPX006\n    pass\n"
+    res = lint_source(src, "hpx_tpu/fixture.py", rules=all_rules())
+    assert res.suppressed == 1
+
+
+def test_syntax_error_is_a_finding():
+    fs = findings("def broken(:\n")
+    assert rules_of(fs) == ["HPX000"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    fs = findings(HPX006_BAD)
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"entries": [{
+        "path": "hpx_tpu/exec/fixture.py",
+        "rule": "HPX006",
+        "message": fs[0].message,
+        "count": 1,
+        "justification": "fixture",
+    }]}))
+    new, matched = apply_baseline(fs, load_baseline(str(path)))
+    assert new == [] and matched == 1
+    # a second identical finding exceeds the baselined count -> new
+    new2, matched2 = apply_baseline(fs + fs, load_baseline(str(path)))
+    assert len(new2) == 1 and matched2 == 1
+
+
+def test_baseline_does_not_match_other_files(tmp_path):
+    fs = findings(HPX006_BAD, path="hpx_tpu/other.py")
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"entries": [{
+        "path": "hpx_tpu/exec/fixture.py", "rule": "HPX006",
+        "message": fs[0].message, "count": 1,
+        "justification": "fixture"}]}))
+    new, matched = apply_baseline(fs, load_baseline(str(path)))
+    assert len(new) == 1 and matched == 0
+
+
+def test_select_rules():
+    src = HPX006_BAD + "\nimport threading\n_l = threading.Lock()\n"
+    only6 = findings(src, path="hpx_tpu/svc/fixture.py", select=["HPX006"])
+    assert rules_of(only6) == ["HPX006"]
+
+
+def test_finding_format():
+    f = Finding(rule="HPX006", severity="error", path="a/b.py",
+                line=3, col=0, message="m")
+    assert f.format() == "a/b.py:3:0: HPX006 [error] m"
+
+
+def test_all_rules_registry():
+    ids = sorted(r.id for r in all_rules())
+    assert ids == ["HPX001", "HPX002", "HPX003",
+                   "HPX004", "HPX005", "HPX006"]
+
+
+# ---------------------------------------------------------------------------
+# the lint gate: the real tree must be clean under the shipped baseline
+# ---------------------------------------------------------------------------
+
+def test_cli_gate_on_real_tree():
+    res = lint_paths([os.path.join(REPO, "hpx_tpu")], rules=all_rules())
+    # display paths are repo-relative, so the shipped baseline applies
+    assert all(f.path.startswith("hpx_tpu") for f in res.findings)
+    new, _ = apply_baseline(res.findings, load_baseline())
+    assert new == [], "\n".join(f.format() for f in new)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(HPX006_BAD)
+    assert cli_main([str(bad), "--no-baseline"]) == 1
+    bad.write_text(HPX006_GOOD)
+    assert cli_main([str(bad), "--no-baseline"]) == 0
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "HPX001" in out and "HPX006" in out
+
+
+def test_module_smoke():
+    # the documented invocation, end to end, from the repo root
+    proc = subprocess.run(
+        [sys.executable, "-m", "hpx_tpu.analysis", "hpx_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
