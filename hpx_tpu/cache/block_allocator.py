@@ -1,0 +1,154 @@
+"""Fixed-size KV-block allocator: the AGAS move applied to decode memory.
+
+Reference analog: `containers/partitioned_vector.py` stores data at
+rest as fixed-size segments behind an address map; this module is the
+same discipline for data in flight — decode-time K/V lives in ONE
+preallocated pool of `[num_blocks, block_size, n_kv, head_dim]` rows
+per layer, and requests hold *block ids*, never rows. The allocator is
+pure host-side bookkeeping (free list + ref counts) so it is testable
+without jax; the device pools it indexes live with their owner
+(`models/serving.ContinuousServer(paged=True)`).
+
+Ref counting is what makes prefix sharing safe: a block chain published
+into the radix tree (`cache/radix.py`) and matched by three live
+requests has refcount 4 (tree + 3 readers); it returns to the free
+list only when the last holder drops it. Copy-on-write (`fork`) covers
+the writer case: a holder that must mutate a block it shares gets a
+fresh exclusive block (and the caller copies the device rows).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core.errors import Error, HpxError
+from ..synchronization import Mutex
+
+__all__ = ["BlockAllocator", "CacheOOM"]
+
+
+class CacheOOM(HpxError):
+    """The pool has no free block. Recoverable: evict unreferenced
+    radix chains (`RadixCache.evict`) and retry — the serving loop's
+    OOM→evict→retry path."""
+
+    def __init__(self, message: str = "", function: str = ""):
+        super().__init__(Error.out_of_memory, message, function)
+
+
+class BlockAllocator:
+    """Free-list + ref-count accounting for `num_blocks` fixed-size
+    blocks of `block_size` token rows each.
+
+    Allocation order is deterministic (LIFO free list seeded
+    0..num_blocks-1 reversed, so fresh pools hand out 0, 1, 2, ...):
+    paged-vs-dense token equality tests rely on runs being repeatable,
+    and debugging a block-map is far easier when ids are stable.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._ref: Dict[int, int] = {}
+        self._lock = Mutex()
+        # cumulative counters (cache/counters.py reads these)
+        self.total_allocs = 0
+        self.total_frees = 0
+        self.total_cow_copies = 0
+
+    # -- queries ----------------------------------------------------------
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    def refcount(self, bid: int) -> int:
+        with self._lock:
+            return self._ref.get(bid, 0)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def alloc(self) -> int:
+        """One fresh block at refcount 1, or CacheOOM when the pool is
+        exhausted (callers evict-and-retry; see serving._alloc_block)."""
+        with self._lock:
+            if not self._free:
+                raise CacheOOM(
+                    f"KV pool exhausted: all {self.num_blocks} blocks "
+                    "in use", "BlockAllocator.alloc")
+            bid = self._free.pop()
+            self._ref[bid] = 1
+            self.total_allocs += 1
+            return bid
+
+    def incref(self, bid: int) -> int:
+        with self._lock:
+            n = self._ref.get(bid, 0)
+            if n < 1:
+                raise ValueError(f"incref on unallocated block {bid}")
+            self._ref[bid] = n + 1
+            return n + 1
+
+    def decref(self, bid: int) -> bool:
+        """Drop one reference; returns True when this freed the block
+        (refcount hit zero and it went back on the free list)."""
+        with self._lock:
+            n = self._ref.get(bid, 0)
+            if n < 1:
+                raise ValueError(f"decref on unallocated block {bid}")
+            if n > 1:
+                self._ref[bid] = n - 1
+                return False
+            del self._ref[bid]
+            self._free.append(bid)
+            self.total_frees += 1
+            return True
+
+    def fork(self, bid: int) -> tuple:
+        """Copy-on-write: make `bid` safely writable by THIS holder.
+
+        Exclusive already (refcount 1): returns ``(bid, False)`` — write
+        in place. Shared: drops this holder's ref, allocates a fresh
+        block, and returns ``(new_bid, True)`` — the caller must copy
+        the device rows old→new before writing (the allocator never
+        touches device memory). Raises CacheOOM like alloc()."""
+        with self._lock:
+            n = self._ref.get(bid, 0)
+            if n < 1:
+                raise ValueError(f"fork of unallocated block {bid}")
+            if n == 1:
+                return bid, False
+            if not self._free:
+                raise CacheOOM(
+                    f"KV pool exhausted: cannot copy-on-write shared "
+                    f"block {bid} ({self.num_blocks} blocks in use)",
+                    "BlockAllocator.fork")
+            self._ref[bid] = n - 1
+            new = self._free.pop()
+            self._ref[new] = 1
+            self.total_allocs += 1
+            self.total_cow_copies += 1
+            return new, True
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "free": len(self._free),
+                "in_use": self.num_blocks - len(self._free),
+                "total_allocs": self.total_allocs,
+                "total_frees": self.total_frees,
+                "total_cow_copies": self.total_cow_copies,
+            }

@@ -1,0 +1,114 @@
+"""Serving + cache performance counters, per ContinuousServer.
+
+Registers into `svc/performance_counters.py`'s registry, following its
+built-in discipline: counters OBSERVE through weakrefs and read 0 once
+the server is gone — observability must never keep a retired server
+(and its device pools) alive. A refresh hook (run before every
+discovery/query, via `register_refresh_hook`) garbage-collects the
+names of dead servers so `discover_counters` stays truthful.
+
+Every server gets the serving counters::
+
+    /serving{locality#L/server#i}/queue/depth       queued requests
+    /serving{locality#L/server#i}/slots/occupancy   live slots / slots
+    /serving{locality#L/server#i}/tokens/rate       decode tokens/sec
+                                                    (windowed RateCounter)
+
+Paged servers additionally export the cache counters::
+
+    /cache{locality#L/server#i}/hit-rate                radix prefix hit rate
+    /cache{locality#L/server#i}/blocks/in-use           pool blocks allocated
+    /cache{locality#L/server#i}/blocks/free             pool blocks free
+    /cache{locality#L/server#i}/blocks/radix-held       blocks retained by the tree
+    /cache{locality#L/server#i}/count/evictions         LRU chains dropped
+    /cache{locality#L/server#i}/prefill-tokens/saved    prompt tokens NOT recomputed
+    /cache{locality#L/server#i}/prefill-tokens/computed prompt tokens prefilled
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Tuple
+
+from ..svc import performance_counters as pc
+from ..synchronization import Mutex
+
+__all__ = ["register_server"]
+
+_lock = Mutex()
+_servers: Dict[int, Tuple["weakref.ref", List[str]]] = {}
+_next_idx = 0
+
+
+def _read(ref, fn):
+    """Weakref-observing callback: a collected server reads 0.0."""
+    def value() -> float:
+        srv = ref()
+        if srv is None:
+            return 0.0
+        return float(fn(srv))
+    return value
+
+
+def register_server(srv) -> str:
+    """Register one server's counters; returns its instance name
+    (``server#<i>``). Called from ContinuousServer.__init__."""
+    global _next_idx
+    with _lock:
+        idx = _next_idx
+        _next_idx += 1
+    inst = f"server#{idx}"
+    ref = weakref.ref(srv)
+    names: List[str] = []
+
+    def put(object_: str, counter: str, c: pc.Counter) -> None:
+        name = pc.counter_name(object_, counter, inst)
+        pc.register_counter(name, c)
+        names.append(name)
+
+    put("serving", "queue/depth",
+        pc.CallbackCounter(_read(ref, lambda s: len(s._queue))))
+    put("serving", "slots/occupancy",
+        pc.CallbackCounter(_read(ref, lambda s: sum(
+            r is not None for r in s._slot_req) / max(1, s.slots))))
+    # the server's own windowed tokens/sec counter, registered as-is
+    # (RateCounter IS a Counter); it holds no reference back
+    put("serving", "tokens/rate", srv._rate)
+
+    if getattr(srv, "paged", False):
+        put("cache", "hit-rate",
+            pc.CallbackCounter(_read(ref, lambda s: s._radix.hit_rate())))
+        put("cache", "blocks/in-use",
+            pc.CallbackCounter(_read(ref, lambda s: s._alloc.in_use)))
+        put("cache", "blocks/free",
+            pc.CallbackCounter(_read(ref, lambda s: s._alloc.free_count)))
+        put("cache", "blocks/radix-held",
+            pc.CallbackCounter(_read(ref, lambda s: s._radix.blocks_held)))
+        put("cache", "count/evictions",
+            pc.CallbackCounter(
+                _read(ref, lambda s: s._radix.total_evictions)))
+        put("cache", "prefill-tokens/saved",
+            pc.CallbackCounter(_read(ref, lambda s: s._prefill_saved)))
+        put("cache", "prefill-tokens/computed",
+            pc.CallbackCounter(_read(ref, lambda s: s._prefill_computed)))
+
+    with _lock:
+        _servers[idx] = (ref, names)
+    return inst
+
+
+def _refresh() -> None:
+    """Refresh hook: unregister the counters of collected servers (the
+    reverse of the builtins' lazily-appearing pools — servers lazily
+    DISAPPEAR)."""
+    with _lock:
+        dead = [(i, names) for i, (ref, names) in _servers.items()
+                if ref() is None]
+        for i, _ in dead:
+            del _servers[i]
+    for _, names in dead:
+        for n in names:
+            pc.unregister_counter(n)
+
+
+pc.register_refresh_hook(_refresh)

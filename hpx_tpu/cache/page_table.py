@@ -1,0 +1,71 @@
+"""Per-request logical→physical block maps, materializable for jit.
+
+A `PageTable` is the request-side view of the paged KV cache: an
+ordered list of physical block ids covering the request's logical token
+positions `[0, tokens)`. Logical block ``i`` holds token rows
+``[i*block_size, (i+1)*block_size)``; position ``p`` lives at physical
+row ``(table[p // block_size], p % block_size)``.
+
+`as_row` / `materialize` turn host tables into padded int32 arrays the
+jitted step/prefill programs index with — the analog of
+partitioned_vector's segment map, materialized per step instead of per
+container. Padding uses a caller-supplied block id (the server's
+reserved trash block) so dead slots and unmapped tail positions always
+resolve to a writable-but-never-read physical block: masked lanes can
+scatter harmlessly instead of corrupting live data.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PageTable", "materialize"]
+
+
+class PageTable:
+    """Block map for one request: `blocks[i]` backs logical block i."""
+
+    def __init__(self, block_size: int) -> None:
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = block_size
+        self.blocks: List[int] = []
+        self.tokens = 0            # logical length in token rows
+
+    def append_block(self, bid: int) -> None:
+        self.blocks.append(bid)
+
+    @property
+    def capacity(self) -> int:
+        return len(self.blocks) * self.block_size
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to cover `tokens` rows."""
+        return -(-tokens // self.block_size)
+
+    def block_of(self, pos: int) -> int:
+        """Physical block id backing logical position `pos`."""
+        return self.blocks[pos // self.block_size]
+
+    def as_row(self, max_blocks: int, pad: int) -> np.ndarray:
+        """Padded int32 row `[max_blocks]` for the jitted programs."""
+        if len(self.blocks) > max_blocks:
+            raise ValueError(
+                f"page table has {len(self.blocks)} blocks, row width "
+                f"is {max_blocks}")
+        row = np.full((max_blocks,), pad, np.int32)
+        row[:len(self.blocks)] = self.blocks
+        return row
+
+
+def materialize(tables: Sequence[Optional[PageTable]], max_blocks: int,
+                pad: int) -> np.ndarray:
+    """Stack per-slot tables into the `[slots, max_blocks]` int32 array
+    one decode step consumes; None slots (dead) pad entirely."""
+    out = np.full((len(tables), max_blocks), pad, np.int32)
+    for i, pt in enumerate(tables):
+        if pt is not None:
+            out[i, :len(pt.blocks)] = pt.blocks
+    return out

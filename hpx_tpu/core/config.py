@@ -53,6 +53,10 @@ DEFAULTS: Dict[str, str] = {
     "hpx.tpu.watcher_threads": "2",       # future-completion watcher pool
     "hpx.tpu.eager_futures": "1",         # device futures ready at dispatch
     "hpx.counters.enable": "1",
+    "hpx.cache.block_size": "16",         # KV tokens per paged block
+    "hpx.cache.num_blocks": "auto",       # pool size (auto: 2x worst case)
+    "hpx.cache.radix_budget_blocks": "auto",  # prefix-tree HBM budget
+    "hpx.cache.prefix_reuse": "1",        # radix prefix matching on admit
     "hpx.checkpoint.dir": "./checkpoints",
     "hpx.resiliency.replay_default_n": "3",
     "hpx.exec.default_chunk": "auto",

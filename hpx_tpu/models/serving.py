@@ -32,9 +32,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..cache.block_allocator import BlockAllocator, CacheOOM
+from ..cache.page_table import PageTable, materialize
+from ..cache.radix import RadixCache
+from ..ops.paged_attention import gather_block_kv, paged_decode_attention
 from .transformer import (
+    _PREFILL_CHUNK,
     TransformerConfig,
     _cached_program,
+    _decode_window,
     _dq,
     _ln,
     _prefill_window,
@@ -140,6 +146,52 @@ def _decode_rows(params, caches, tok, pos, cfg):
     return new_caches, logits[:, 0, :].astype(jnp.float32)
 
 
+def _paged_block_rows(x, lp, pools, table, pos, cfg: TransformerConfig):
+    """_block_decode_rows with the K/V rows living in a shared BLOCK
+    POOL instead of per-slot dense buffers. x: [B, 1, D]; pools:
+    (k_pool, v_pool) each [num_blocks, block_size, Nkv, H]; table:
+    [B, max_blocks] int32 logical->physical block map; pos: [B] int32.
+    Projections/rope/ffn are byte-identical to the dense path; only
+    the cache write (scatter through the table) and read (gather in
+    logical order — same row values at the same logical indices)
+    differ, which is what keeps paged == dense token-exact."""
+    kp, vp = pools
+    b = x.shape[0]
+    h = _ln(x, lp["ln1"])
+    q, k, v = _qkv_proj(h, lp)
+    if cfg.rope:
+        q = _rope_rows(q, pos, cfg)
+        k = _rope_rows(k, pos, cfg)
+    att, kp, vp = paged_decode_attention(q, k[:, 0], v[:, 0], kp, vp,
+                                         table, pos)
+    o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
+    x = x + o
+    h = _ln(x, lp["ln2"])
+    if "moe" in lp:
+        from .moe import moe_ffn
+        from .transformer import _moe_cfg
+        d = h.shape[-1]
+        mcfg = dataclasses.replace(_moe_cfg(cfg),
+                                   capacity_factor=float(cfg.n_experts))
+        out, _aux = moe_ffn(h.reshape(b, d), lp["moe"], mcfg)
+        return x + out.reshape(b, 1, d), (kp, vp)
+    h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
+    return x + h, (kp, vp)
+
+
+def _paged_decode_rows(params, pools, tok, table, pos, cfg):
+    """One token per slot through every block over paged pools;
+    returns (pools, f32 logits [B, V]) — the _decode_rows analog."""
+    x = params["emb"][tok][:, None, :]
+    new_pools = []
+    for lp, pl in zip(params["layers"], pools):
+        x, pl = _paged_block_rows(x, lp, pl, table, pos, cfg)
+        new_pools.append(pl)
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+    return new_pools, logits[:, 0, :].astype(jnp.float32)
+
+
 @dataclasses.dataclass
 class _Request:
     rid: int
@@ -175,13 +227,23 @@ class ContinuousServer:
     prompts in production)."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int = 4,
-                 smax: int = 512, mesh=None):
+                 smax: int = 512, mesh=None, paged: bool = False,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 radix_budget_blocks: Optional[int] = None,
+                 prefix_reuse: Optional[bool] = None):
         self.cfg = cfg
         self.slots = slots
         self.smax = smax
         self.mesh = mesh
+        self.paged = bool(paged)
         nkv, hd = cfg.kv_heads, cfg.head_dim
         cache_sh = None
+        if self.paged and mesh is not None:
+            raise ValueError(
+                "paged=True serving is single-device for now: shard "
+                "the dense path (mesh=...) or run one paged server "
+                "per replica")
         if mesh is not None:
             # GSPMD sharded serving: slots over dp, heads over tp. The
             # step/prefill/splice programs are UNCHANGED — placement
@@ -204,16 +266,25 @@ class ContinuousServer:
         self.params = params
         self._cache_sh = cache_sh
 
-        def zeros():
-            # allocate DIRECTLY in the sharded layout: a full buffer on
-            # device 0 followed by a redistribute would peak at the
-            # unsharded size there — the exact OOM sharding avoids
-            if cache_sh is not None:
-                return jnp.zeros((slots, smax, nkv, hd), cfg.dtype,
-                                 device=cache_sh)
-            return jnp.zeros((slots, smax, nkv, hd), cfg.dtype)
-        self._caches = [(zeros(), zeros())
-                        for _ in range(cfg.n_layers)]
+        if self.paged:
+            self._init_paged(block_size, num_blocks,
+                             radix_budget_blocks, prefix_reuse)
+            self._caches = None     # dense buffers never allocated
+        else:
+            def zeros():
+                # allocate DIRECTLY in the sharded layout: a full
+                # buffer on device 0 followed by a redistribute would
+                # peak at the unsharded size there — the exact OOM
+                # sharding avoids
+                if cache_sh is not None:
+                    return jnp.zeros((slots, smax, nkv, hd), cfg.dtype,
+                                     device=cache_sh)
+                return jnp.zeros((slots, smax, nkv, hd), cfg.dtype)
+            self._caches = [(zeros(), zeros())
+                            for _ in range(cfg.n_layers)]
+        # windowed decode throughput, read by the serving counters
+        from ..svc.performance_counters import RateCounter
+        self._rate = RateCounter(window_s=5.0)
         # host-side slot state
         self._slot_req: List[Optional[_Request]] = [None] * slots
         self._pos = [0] * slots         # next write position per slot
@@ -223,6 +294,63 @@ class ContinuousServer:
         self._queue: deque = deque()
         self._done: Dict[int, List[int]] = {}
         self._next_rid = 0
+        from ..cache.counters import register_server
+        self.counter_instance = register_server(self)
+
+    def _init_paged(self, block_size, num_blocks, radix_budget_blocks,
+                    prefix_reuse) -> None:
+        """Resolve the hpx.cache.* knobs and build the paged state:
+        one preallocated block pool per layer, the free-list/ref-count
+        allocator over it, and the radix prefix tree."""
+        from ..core.config import runtime_config
+        cfg, slots, smax = self.cfg, self.slots, self.smax
+        rc = runtime_config()
+        if block_size is None:
+            block_size = rc.get_int("hpx.cache.block_size", 16)
+        bs = int(block_size)
+        if bs < 1:
+            raise ValueError(f"block_size must be >= 1, got {bs}")
+        if smax % bs:
+            raise ValueError(
+                f"paged serving needs smax divisible by the block "
+                f"size {bs}; got smax {smax} (use smax="
+                f"{-(-smax // bs) * bs})")
+        self.block_size = bs
+        self._maxb = smax // bs     # table width: blocks per sequence
+        if num_blocks is None:
+            v = rc.get("hpx.cache.num_blocks", "auto")
+            num_blocks = None if v in (None, "", "auto") else int(v)
+        if num_blocks is None:
+            # worst-case live demand (every slot at smax) + the trash
+            # block + equal headroom for radix retention, so prefix
+            # chains persist before OOM-eviction starts recycling them
+            num_blocks = 2 * slots * self._maxb + 1
+        if num_blocks < self._maxb + 1:
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold one max-length "
+                f"request ({self._maxb} blocks) plus the reserved "
+                "trash block")
+        if radix_budget_blocks is None:
+            v = rc.get("hpx.cache.radix_budget_blocks", "auto")
+            radix_budget_blocks = (None if v in (None, "", "auto")
+                                   else int(v))
+        if prefix_reuse is None:
+            prefix_reuse = rc.get_bool("hpx.cache.prefix_reuse", True)
+        self._prefix_reuse = bool(prefix_reuse)
+        self._alloc = BlockAllocator(num_blocks, bs)
+        # the trash block: dead slots' tables and table padding point
+        # here, so masked decode lanes scatter into rows nothing reads
+        self._trash = self._alloc.alloc()
+        self._radix = RadixCache(self._alloc, radix_budget_blocks)
+        nkv, hd = cfg.kv_heads, cfg.head_dim
+
+        def pzeros():
+            return jnp.zeros((num_blocks, bs, nkv, hd), cfg.dtype)
+        self._pools = [(pzeros(), pzeros())
+                       for _ in range(cfg.n_layers)]
+        self._tables: List[Optional[PageTable]] = [None] * slots
+        self._prefill_saved = 0
+        self._prefill_computed = 0
 
     # -- jitted pieces (memoized on the baked constants) ----------------
 
@@ -298,6 +426,202 @@ class ContinuousServer:
             return jax.jit(splice, donate_argnums=(0,))
         return _cached_program(ck, build)
 
+    # -- paged programs (models live in pools; tables map positions) -----
+
+    def _paged_step_prog(self):
+        cfg, slots, smax = self.cfg, self.slots, self.smax
+        nb, bs = self._alloc.num_blocks, self.block_size
+        ck = ("pg_step", cfg, slots, smax, nb, bs,
+              _tree_key(self.params))
+
+        def build():
+            def step(params, pools, tok, pos, tables, temp, keys):
+                pools, logits = _paged_decode_rows(params, pools, tok,
+                                                   tables, pos, cfg)
+
+                def pick(row, key, t, p):
+                    greedy = jnp.argmax(row)
+                    sampled = _sample_row(row, jnp.maximum(t, 1e-6),
+                                          key, p, 0)
+                    return jnp.where(t > 0, sampled, greedy)
+
+                nxt = jax.vmap(pick)(logits, keys, temp, pos)
+                return pools, nxt
+            return jax.jit(step, donate_argnums=(1,))
+        return _cached_program(ck, build)
+
+    def _paged_prefill_prog(self, slen: int, plen: int):
+        """Suffix prefill: gather the slot's (possibly prefix-matched)
+        blocks into a contiguous b=1 scratch cache, then run ONLY the
+        last `slen` prompt tokens through windowed forwards at their
+        absolute positions — the prefix-reuse saving. slen == plen is
+        the no-match case (and bitwise the dense prefill: the garbage
+        scratch rows beyond the write frontier are causally masked to
+        exact-zero weight, like the dense path's zeros)."""
+        cfg, smax = self.cfg, self.smax
+        nb, bs = self._alloc.num_blocks, self.block_size
+        ck = ("pg_prefill", cfg, slen, plen, smax, nb, bs,
+              _tree_key(self.params))
+
+        def build():
+            matched = plen - slen
+
+            def prefill(params, pools, trow, suffix):
+                caches = [(gather_block_kv(kp, trow[None]),
+                           gather_block_kv(vp, trow[None]))
+                          for kp, vp in pools]
+                # windows on the ABSOLUTE chunk grid, so long-prompt
+                # suffix chunking lines up with a from-zero prefill
+                last = None
+                s = matched
+                while s < plen:
+                    e = min(plen,
+                            (s // _PREFILL_CHUNK + 1) * _PREFILL_CHUNK)
+                    caches, lg = _decode_window(
+                        params, caches,
+                        suffix[:, s - matched:e - matched], s, cfg,
+                        need_logits=e == plen)
+                    if lg is not None:
+                        last = lg
+                    s = e
+                return caches, last[:, -1]
+            return jax.jit(prefill)
+        return _cached_program(ck, build)
+
+    def _paged_splice_prog(self, slen: int, plen: int):
+        """Write the freshly prefilled suffix rows from the b=1
+        scratch cache into the request's newly allocated pool blocks
+        (whole-block scatter; the shared prefix blocks are untouched)."""
+        cfg, smax = self.cfg, self.smax
+        nb, bs = self._alloc.num_blocks, self.block_size
+        ck = ("pg_splice", cfg, slen, plen, smax, nb, bs,
+              _tree_key(self.params))
+
+        def build():
+            from ..ops.paged_attention import scatter_blocks
+            matched = plen - slen
+            nsuf = -(-slen // bs)      # suffix blocks (matched % bs == 0)
+            lo, hi = matched, matched + nsuf * bs
+
+            def splice(pools, one, bids):
+                out = []
+                for (kp, vp), (kc, vc) in zip(pools, one):
+                    kseg = kc[0, lo:hi].reshape(nsuf, bs, *kc.shape[2:])
+                    vseg = vc[0, lo:hi].reshape(nsuf, bs, *vc.shape[2:])
+                    out.append((scatter_blocks(kp, bids, kseg),
+                                scatter_blocks(vp, bids, vseg)))
+                return out
+            return jax.jit(splice, donate_argnums=(0,))
+        return _cached_program(ck, build)
+
+    def _copy_block_prog(self):
+        """Device side of allocator copy-on-write: duplicate one
+        block's rows src->dst across every layer's pools."""
+        nb, bs = self._alloc.num_blocks, self.block_size
+        ck = ("pg_copy", self.cfg, self.smax, nb, bs,
+              _tree_key(self.params))
+
+        def build():
+            def copy(pools, src, dst):
+                return [(kp.at[dst].set(kp[src]),
+                         vp.at[dst].set(vp[src]))
+                        for kp, vp in pools]
+            return jax.jit(copy, donate_argnums=(0,))
+        return _cached_program(ck, build)
+
+    # -- paged host-side bookkeeping -------------------------------------
+
+    def _alloc_block(self) -> int:
+        """allocator.alloc with the OOM→evict→retry discipline: a full
+        pool first evicts the least-recently-used idle radix chain
+        (retained prefixes are a cache, not a reservation)."""
+        try:
+            return self._alloc.alloc()
+        except CacheOOM:
+            if not self._radix.evict(1):
+                raise
+            return self._alloc.alloc()
+
+    def _ensure_block(self, slot: int, pos: int) -> None:
+        """Before a decode write at `pos`: extend the slot's table to
+        cover it, and make the target block exclusively ours (COW
+        guard — unreachable under the publish-at-retire policy, since
+        writes always land past the shared prefix, but correctness
+        must not depend on the policy staying that way)."""
+        pt = self._tables[slot]
+        assert pt is not None
+        while pt.capacity <= pos:
+            pt.append_block(self._alloc_block())
+        bid = pt.block_of(pos)
+        if self._alloc.refcount(bid) > 1:
+            new, copied = self._alloc.fork(bid)
+            if copied:
+                self._pools = self._copy_block_prog()(
+                    self._pools, jnp.int32(bid), jnp.int32(new))
+                pt.blocks[pos // self.block_size] = new
+
+    def _admit_paged(self, req: "_Request"):
+        """Paged admission: longest-cached-prefix lookup, fresh blocks
+        for the suffix, suffix-only prefill, splice into the pool.
+        Returns the last prompt position's logits [1, V]."""
+        plen = len(req.prompt)
+        matched, mbids = (0, [])
+        if self._prefix_reuse:
+            # always leave >= 1 suffix token: admission needs the LAST
+            # prompt token's logits to seed generation
+            matched, mbids = self._radix.match(req.prompt[:-1])
+        pt = PageTable(self.block_size)
+        pt.blocks.extend(mbids)
+        try:
+            while pt.capacity < plen:
+                pt.append_block(self._alloc_block())
+        except CacheOOM:
+            for bid in pt.blocks:
+                self._alloc.decref(bid)
+            raise
+        pt.tokens = plen
+        slen = plen - matched
+        trow = jnp.asarray(pt.as_row(self._maxb, self._trash))
+        suffix = jnp.asarray([req.prompt[matched:]], jnp.int32)
+        one, last_logits = self._paged_prefill_prog(slen, plen)(
+            self.params, self._pools, trow, suffix)
+        sbids = jnp.asarray(pt.blocks[matched // self.block_size:],
+                            jnp.int32)
+        self._pools = self._paged_splice_prog(slen, plen)(
+            self._pools, one, sbids)
+        self._prefill_saved += matched
+        self._prefill_computed += slen
+        return pt, last_logits
+
+    def _release_slot(self, slot: int, req: "_Request") -> None:
+        """Paged retire: publish the request's FULL prompt blocks into
+        the radix tree (prefix reuse for future admits), then drop the
+        request's references — shared blocks survive under the tree's
+        ref, private ones return to the free list."""
+        pt = self._tables[slot]
+        if pt is None:
+            return
+        if self._prefix_reuse:
+            nfull = len(req.prompt) // self.block_size
+            if nfull:
+                self._radix.insert(
+                    req.prompt[:nfull * self.block_size],
+                    pt.blocks[:nfull])
+        for bid in pt.blocks:
+            self._alloc.decref(bid)
+        self._tables[slot] = None
+
+    def cache_stats(self) -> Dict[str, float]:
+        """Paged-mode observability snapshot (the same numbers the
+        /cache{...} performance counters export)."""
+        if not self.paged:
+            raise ValueError("cache_stats() requires paged=True")
+        st: Dict[str, float] = dict(self._alloc.stats())
+        st.update(self._radix.stats())
+        st["prefill_tokens_saved"] = self._prefill_saved
+        st["prefill_tokens_computed"] = self._prefill_computed
+        return st
+
     # -- public API ------------------------------------------------------
 
     def submit(self, prompt, max_new: int, eos_id: Optional[int] = None,
@@ -330,32 +654,43 @@ class ContinuousServer:
 
     def _admit(self) -> None:
         """Fill free slots from the queue: prefill the prompt on a b=1
-        cache (one window forward), splice its K/V rows into the slot,
-        seed the slot's first generated token."""
+        cache (one window forward; paged mode prefills only past the
+        longest cached prefix), splice its K/V rows into the slot (or
+        pool blocks), seed the slot's first generated token.
+
+        A request that retires DURING admission (max_new == 1, or an
+        instant eos) frees its slot immediately — the inner loop
+        re-scans the same slot within this pass, so a burst of
+        one-token requests drains through one slot without burning a
+        full decode step per request on an empty batch."""
         for slot in range(self.slots):
-            if self._slot_req[slot] is not None or not self._queue:
-                continue
-            req = self._queue.popleft()
-            plen = len(req.prompt)
-            prompt = jnp.asarray([req.prompt], jnp.int32)
-            one, last_logits = self._prefill_prog(plen)(self.params,
-                                                        prompt)
-            self._caches = self._splice_prog(plen)(
-                self._caches, one, jnp.int32(slot))
-            if req.temperature > 0.0:
-                # generate()'s tok0 draw: position plen-1, row 0
-                tok0 = int(_sample_row(last_logits[0], req.temperature,
-                                       req.key, plen - 1, 0))
-            else:
-                tok0 = int(jnp.argmax(last_logits[0]))
-            req.tokens.append(tok0)
-            self._slot_req[slot] = req
-            self._pos[slot] = plen
-            self._cur[slot] = tok0
-            self._temp[slot] = req.temperature
-            self._key[slot] = (req.key if req.key is not None
-                               else jax.random.PRNGKey(0))
-            self._maybe_retire(slot)
+            while self._slot_req[slot] is None and self._queue:
+                req = self._queue.popleft()
+                plen = len(req.prompt)
+                if self.paged:
+                    pt, last_logits = self._admit_paged(req)
+                    self._tables[slot] = pt
+                else:
+                    prompt = jnp.asarray([req.prompt], jnp.int32)
+                    one, last_logits = self._prefill_prog(plen)(
+                        self.params, prompt)
+                    self._caches = self._splice_prog(plen)(
+                        self._caches, one, jnp.int32(slot))
+                if req.temperature > 0.0:
+                    # generate()'s tok0 draw: position plen-1, row 0
+                    tok0 = int(_sample_row(last_logits[0],
+                                           req.temperature,
+                                           req.key, plen - 1, 0))
+                else:
+                    tok0 = int(jnp.argmax(last_logits[0]))
+                req.tokens.append(tok0)
+                self._slot_req[slot] = req
+                self._pos[slot] = plen
+                self._cur[slot] = tok0
+                self._temp[slot] = req.temperature
+                self._key[slot] = (req.key if req.key is not None
+                                   else jax.random.PRNGKey(0))
+                self._maybe_retire(slot)
 
     def _maybe_retire(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -371,6 +706,8 @@ class ContinuousServer:
                     req.max_new - len(req.tokens))
             self._done[req.rid] = req.tokens
             self._slot_req[slot] = None
+            if self.paged:
+                self._release_slot(slot, req)
 
     def step(self) -> bool:
         """Admit + one decode step for every live slot. Returns True
@@ -381,14 +718,25 @@ class ContinuousServer:
         if not live:
             return bool(self._queue)
         tok = jnp.asarray(self._cur, jnp.int32)
-        # dead slots re-write their own last position (harmless: they
-        # are never read — admission overwrites rows 0..plen first)
+        # dense: dead slots re-write their own last position (harmless:
+        # never read — admission overwrites rows 0..plen first). Paged:
+        # dead slots' tables are all-trash, so their writes land in the
+        # reserved trash block instead of a recycled live block.
         pos = jnp.asarray(self._pos, jnp.int32)
         temp = jnp.asarray(self._temp, jnp.float32)
         keys = jnp.stack(self._key)
-        self._caches, nxt = self._step_prog()(
-            self.params, self._caches, tok, pos, temp, keys)
+        if self.paged:
+            for s in live:
+                self._ensure_block(s, self._pos[s])
+            tables = jnp.asarray(materialize(self._tables, self._maxb,
+                                             self._trash))
+            self._pools, nxt = self._paged_step_prog()(
+                self.params, self._pools, tok, pos, tables, temp, keys)
+        else:
+            self._caches, nxt = self._step_prog()(
+                self.params, self._caches, tok, pos, temp, keys)
         nxt_host = np.asarray(nxt).tolist()    # ONE device->host read
+        self._rate.mark(float(len(live)))
         for s in live:
             req = self._slot_req[s]
             assert req is not None

@@ -8,3 +8,9 @@ from .attention import (  # noqa: F401
     ulysses_attention,
 )
 from .attention_pallas import flash_attention  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    gather_block_kv,
+    paged_decode_attention,
+    scatter_blocks,
+    scatter_token,
+)

@@ -23,7 +23,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..utils.jaxcompat import shard_map
 from jax.sharding import PartitionSpec as P
 
 __all__ = [

@@ -1,0 +1,102 @@
+"""Gather-based paged decode attention over block tables.
+
+The device side of the `hpx_tpu/cache` subsystem: K/V for every
+request lives in one preallocated per-layer pool of fixed-size blocks
+(`[num_blocks, block_size, n_kv, head_dim]`), and a per-step int32
+block table (`cache/page_table.py`) maps each slot's logical positions
+to physical blocks. This module is pure jit-safe array plumbing — no
+host state, no syncs — so the serving layer can compose it with its
+projections while the numerics stay in one place.
+
+Numerical contract: `paged_decode_attention` is element-for-element the
+attention core of `models/serving._block_decode_rows` — same einsum
+contractions, same contraction lengths (`max_blocks * block_size` rows
+gathered in logical order == the dense `smax` rows), same -inf mask and
+f32 softmax. Rows past a slot's position are masked to exact-zero
+probability, so the garbage content of pad/trash blocks contributes
+exactly 0.0 — paged and dense servers emit byte-identical tokens.
+
+The gather materializes a `[B, S, n_kv, head_dim]` view per layer —
+the XLA-oracle formulation. A fused Pallas kernel that walks the block
+table in VMEM (the vLLM PagedAttention shape) is the follow-on once
+the flash path grows a block-table BlockSpec; this module is the
+equivalence oracle such a kernel will be tested against.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "gather_block_kv",
+    "paged_decode_attention",
+    "scatter_blocks",
+    "scatter_token",
+]
+
+
+def gather_block_kv(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Materialize logical K or V rows from a block pool.
+
+    pool: [num_blocks, block_size, n_kv, head_dim]; table: [B,
+    max_blocks] int32. Returns [B, max_blocks * block_size, n_kv,
+    head_dim] — slot b's logical row p at index p (pad blocks yield
+    garbage rows the causal mask must exclude)."""
+    g = pool[table]                       # [B, maxb, bs, nkv, hd]
+    b, m, s, n, h = g.shape
+    return g.reshape(b, m * s, n, h)
+
+
+def scatter_token(pool: jax.Array, table: jax.Array, pos: jax.Array,
+                  val: jax.Array) -> jax.Array:
+    """Write one token row per slot into the pool.
+
+    pool: [num_blocks, block_size, n_kv, head_dim]; table: [B,
+    max_blocks]; pos: [B] int32 logical positions; val: [B, n_kv,
+    head_dim]. Slot b's row lands at (table[b, pos[b]//bs],
+    pos[b]%bs) — dead slots point their whole table at a reserved
+    trash block, so their masked lanes scatter harmlessly."""
+    bs = pool.shape[1]
+    rows = jnp.arange(table.shape[0])
+    bidx = table[rows, pos // bs]
+    return pool.at[bidx, pos % bs].set(val)
+
+
+def scatter_blocks(pool: jax.Array, bids: jax.Array,
+                   rows: jax.Array) -> jax.Array:
+    """Bulk-write whole blocks (prefill splice): bids [n] int32, rows
+    [n, block_size, n_kv, head_dim]."""
+    return pool.at[bids].set(rows.astype(pool.dtype))
+
+
+def paged_decode_attention(q: jax.Array, k_new: jax.Array,
+                           v_new: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, table: jax.Array,
+                           pos: jax.Array):
+    """One decode step of attention over paged K/V.
+
+    q: [B, 1, n_q, head_dim] (post-rope); k_new/v_new: [B, n_kv,
+    head_dim] this step's K/V rows (post-rope — pools store post-rope
+    K exactly like the dense caches); table: [B, max_blocks] int32;
+    pos: [B] int32 write/attend positions. Returns (att [B, 1, n_q,
+    head_dim], k_pool, v_pool) with the new rows written — write
+    precedes the gather so each slot attends its own fresh token
+    (the mask is `<= pos`, inclusive)."""
+    k_pool = scatter_token(k_pool, table, pos, k_new)
+    v_pool = scatter_token(v_pool, table, pos, v_new)
+    kc = gather_block_kv(k_pool, table)
+    vc = gather_block_kv(v_pool, table)
+    b, _, nq, hd = q.shape
+    nkv = kc.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, 1, nkv, g, hd)
+    s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
+    kpos = jnp.arange(kc.shape[1])
+    live = kpos[None, :] <= pos[:, None]                # [B, S]
+    s = jnp.where(live[:, None, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, 1, nq, hd)
+    return att, k_pool, v_pool

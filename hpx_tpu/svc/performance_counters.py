@@ -18,6 +18,7 @@ import dataclasses
 import fnmatch
 import re
 import threading
+from collections import deque
 
 from ..synchronization import Mutex
 import time
@@ -141,6 +142,43 @@ class ElapsedTimeCounter(Counter):
         if reset:
             self._t0 = now
         return CounterValue(v, time.time())
+
+
+class RateCounter(Counter):
+    """Windowed events/sec: `mark(n)` records n events now; the value
+    is the event total landed inside the trailing `window_s` seconds
+    divided by the window. Serving uses it for tokens/sec — a
+    cumulative GaugeCounter can't answer "how fast NOW", and an
+    AverageCounter's mean-of-samples isn't a rate at all."""
+
+    def __init__(self, window_s: float = 10.0) -> None:
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self._window = float(window_s)
+        self._events: "deque" = deque()     # (monotonic time, n)
+        self._lock = Mutex()
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self._window
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    def mark(self, n: float = 1.0) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, float(n)))
+            self._prune(now)
+
+    def get_value(self, reset: bool = False) -> CounterValue:
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            total = sum(n for _, n in self._events)
+            count = len(self._events)
+            if reset:
+                self._events.clear()
+        return CounterValue(total / self._window, time.time(),
+                            max(count, 1))
 
 
 class AverageCounter(Counter):

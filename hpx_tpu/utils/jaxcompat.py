@@ -1,0 +1,33 @@
+"""Version-bridging imports for the jax API surface the runtime uses.
+
+The library targets current jax (`jax.shard_map` is public API since
+0.6), but CI sandboxes and TPU pods pin older wheels where the same
+function lives at `jax.experimental.shard_map.shard_map`. Importing
+through this module keeps every subsystem collectable on both — an
+ImportError at module scope would otherwise take out the whole
+models/ops import chain (and with it every test in those files) on an
+older pin. Semantics-level differences (e.g. `jax.lax.pvary` not
+existing before varying-manual-axes tracking) stay guarded at the call
+sites with hasattr, as `ops.attention._pvary` does.
+"""
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:            # older pins keep it in experimental
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, **kwargs):
+        # The experimental version's check_rep pass infers replication
+        # statically and REJECTS programs whose replicated out_specs it
+        # cannot prove (e.g. psum-closed grads inside a scanned train
+        # step). Modern jax tracks varying axes through the program
+        # instead and accepts them, and every caller here was written
+        # against that behavior — so default the legacy check off
+        # rather than fail closed on valid programs.
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(f, **kwargs)
+
+__all__ = ["shard_map"]

@@ -165,3 +165,34 @@ def test_sharded_server_validates(params):
     with pytest.raises(NotImplementedError, match="dense"):
         ContinuousServer(moe_params, moe_cfg, slots=4, smax=32,
                          mesh=mesh)
+
+
+def test_one_token_burst_drains_in_admission(params):
+    """Requests that retire instantly (max_new == 1) free their slot
+    mid-admission; the same-pass re-scan pushes the next queued
+    request through WITHOUT spending a decode step per request —
+    the whole burst drains before the first (and only) step() call
+    dispatches nothing."""
+    srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    reqs = {srv.submit([3 + i, 1, 4], max_new=1): [3 + i, 1, 4]
+            for i in range(5)}
+    steps = 0
+    while srv.step():
+        steps += 1
+    assert steps == 0
+    out, srv._done = srv._done, {}
+    for rid, p in reqs.items():
+        assert out[rid] == _ref(params, CFG, p, 1)
+
+
+def test_instant_eos_frees_slot_same_pass(params):
+    """A request whose FIRST token is its eos retires during admission
+    too; the re-scan lets a trailing request take the slot in the same
+    pass and everything still matches generate()."""
+    tok0 = _ref(params, CFG, [3, 1, 4], 1)[0]
+    srv = ContinuousServer(params, CFG, slots=1, smax=64)
+    a = srv.submit([3, 1, 4], max_new=5, eos_id=tok0)   # instant eos
+    b = srv.submit([2, 7], max_new=4)
+    out = srv.run()
+    assert out[a] == _ref(params, CFG, [3, 1, 4], 5, eos_id=tok0)
+    assert out[b] == _ref(params, CFG, [2, 7], 4)

@@ -97,7 +97,7 @@ def test_future_overhead_benchmark():
 
 @pytest.mark.slow
 def test_serving_benchmark_smoke():
-    """benchmarks/serving_bench.py --cpu: all four engines report a
+    """benchmarks/serving_bench.py --cpu: all five engines report a
     tokens/s line and speculation reports its rounds."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -111,7 +111,29 @@ def test_serving_benchmark_smoke():
             if ln.startswith("{")]
     engines = {row["engine"] for row in rows}
     assert engines == {"generate", "continuous_batching", "speculative",
-                       "generate_single_stream"}, engines
+                       "generate_single_stream",
+                       "paged_prefix_reuse"}, engines
     assert all(row["tokens_per_s"] > 0 for row in rows)
     spec = next(row for row in rows if row["engine"] == "speculative")
     assert spec["rounds"] >= 1
+
+
+def test_paged_prefix_bench_smoke():
+    """The prefix-heavy paged workload (--prefix-only keeps it in
+    tier 1): the radix cache must actually hit — a nonzero hit rate and
+    at least 30% of prefill tokens eliminated for the 12-requests-one-
+    system-prompt mix."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join("benchmarks", "serving_bench.py"),
+         "--cpu", "--scale", "1", "--prefix-only"],
+        cwd=REPO, capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    import json
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    row = next(r_ for r_ in rows if r_["engine"] == "paged_prefix_reuse")
+    assert row["cache_hit_rate"] > 0, row
+    assert row["prefill_saved_frac"] >= 0.3, row
+    assert row["tokens_per_s"] > 0, row

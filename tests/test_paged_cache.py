@@ -1,0 +1,229 @@
+"""Host-side paged KV-cache bookkeeping (hpx_tpu/cache): block
+allocator ref counts and copy-on-write, page tables, and the radix
+prefix tree's match/insert/evict contract. Pure Python — no jax
+arrays; the device side is exercised by test_paged_serving.py."""
+
+import numpy as np
+import pytest
+
+from hpx_tpu.cache import (BlockAllocator, CacheOOM, PageTable,
+                           RadixCache, materialize)
+
+
+# -- BlockAllocator ----------------------------------------------------------
+
+def test_alloc_is_deterministic_and_exhausts():
+    a = BlockAllocator(num_blocks=3, block_size=4)
+    assert [a.alloc() for _ in range(3)] == [0, 1, 2]
+    assert a.free_count == 0 and a.in_use == 3
+    with pytest.raises(CacheOOM):
+        a.alloc()
+
+
+def test_decref_returns_block_to_pool():
+    a = BlockAllocator(4, 8)
+    b = a.alloc()
+    assert a.refcount(b) == 1
+    assert a.decref(b) is True           # freed
+    assert a.free_count == 4
+    # freed block is reusable (LIFO: comes straight back)
+    assert a.alloc() == b
+
+
+def test_shared_block_survives_one_holder():
+    a = BlockAllocator(4, 8)
+    b = a.alloc()
+    a.incref(b)                          # second holder
+    assert a.refcount(b) == 2
+    assert a.decref(b) is False          # still held
+    assert a.refcount(b) == 1
+    assert a.decref(b) is True
+
+
+def test_ref_misuse_raises():
+    a = BlockAllocator(2, 4)
+    with pytest.raises(ValueError):
+        a.incref(0)                      # never allocated
+    with pytest.raises(ValueError):
+        a.decref(1)
+    with pytest.raises(ValueError):
+        a.fork(0)
+
+
+def test_cow_fork_exclusive_is_in_place():
+    a = BlockAllocator(4, 8)
+    b = a.alloc()
+    nb, copied = a.fork(b)
+    assert (nb, copied) == (b, False)    # refcount 1: write in place
+    assert a.total_cow_copies == 0
+
+
+def test_cow_fork_shared_allocates_fresh():
+    a = BlockAllocator(4, 8)
+    b = a.alloc()
+    a.incref(b)                          # shared with the radix tree
+    nb, copied = a.fork(b)
+    assert copied and nb != b
+    assert a.refcount(b) == 1            # other holder keeps the old
+    assert a.refcount(nb) == 1           # forker owns the new
+    assert a.total_cow_copies == 1
+
+
+def test_cow_fork_oom_when_pool_full():
+    a = BlockAllocator(2, 4)
+    b0, b1 = a.alloc(), a.alloc()
+    a.incref(b0)
+    with pytest.raises(CacheOOM):
+        a.fork(b0)
+    assert a.refcount(b0) == 2           # failed fork changed nothing
+    del b1
+
+
+# -- PageTable ---------------------------------------------------------------
+
+def test_page_table_capacity_and_mapping():
+    pt = PageTable(block_size=4)
+    assert pt.capacity == 0
+    pt.append_block(7)
+    pt.append_block(2)
+    assert pt.capacity == 8
+    assert pt.block_of(0) == 7 and pt.block_of(3) == 7
+    assert pt.block_of(4) == 2
+    assert pt.blocks_for(5) == 2
+    assert pt.blocks_for(8) == 2
+    assert pt.blocks_for(9) == 3
+
+
+def test_page_table_as_row_pads():
+    pt = PageTable(4)
+    pt.append_block(3)
+    row = pt.as_row(max_blocks=4, pad=9)
+    assert row.dtype == np.int32
+    assert row.tolist() == [3, 9, 9, 9]
+
+
+def test_materialize_handles_dead_slots():
+    pt = PageTable(4)
+    pt.append_block(5)
+    tab = materialize([pt, None], max_blocks=3, pad=0)
+    assert tab.shape == (2, 3) and tab.dtype == np.int32
+    assert tab.tolist() == [[5, 0, 0], [0, 0, 0]]
+
+
+# -- RadixCache --------------------------------------------------------------
+
+def _chain(alloc, n):
+    return [alloc.alloc() for _ in range(n)]
+
+
+def test_radix_match_is_block_granular():
+    a = BlockAllocator(8, 4)
+    r = RadixCache(a)
+    toks = list(range(10))               # 2 full blocks + ragged tail
+    bids = _chain(a, 2)
+    assert r.insert(toks, bids) == 2     # tail ignored
+    assert r.blocks_held == 2
+    assert a.refcount(bids[0]) == 2      # caller + tree
+
+    m, got = r.match(toks)
+    assert m == 8 and got == bids
+    assert a.refcount(bids[0]) == 3      # match took a read lease
+
+    m2, got2 = r.match(toks[:6])         # only 1 full block of it
+    assert m2 == 4 and got2 == [bids[0]]
+    m3, got3 = r.match([99, 98, 97, 96])
+    assert (m3, got3) == (0, [])
+
+
+def test_radix_insert_dedups_by_content():
+    a = BlockAllocator(8, 4)
+    r = RadixCache(a)
+    toks = list(range(8))
+    first = _chain(a, 2)
+    r.insert(toks, first)
+    dup = _chain(a, 2)                   # same tokens, different blocks
+    assert r.insert(toks, dup) == 0      # nothing newly retained
+    assert r.blocks_held == 2
+    # the duplicate chain stays wholly the caller's to free
+    assert a.refcount(dup[0]) == 1 and a.refcount(first[0]) == 2
+
+
+def test_radix_divergent_suffixes_share_prefix_node():
+    a = BlockAllocator(8, 4)
+    r = RadixCache(a)
+    pre = [1, 2, 3, 4]
+    ca = _chain(a, 2)
+    cb = [ca[0]] + _chain(a, 1)          # same prefix block, new tail
+    r.insert(pre + [5, 6, 7, 8], ca)
+    a.incref(ca[0])                      # second publisher's lease
+    r.insert(pre + [9, 9, 9, 9], cb)
+    assert r.blocks_held == 3            # 1 shared prefix + 2 tails
+    m, got = r.match(pre + [9, 9, 9, 9])
+    assert m == 8 and got == cb
+
+
+def _publish(r, a, toks, n):
+    """Insert then drop the publisher's own refs, as retire does —
+    leaves the tree holding the only reference (the idle state)."""
+    chain = _chain(a, n)
+    r.insert(toks, chain)
+    for b in chain:
+        a.decref(b)
+    return chain
+
+
+def test_radix_evict_lru_skips_live_readers():
+    a = BlockAllocator(8, 4)
+    r = RadixCache(a)
+    _publish(r, a, [1, 1, 1, 1], 1)          # older
+    _publish(r, a, [2, 2, 2, 2], 1)          # newer
+    m, lease = r.match([2, 2, 2, 2])         # newer becomes MRU + leased
+    assert m == 4
+
+    # also lease the older chain: now nothing is evictable
+    _, old_lease = r.match([1, 1, 1, 1])
+    assert r.evict(2) == 0
+    for b in old_lease:
+        a.decref(b)                          # reader retires
+
+    assert r.evict(1) == 1                   # LRU idle leaf goes first
+    assert r.match([1, 1, 1, 1])[0] == 0     # the older one is gone
+    assert r.blocks_held == 1
+    for b in lease:
+        a.decref(b)
+
+
+def test_radix_budget_trims_on_insert():
+    a = BlockAllocator(8, 4)
+    r = RadixCache(a, budget_blocks=2)
+    _publish(r, a, list(range(8)), 2)        # exactly at budget
+    _publish(r, a, [9] * 4, 1)               # pushes over -> trim
+    assert r.blocks_held == 2
+    assert r.total_evictions == 1
+
+
+def test_oom_evict_retry_loop():
+    """The serving loop's recovery path: pool exhausted, idle radix
+    chains give their blocks back, retry succeeds."""
+    a = BlockAllocator(2, 4)
+    r = RadixCache(a)
+    chain = _chain(a, 2)
+    r.insert(list(range(8)), chain)
+    for b in chain:
+        a.decref(b)                          # publisher retired: idle
+    with pytest.raises(CacheOOM):
+        a.alloc()
+    assert r.evict(1) == 1
+    a.alloc()                                # retry succeeds
+
+
+def test_match_updates_hit_rate():
+    a = BlockAllocator(8, 4)
+    r = RadixCache(a)
+    assert r.hit_rate() == 0.0
+    r.insert(list(range(4)), _chain(a, 1))
+    r.match(list(range(4)))                  # 4 requested, 4 matched
+    r.match([7, 7, 7, 7])                    # 4 requested, 0 matched
+    assert r.hit_rate() == pytest.approx(0.5)
+    st = r.stats()
+    assert st["tokens_requested"] == 8 and st["tokens_matched"] == 4

@@ -314,3 +314,50 @@ def test_host_memory_counters():
         "/runtime{locality#0/total}/memory/virtual")
     assert res.value > 1_000_000    # a python process is >1 MB resident
     assert virt.value >= res.value
+
+
+def test_rate_counter_windowed_rate():
+    """RateCounter: events/sec over a sliding window — the serving
+    tokens/rate shape. 10 events in a 2s window read as 5/s no matter
+    how fast they were marked."""
+    rc = pc.RateCounter(window_s=2.0)
+    assert rc.get_value().value == 0.0
+    for _ in range(10):
+        rc.mark()
+    v = rc.get_value()
+    assert v.value == pytest.approx(10 / 2.0)
+    assert v.count >= 1
+    rc.mark(4.0)                      # weighted marks (4 tokens at once)
+    assert rc.get_value().value == pytest.approx(14 / 2.0)
+
+
+def test_rate_counter_events_expire():
+    rc = pc.RateCounter(window_s=0.05)
+    rc.mark(100.0)
+    deadline = time.time() + 5
+    while time.time() < deadline and rc.get_value().value > 0:
+        time.sleep(0.01)
+    assert rc.get_value().value == 0.0   # aged out of the window
+
+
+def test_rate_counter_reset_clears_window():
+    rc = pc.RateCounter(window_s=60.0)
+    rc.mark(30.0)
+    assert rc.get_value(reset=True).value == pytest.approx(0.5)
+    assert rc.get_value().value == 0.0
+
+
+def test_rate_counter_validates_window():
+    with pytest.raises(ValueError):
+        pc.RateCounter(window_s=0.0)
+
+
+def test_rate_counter_registers_like_any_counter():
+    rc = pc.RateCounter(window_s=10.0)
+    name = pc.counter_name("test", "events/rate", "ratecounter-test")
+    pc.register_counter(name, rc)
+    try:
+        rc.mark(20.0)
+        assert pc.query_counter(name).value == pytest.approx(2.0)
+    finally:
+        pc.unregister_counter(name)
