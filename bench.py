@@ -596,6 +596,17 @@ def _bench_main() -> None:
     sys.path.insert(0, ".")
     from hpx_tpu.ops.stencil import heat_step_best, multistep
 
+    # --trace-out travels from the parent as an env var (the child is
+    # spawned without argv): run everything under the causal tracer and
+    # write Chrome trace JSON next to the bench result at the end.
+    tracer = None
+    trace_out = os.environ.get(_TRACE_ENV)
+    if trace_out:
+        from hpx_tpu.core.config import runtime_config
+        from hpx_tpu.svc import tracing
+        runtime_config().set("hpx.trace.enabled", "1")
+        tracer = tracing.start_if_configured()
+
     dev = jax.devices()[0]
     print(f"# device: {dev} platform={dev.platform}", file=sys.stderr)
 
@@ -644,11 +655,26 @@ def _bench_main() -> None:
              spread=round(spread, 3))
     _save_fallback()
 
+    if tracer is not None:
+        from hpx_tpu.svc import tracing
+        tracing.stop_tracing()
+        doc = tracer.export(trace_out)
+        print(f"# trace written: {trace_out} "
+              f"({len(doc['traceEvents'])} events, "
+              f"{doc['otherData']['dropped_events']} dropped)",
+              file=sys.stderr)
+
 
 _CHILD_ENV = "_HPX_BENCH_CHILD"
+_TRACE_ENV = "_HPX_BENCH_TRACE_OUT"
 
 
 def main() -> None:
+    # parsed in the PARENT and forwarded via env — the bounded child is
+    # spawned without argv
+    if "--trace-out" in sys.argv:
+        os.environ[_TRACE_ENV] = os.path.abspath(
+            sys.argv[sys.argv.index("--trace-out") + 1])
     if os.environ.get(_CHILD_ENV) == "1":
         return _bench_main()
 

@@ -19,8 +19,14 @@ Prints one JSON line per engine. This is an operator harness, not part
 of bench.py's driver metrics — serving throughput depends on the
 request mix, so the mix is printed with the number.
 
+With --trace-out PATH the whole run executes under the causal task
+tracer (hpx_tpu.svc.tracing) and a Chrome trace-event JSON — serving
+spans, flow arrows, /serving + /cache counter tracks — is written to
+PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
+
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only]
+                                          [--trace-out PATH]
 """
 
 import json
@@ -44,6 +50,15 @@ def main() -> int:
     scale = int(sys.argv[sys.argv.index("--scale") + 1]) \
         if "--scale" in sys.argv else (4 if "--cpu" in sys.argv else 16)
     on_tpu = jax.default_backend() == "tpu"
+
+    trace_out = sys.argv[sys.argv.index("--trace-out") + 1] \
+        if "--trace-out" in sys.argv else None
+    tracer = None
+    if trace_out:
+        from hpx_tpu.core.config import runtime_config
+        from hpx_tpu.svc import tracing
+        runtime_config().set("hpx.trace.enabled", "1")
+        tracer = tracing.start_if_configured()
 
     d = 64 * scale
     cfg = tfm.TransformerConfig(
@@ -96,9 +111,21 @@ def main() -> int:
              prefill_tokens_computed=computed,
              prefill_saved_frac=round(saved / (saved + computed), 3))
 
+    def finish() -> int:
+        if tracer is not None:
+            from hpx_tpu.svc import tracing
+            tracing.stop_tracing()
+            doc = tracer.export(trace_out)
+            print(json.dumps({
+                "trace": os.path.abspath(trace_out),
+                "trace_events": len(doc["traceEvents"]),
+                "dropped_events": doc["otherData"]["dropped_events"],
+            }), flush=True)
+        return 0
+
     if "--prefix-only" in sys.argv:
         paged_prefix_bench()
-        return 0
+        return finish()
 
     # 1. uniform batched greedy
     B, plen, max_new = 8, 32, 64
@@ -147,7 +174,7 @@ def main() -> int:
     emit("generate_single_stream", max_new, time.perf_counter() - t0)
 
     paged_prefix_bench()
-    return 0
+    return finish()
 
 
 if __name__ == "__main__":

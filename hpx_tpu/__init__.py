@@ -165,3 +165,7 @@ from .svc.resiliency import (  # noqa: F401
 from .svc.logging import get_logger, set_log_level  # noqa: F401
 from .svc.iostreams import cout, cerr  # noqa: F401
 from .svc import profiling  # noqa: F401
+from .svc import tracing  # noqa: F401
+from .svc.tracing import (  # noqa: F401
+    Tracer, active_tracer, start_tracing, stop_tracing,
+)

@@ -369,3 +369,47 @@ class BareExceptRule(Rule):
                     ctx, node, "bare except: swallows future exceptions "
                     "(and KeyboardInterrupt/DeadlockError) — catch a "
                     "concrete type or re-raise into the future")
+
+
+_SPAN_FACTORIES = {"span", "annotate"}
+
+
+@register
+class SpanLeakRule(Rule):
+    """HPX007: ``span(...)`` / ``annotate(...)`` called as a bare
+    expression statement.
+
+    Both return a context manager (``svc.tracing.span`` a B/E span,
+    ``svc.profiling.annotate`` a jax TraceAnnotation); dropping the
+    result records NOTHING — the begin never fires, so the region
+    silently vanishes from every trace.  Worse, a tracer-level
+    ``tracer.span(...)`` statement allocates a ``_Span`` that is never
+    entered, leaking the annotation the author thought they added.
+    Fix: ``with tracing.span("phase"): ...`` (or keep the object and
+    enter it); for a point event use ``tracing.instant(...)``, which
+    really is fire-and-forget.
+    """
+
+    id = "HPX007"
+    name = "span-leak"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            func = node.value.func
+            if isinstance(func, ast.Name):
+                name = func.id
+            elif isinstance(func, ast.Attribute):
+                name = func.attr
+            else:
+                continue
+            if name in _SPAN_FACTORIES:
+                yield self.finding(
+                    ctx, node,
+                    f"result of {name}() is discarded — it returns a "
+                    "context manager, so no event is ever recorded; "
+                    "wrap the region in `with ... :` or use "
+                    "tracing.instant() for a point event")

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..svc import tracing
 from ..synchronization import Mutex
 from .block_allocator import BlockAllocator
 
@@ -111,7 +112,10 @@ class RadixCache:
                 node = child
             matched = len(bids) * self.block_size
             self.tokens_matched += matched
-            return matched, bids
+        if tracing.active_tracer() is not None:
+            tracing.instant("cache.match", "cache", matched=matched,
+                            requested=len(tokens), blocks=len(bids))
+        return matched, bids
 
     def insert(self, tokens: Sequence[int],
                block_ids: Sequence[int]) -> int:
@@ -177,6 +181,9 @@ class RadixCache:
             self._blocks_held -= 1
             self.total_evictions += 1
             freed += 1
+        if freed and tracing.active_tracer() is not None:
+            tracing.instant("cache.evict", "cache", freed=freed,
+                            requested=n, held=self._blocks_held)
         return freed
 
     def stats(self) -> Dict[str, float]:

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from ..dist.actions import async_action, plain_action
 from ..dist.runtime import find_here, get_num_localities
 from ..futures.future import Future, SharedState
+from ..svc import tracing
 from ..synchronization import Mutex
 
 # ---------------------------------------------------------------------------
@@ -177,9 +178,14 @@ class Communicator:
                   op: Optional[Callable] = None, root: int = 0,
                   generation: Optional[int] = None) -> Future:
         gen = self._next_gen(kind, generation)
-        return async_action(
-            _contribute, self.root_locality, self.basename, kind, gen,
-            self.this_site, self.num_sites, value, op, root)
+        # span covers the LAUNCH (contribution dispatch); completion is
+        # visible as the continuation/flow the returned future carries
+        with tracing.span(f"collectives.{kind}", "collectives",
+                          basename=self.basename, gen=gen,
+                          site=self.this_site):
+            return async_action(
+                _contribute, self.root_locality, self.basename, kind,
+                gen, self.this_site, self.num_sites, value, op, root)
 
     def __repr__(self) -> str:
         return (f"<communicator '{self.basename}' site {self.this_site}/"

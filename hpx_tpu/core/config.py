@@ -57,6 +57,10 @@ DEFAULTS: Dict[str, str] = {
     "hpx.cache.num_blocks": "auto",       # pool size (auto: 2x worst case)
     "hpx.cache.radix_budget_blocks": "auto",  # prefix-tree HBM budget
     "hpx.cache.prefix_reuse": "1",        # radix prefix matching on admit
+    "hpx.trace.enabled": "0",             # svc/tracing off by default
+    "hpx.trace.buffer_events": "65536",   # ring capacity (drop-oldest)
+    "hpx.trace.counter_interval": "0.05", # s between counter samples
+    "hpx.trace.counters": "/serving*,/cache*,/threads*",
     "hpx.checkpoint.dir": "./checkpoints",
     "hpx.resiliency.replay_default_n": "3",
     "hpx.exec.default_chunk": "auto",

@@ -35,6 +35,7 @@ import numpy as np
 from ..cache.block_allocator import BlockAllocator, CacheOOM
 from ..cache.page_table import PageTable, materialize
 from ..cache.radix import RadixCache
+from ..svc import tracing
 from ..ops.paged_attention import gather_block_kv, paged_decode_attention
 from .transformer import (
     _PREFILL_CHUNK,
@@ -581,14 +582,16 @@ class ContinuousServer:
             raise
         pt.tokens = plen
         slen = plen - matched
-        trow = jnp.asarray(pt.as_row(self._maxb, self._trash))
-        suffix = jnp.asarray([req.prompt[matched:]], jnp.int32)
-        one, last_logits = self._paged_prefill_prog(slen, plen)(
-            self.params, self._pools, trow, suffix)
-        sbids = jnp.asarray(pt.blocks[matched // self.block_size:],
-                            jnp.int32)
-        self._pools = self._paged_splice_prog(slen, plen)(
-            self._pools, one, sbids)
+        with tracing.span("serving.prefill", "serving", rid=req.rid,
+                          plen=plen, matched=matched, suffix=slen):
+            trow = jnp.asarray(pt.as_row(self._maxb, self._trash))
+            suffix = jnp.asarray([req.prompt[matched:]], jnp.int32)
+            one, last_logits = self._paged_prefill_prog(slen, plen)(
+                self.params, self._pools, trow, suffix)
+            sbids = jnp.asarray(pt.blocks[matched // self.block_size:],
+                                jnp.int32)
+            self._pools = self._paged_splice_prog(slen, plen)(
+                self._pools, one, sbids)
         self._prefill_saved += matched
         self._prefill_computed += slen
         return pt, last_logits
@@ -667,30 +670,35 @@ class ContinuousServer:
             while self._slot_req[slot] is None and self._queue:
                 req = self._queue.popleft()
                 plen = len(req.prompt)
-                if self.paged:
-                    pt, last_logits = self._admit_paged(req)
-                    self._tables[slot] = pt
-                else:
-                    prompt = jnp.asarray([req.prompt], jnp.int32)
-                    one, last_logits = self._prefill_prog(plen)(
-                        self.params, prompt)
-                    self._caches = self._splice_prog(plen)(
-                        self._caches, one, jnp.int32(slot))
-                if req.temperature > 0.0:
-                    # generate()'s tok0 draw: position plen-1, row 0
-                    tok0 = int(_sample_row(last_logits[0],
-                                           req.temperature,
-                                           req.key, plen - 1, 0))
-                else:
-                    tok0 = int(jnp.argmax(last_logits[0]))
-                req.tokens.append(tok0)
-                self._slot_req[slot] = req
-                self._pos[slot] = plen
-                self._cur[slot] = tok0
-                self._temp[slot] = req.temperature
-                self._key[slot] = (req.key if req.key is not None
-                                   else jax.random.PRNGKey(0))
-                self._maybe_retire(slot)
+                with tracing.span("serving.admit", "serving",
+                                  rid=req.rid, slot=slot, plen=plen):
+                    if self.paged:
+                        pt, last_logits = self._admit_paged(req)
+                        self._tables[slot] = pt
+                    else:
+                        with tracing.span("serving.prefill", "serving",
+                                          rid=req.rid, plen=plen):
+                            prompt = jnp.asarray([req.prompt],
+                                                 jnp.int32)
+                            one, last_logits = self._prefill_prog(
+                                plen)(self.params, prompt)
+                            self._caches = self._splice_prog(plen)(
+                                self._caches, one, jnp.int32(slot))
+                    if req.temperature > 0.0:
+                        # generate()'s tok0 draw: position plen-1, row 0
+                        tok0 = int(_sample_row(last_logits[0],
+                                               req.temperature,
+                                               req.key, plen - 1, 0))
+                    else:
+                        tok0 = int(jnp.argmax(last_logits[0]))
+                    req.tokens.append(tok0)
+                    self._slot_req[slot] = req
+                    self._pos[slot] = plen
+                    self._cur[slot] = tok0
+                    self._temp[slot] = req.temperature
+                    self._key[slot] = (req.key if req.key is not None
+                                       else jax.random.PRNGKey(0))
+                    self._maybe_retire(slot)
 
     def _maybe_retire(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -704,10 +712,13 @@ class ContinuousServer:
                 # slot retires early and pads the same tail
                 req.tokens = req.tokens + [req.eos_id] * (
                     req.max_new - len(req.tokens))
-            self._done[req.rid] = req.tokens
-            self._slot_req[slot] = None
-            if self.paged:
-                self._release_slot(slot, req)
+            with tracing.span("serving.retire", "serving",
+                              rid=req.rid, slot=slot,
+                              tokens=len(req.tokens), eos=hit_eos):
+                self._done[req.rid] = req.tokens
+                self._slot_req[slot] = None
+                if self.paged:
+                    self._release_slot(slot, req)
 
     def step(self) -> bool:
         """Admit + one decode step for every live slot. Returns True
@@ -717,33 +728,38 @@ class ContinuousServer:
                 if self._slot_req[s] is not None]
         if not live:
             return bool(self._queue)
-        tok = jnp.asarray(self._cur, jnp.int32)
-        # dense: dead slots re-write their own last position (harmless:
-        # never read — admission overwrites rows 0..plen first). Paged:
-        # dead slots' tables are all-trash, so their writes land in the
-        # reserved trash block instead of a recycled live block.
-        pos = jnp.asarray(self._pos, jnp.int32)
-        temp = jnp.asarray(self._temp, jnp.float32)
-        keys = jnp.stack(self._key)
-        if self.paged:
+        with tracing.span("serving.decode", "serving",
+                          live=len(live),
+                          rids=[self._slot_req[s].rid for s in live]):
+            tok = jnp.asarray(self._cur, jnp.int32)
+            # dense: dead slots re-write their own last position
+            # (harmless: never read — admission overwrites rows
+            # 0..plen first). Paged: dead slots' tables are all-trash,
+            # so their writes land in the reserved trash block instead
+            # of a recycled live block.
+            pos = jnp.asarray(self._pos, jnp.int32)
+            temp = jnp.asarray(self._temp, jnp.float32)
+            keys = jnp.stack(self._key)
+            if self.paged:
+                for s in live:
+                    self._ensure_block(s, self._pos[s])
+                tables = jnp.asarray(materialize(
+                    self._tables, self._maxb, self._trash))
+                self._pools, nxt = self._paged_step_prog()(
+                    self.params, self._pools, tok, pos, tables, temp,
+                    keys)
+            else:
+                self._caches, nxt = self._step_prog()(
+                    self.params, self._caches, tok, pos, temp, keys)
+            nxt_host = np.asarray(nxt).tolist()  # ONE device->host read
+            self._rate.mark(float(len(live)))
             for s in live:
-                self._ensure_block(s, self._pos[s])
-            tables = jnp.asarray(materialize(self._tables, self._maxb,
-                                             self._trash))
-            self._pools, nxt = self._paged_step_prog()(
-                self.params, self._pools, tok, pos, tables, temp, keys)
-        else:
-            self._caches, nxt = self._step_prog()(
-                self.params, self._caches, tok, pos, temp, keys)
-        nxt_host = np.asarray(nxt).tolist()    # ONE device->host read
-        self._rate.mark(float(len(live)))
-        for s in live:
-            req = self._slot_req[s]
-            assert req is not None
-            req.tokens.append(nxt_host[s])
-            self._pos[s] += 1
-            self._cur[s] = nxt_host[s]
-            self._maybe_retire(s)
+                req = self._slot_req[s]
+                assert req is not None
+                req.tokens.append(nxt_host[s])
+                self._pos[s] += 1
+                self._cur[s] = nxt_host[s]
+                self._maybe_retire(s)
         return True
 
     def run(self) -> Dict[int, List[int]]:

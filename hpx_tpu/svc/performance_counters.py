@@ -461,6 +461,14 @@ def _register_builtins() -> None:
     put("runtime", "memory/virtual", CallbackCounter(_statm(0)))
     put("runtime", "memory/resident", CallbackCounter(_statm(1)))
 
+    # observer health: external-timer / task-observer callbacks whose
+    # exceptions were swallowed (svc/profiling) — nonzero means a
+    # profiling hook is broken and silently dropping data
+    from . import profiling as _prof
+    put("runtime", "count/dropped-observer-callbacks",
+        CallbackCounter(lambda: float(_prof.dropped_callbacks()),
+                        reset_fn=_prof.reset_dropped_callbacks))
+
     # parcel layer (only once the distributed runtime is up). Read the
     # CURRENT runtime at query time: closing over the runtime object
     # alive at first registration would report frozen values (and pin a

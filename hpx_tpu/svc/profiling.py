@@ -27,6 +27,33 @@ from typing import Any, Callable, Dict, List, Optional
 _hooks_lock = Mutex()
 _hooks: List[Any] = []      # objects with optional on_submit/on_start/on_stop
 
+# Observer callbacks must never break tasks, so their exceptions are
+# swallowed — but SILENT swallowing makes a broken hook (a TaskTimer
+# whose on_stop raises, a tracer bug) invisible forever. Every swallow
+# increments this counter, exported as the
+# /runtime{...}/count/dropped-observer-callbacks performance counter.
+_dropped_lock = Mutex()
+_dropped_callbacks = 0
+
+
+def note_observer_error() -> None:
+    """Record one swallowed observer exception (also called by the
+    threadpool's own observer guards)."""
+    global _dropped_callbacks
+    with _dropped_lock:
+        _dropped_callbacks += 1
+
+
+def dropped_callbacks() -> int:
+    """Observer callbacks dropped (exception swallowed) so far."""
+    return _dropped_callbacks
+
+
+def reset_dropped_callbacks() -> None:
+    global _dropped_callbacks
+    with _dropped_lock:
+        _dropped_callbacks = 0
+
 
 def register_external_timer(hook: Any) -> None:
     """hook may define on_submit(fn), on_start(fn), on_stop(fn, seconds)."""
@@ -55,7 +82,7 @@ def _emit(event: str, *args: Any) -> None:
             try:
                 cb(*args)
             except Exception:  # noqa: BLE001 — observers must not break tasks
-                pass
+                note_observer_error()
 
 
 def _set_pool_instrumentation(enable: bool) -> None:

@@ -1,0 +1,214 @@
+"""Chrome trace-event export for `svc/tracing` — Perfetto-loadable JSON.
+
+Produces the JSON-object form of the trace-event format
+(``{"traceEvents": [...]}``) that ``chrome://tracing`` and
+https://ui.perfetto.dev load directly:
+
+  * ``M`` metadata rows name the process and one row per worker thread;
+  * every span is a matched ``B``/``E`` duration pair (span id and
+    causal parent id in ``args`` — the task DAG survives the export);
+  * every submit→run / future→continuation edge is an ``s``/``f`` flow
+    pair (Perfetto draws the arrows);
+  * performance-counter samples are ``C`` counter events on the same
+    timeline (one track per counter name).
+
+The exporter is also the trace's janitor: spans still open at snapshot
+time get a synthetic ``E`` at the trace end, ``E``/``f`` events whose
+``B``/``s`` half was evicted from the ring (drop-oldest) are discarded,
+so the artifact always validates. :func:`validate_chrome_trace` is the
+schema check the tests (and CI smoke) run on every emitted artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["to_chrome_trace", "write_chrome_trace",
+           "validate_chrome_trace", "load_chrome_trace"]
+
+_PID = 1                       # single-process trace; localities could
+                               # map to pids in a multi-host merge
+
+
+def _us(ts: float, t0: float) -> float:
+    return round((ts - t0) * 1e6, 3)
+
+
+def to_chrome_trace(events: List[tuple],
+                    thread_names: Optional[Dict[int, str]] = None,
+                    t0: float = 0.0,
+                    dropped: int = 0) -> dict:
+    """Convert a `Tracer.snapshot()` (record-order flat tuples) into
+    the Chrome trace-event JSON document."""
+    thread_names = thread_names or {}
+    out: List[dict] = []
+
+    # pass 1: which span/flow ids have their opening half in-buffer,
+    # and the trace end timestamp for closing dangling spans
+    begun: set = set()
+    flow_started: set = set()
+    t_end = t0
+    for ev in events:
+        ph, _name, _cat, ts, _tid, eid = ev[0], ev[1], ev[2], ev[3], \
+            ev[4], ev[5]
+        if ts > t_end:
+            t_end = ts
+        if ph == "B":
+            begun.add(eid)
+        elif ph == "s":
+            flow_started.add(eid)
+
+    open_spans: Dict[int, dict] = {}     # span id -> its B record
+    for ev in events:
+        ph, name, cat, ts, tid, eid, parent, args = ev
+        if ph == "B":
+            rec = {"ph": "B", "pid": _PID, "tid": tid, "ts": _us(ts, t0),
+                   "name": name, "cat": cat,
+                   "args": {"span": eid, "parent": parent}}
+            if args:
+                rec["args"].update(args)
+            out.append(rec)
+            open_spans[eid] = rec
+        elif ph == "E":
+            if eid not in begun:
+                continue           # its B was evicted: keep pairs matched
+            open_spans.pop(eid, None)
+            out.append({"ph": "E", "pid": _PID, "tid": tid,
+                        "ts": _us(ts, t0), "name": name, "cat": cat})
+        elif ph == "i":
+            rec = {"ph": "i", "pid": _PID, "tid": tid, "ts": _us(ts, t0),
+                   "name": name, "cat": cat, "s": "t",
+                   "args": {"parent": parent}}
+            if args:
+                rec["args"].update(args)
+            out.append(rec)
+        elif ph == "s":
+            out.append({"ph": "s", "pid": _PID, "tid": tid,
+                        "ts": _us(ts, t0), "name": name, "cat": cat,
+                        "id": eid})
+        elif ph == "f":
+            if eid not in flow_started:
+                continue           # unresolved arrow: drop the head
+            out.append({"ph": "f", "pid": _PID, "tid": tid,
+                        "ts": _us(ts, t0), "name": name, "cat": cat,
+                        "id": eid, "bp": "e"})
+        elif ph == "C":
+            out.append({"ph": "C", "pid": _PID, "tid": 0,
+                        "ts": _us(ts, t0), "name": name, "cat": cat,
+                        "args": {"value": args}})
+
+    # drop flow tails whose head span never ran (task still queued at
+    # snapshot): validators demand every s resolve to an f
+    finished = {e["id"] for e in out if e["ph"] == "f"}
+    out = [e for e in out if e["ph"] != "s" or e["id"] in finished]
+
+    # close spans still open at snapshot so B/E always balance —
+    # innermost (most recent B) first, preserving stack nesting
+    for sid, rec in reversed(list(open_spans.items())):
+        out.append({"ph": "E", "pid": _PID, "tid": rec["tid"],
+                    "ts": _us(t_end, t0), "name": rec["name"],
+                    "cat": rec["cat"]})
+
+    # stable sort by ts: per-thread record order (already
+    # non-decreasing) is preserved, threads interleave correctly
+    out.sort(key=lambda e: e["ts"])
+
+    meta: List[dict] = [{
+        "ph": "M", "pid": _PID, "tid": 0, "name": "process_name",
+        "args": {"name": "hpx_tpu"}}]
+    for ident, tname in sorted(thread_names.items()):
+        meta.append({"ph": "M", "pid": _PID, "tid": ident,
+                     "name": "thread_name", "args": {"name": tname}})
+
+    return {"traceEvents": meta + out,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": dropped,
+                          "format": "hpx_tpu.svc.tracing"}}
+
+
+def write_chrome_trace(path: str, tracer: Any) -> dict:
+    """Snapshot `tracer` and write the JSON artifact to `path`."""
+    doc = to_chrome_trace(tracer.snapshot(), tracer.thread_names(),
+                          tracer.t0, tracer.dropped)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)          # readers never see a half-written trace
+    return doc
+
+
+def load_chrome_trace(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Schema-check an exported document; returns a list of problems
+    (empty == valid). Checks: required keys per phase, globally
+    non-decreasing timestamps, matched B/E pairs per thread, every
+    flow id resolving to an s+f pair, numeric counter values."""
+    problems: List[str] = []
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return ["document is not a dict with a traceEvents list"]
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+
+    required = {"B": ("name", "cat", "ts", "pid", "tid"),
+                "E": ("name", "ts", "pid", "tid"),
+                "i": ("name", "ts", "pid", "tid"),
+                "s": ("name", "ts", "pid", "tid", "id"),
+                "f": ("name", "ts", "pid", "tid", "id"),
+                "C": ("name", "ts", "pid", "args"),
+                "M": ("name", "pid", "args")}
+    last_ts: Optional[float] = None
+    depth: Dict[Tuple[int, int], int] = {}     # (pid, tid) -> open B count
+    flows: Dict[int, set] = {}
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if ph not in required:
+            problems.append(f"event {i}: unknown/missing ph {ph!r}")
+            continue
+        missing = [k for k in required[ph] if k not in ev]
+        if missing:
+            problems.append(f"event {i} (ph={ph}): missing {missing}")
+            continue
+        if ph == "M":
+            continue
+        ts = ev["ts"]
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i}: non-numeric ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            problems.append(
+                f"event {i}: ts {ts} < previous {last_ts} — "
+                "not monotonically ordered")
+        last_ts = ts
+        key = (ev["pid"], ev["tid"])
+        if ph == "B":
+            depth[key] = depth.get(key, 0) + 1
+        elif ph == "E":
+            depth[key] = depth.get(key, 0) - 1
+            if depth[key] < 0:
+                problems.append(
+                    f"event {i}: E without a matching B on tid "
+                    f"{ev['tid']}")
+        elif ph in ("s", "f"):
+            flows.setdefault(ev["id"], set()).add(ph)
+        elif ph == "C":
+            v = ev["args"].get("value")
+            if not isinstance(v, (int, float)):
+                problems.append(
+                    f"event {i}: counter {ev['name']!r} value {v!r} "
+                    "is not numeric")
+    for key, d in depth.items():
+        if d != 0:
+            problems.append(f"tid {key[1]}: {d} unmatched B events")
+    for fid, phases in flows.items():
+        if phases != {"s", "f"}:
+            problems.append(
+                f"flow id {fid}: has {sorted(phases)}, needs both "
+                "s and f")
+    return problems

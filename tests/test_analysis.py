@@ -263,6 +263,51 @@ def test_hpx006_silent_with_type():
 
 
 # ---------------------------------------------------------------------------
+# HPX007 — span context manager discarded
+# ---------------------------------------------------------------------------
+
+HPX007_BAD = """\
+from hpx_tpu.svc import tracing
+
+def phase():
+    tracing.span("phase", "serving", step=1)
+    work()
+"""
+
+HPX007_GOOD = """\
+from hpx_tpu.svc import tracing
+
+def phase():
+    with tracing.span("phase", "serving", step=1):
+        work()
+    tracing.instant("phase.done", "serving")
+"""
+
+
+def test_hpx007_fires_once():
+    assert rules_of(findings(HPX007_BAD)) == ["HPX007"]
+
+
+def test_hpx007_silent_with_with():
+    assert findings(HPX007_GOOD) == []
+
+
+def test_hpx007_annotate_statement():
+    src = ("from hpx_tpu.svc.profiling import annotate\n"
+           "def f():\n"
+           "    annotate('region')\n")
+    assert rules_of(findings(src)) == ["HPX007"]
+
+
+def test_hpx007_kept_result_is_silent():
+    # binding the manager (entered later / passed on) is fine
+    src = ("def f(tracer):\n"
+           "    s = tracer.span('x')\n"
+           "    return s\n")
+    assert findings(src) == []
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, syntax errors, baseline
 # ---------------------------------------------------------------------------
 
@@ -358,7 +403,7 @@ def test_finding_format():
 def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003",
-                   "HPX004", "HPX005", "HPX006"]
+                   "HPX004", "HPX005", "HPX006", "HPX007"]
 
 
 # ---------------------------------------------------------------------------

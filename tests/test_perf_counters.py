@@ -124,13 +124,15 @@ class TestPrinting:
         buf = io.StringIO()
         pc.print_counters("/runtime{*", file=buf)
         lines = buf.getvalue().strip().splitlines()
-        HPX_TEST(lines[0].startswith(
-            "/runtime{locality#0/total}/memory/resident,"))
         HPX_TEST_EQ(len(lines[0].split(",")), 4)
-        # /runtime now carries uptime + the process memory counters
+        # /runtime carries uptime, the process memory counters, and the
+        # dropped-observer-callbacks diagnostic
         names = [ln.split(",")[0] for ln in lines]
+        HPX_TEST("/runtime{locality#0/total}/memory/resident" in names)
         HPX_TEST("/runtime{locality#0/total}/uptime" in names)
         HPX_TEST("/runtime{locality#0/total}/memory/virtual" in names)
+        HPX_TEST("/runtime{locality#0/total}/count/"
+                 "dropped-observer-callbacks" in names)
 
     def test_interval_printer_stops(self):
         buf = io.StringIO()
