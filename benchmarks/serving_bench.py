@@ -5,7 +5,10 @@ host; CPU for smoke runs with --cpu):
 
   1. generate            — batched uniform greedy decode
   2. ContinuousServer    — slot-based continuous batching over a ragged
-                           request mix (the steady-state serving shape)
+                           request mix (the steady-state serving shape),
+                           plus a mixed-UNBUCKETED-length wave reporting
+                           cold-start compiles, TTFT, and decode-stall
+                           p99 (the bucketed chunked-prefill case)
   3. speculative_generate — draft-assisted greedy (reports rounds too:
                            tokens per target window forward is the
                            speedup lever)
@@ -137,10 +140,9 @@ def main() -> int:
     emit("generate", B * max_new, time.perf_counter() - t0,
          mix=f"B{B} plen{plen} new{max_new}")
 
-    # 2. continuous batching over a ragged mix
-    # prompt lengths bucketed to multiples of 8: the server memoizes
-    # prefill programs per plen, so buckets bound compile count (the
-    # production discipline the ContinuousServer docstring names)
+    # 2. continuous batching over a ragged mix (pre-bucketed plens:
+    # the legacy-friendly shape; the mixed_length wave below is the
+    # hard case)
     reqs = [(rng.integers(1, 1000, 8 * int(rng.integers(1, 7))).tolist(),
              int(rng.integers(16, 96))) for _ in range(12)]
     total_new = sum(m for _, m in reqs)
@@ -155,6 +157,50 @@ def main() -> int:
     srv.run()
     emit("continuous_batching", total_new, time.perf_counter() - t0,
          mix="12 reqs plen8-48(x8 buckets) new16-96 over 4 slots")
+
+    # 2b. mixed UNBUCKETED prompt lengths — the compile-storm shape the
+    # bucketed chunked prefill exists for. A manual step loop times
+    # every step (decode-stall p99: a prefill blocking the batch shows
+    # up here), TTFT comes straight from srv.ttft, and compile counts
+    # from jax.monitoring — reported for the COLD server; throughput
+    # and stalls for the warm one.
+    def mixed_length_bench():
+        from hpx_tpu.utils.compilemon import count_compiles
+        mreqs = [(rng.integers(
+                      1, 1000, int(rng.integers(5, 150))).tolist(),
+                  int(rng.integers(16, 96))) for _ in range(12)]
+        mtotal = sum(m for _, m in mreqs)
+
+        def run_mixed():
+            with count_compiles() as c:
+                srv = ContinuousServer(params, cfg, slots=4, smax=256)
+                for p, m in mreqs:
+                    srv.submit(p, max_new=m)
+                t0 = time.perf_counter()
+                stalls = []
+                alive = True
+                while alive:
+                    s0 = time.perf_counter()
+                    alive = srv.step()
+                    stalls.append(time.perf_counter() - s0)
+                secs = time.perf_counter() - t0
+            srv._done.clear()
+            return srv, secs, stalls, int(c)
+
+        cold_srv, _, _, cold_compiles = run_mixed()
+        srv, secs, stalls, _ = run_mixed()
+        ttfts = list(srv.ttft.values())
+        emit("continuous_batching_mixed", mtotal, secs,
+             mix="12 reqs plen5-149 (unbucketed) new16-96 over 4 slots",
+             compiles_cold=cold_compiles,
+             programs_built=cold_srv._prog_misses,
+             prefill_chunks=srv._chunks,
+             ttft_mean_ms=round(1e3 * sum(ttfts) / len(ttfts), 2),
+             ttft_max_ms=round(1e3 * max(ttfts), 2),
+             decode_stall_p99_ms=round(
+                 1e3 * float(np.percentile(stalls, 99)), 2))
+
+    mixed_length_bench()
 
     # 3. speculative greedy (single stream: the latency case)
     sp = jnp.asarray(rng.integers(1, 1000, (1, plen)), jnp.int32)

@@ -413,3 +413,126 @@ class SpanLeakRule(Rule):
                     "context manager, so no event is ever recorded; "
                     "wrap the region in `with ... :` or use "
                     "tracing.instant() for a point event")
+
+
+_PROGRAM_CACHE_CALLEES = {"cached_program", "_cached_program",
+                          "_program"}
+
+
+def _walk_function(fn: ast.AST) -> Iterable[ast.AST]:
+    """Yield the nodes of one function body WITHOUT descending into
+    nested function definitions (each is analyzed as its own scope)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_shape_read(value: ast.AST) -> bool:
+    """`x.shape` or `x.shape[i]` — a raw array-extent read."""
+    if isinstance(value, ast.Attribute) and value.attr == "shape":
+        return True
+    return (isinstance(value, ast.Subscript)
+            and isinstance(value.value, ast.Attribute)
+            and value.value.attr == "shape")
+
+
+def _is_len_call(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "len")
+
+
+@register
+class UnbucketedProgramKeyRule(Rule):
+    """HPX008: jit program cache keyed on a raw dynamic length.
+
+    ``cached_program``-family memoization keyed on ``len(...)`` or a
+    ``.shape`` extent compiles ONE program per distinct value — under
+    mixed-length traffic (serving prompts, ragged batches) the cache
+    becomes a compile storm and the trace cache an HBM leak.  Fix:
+    round the extent to a bucket ladder and pad-then-mask inside the
+    program (``models/serving.py``'s ``hpx.serving.prefill_buckets``
+    discipline), so the cache is O(buckets).  A per-shape key is
+    legitimate when the program truly cannot pad (whole-array FFTs,
+    monolithic generate/scan bodies that bake trip counts) — keep
+    those in the baseline with a justification.
+    """
+
+    id = "HPX008"
+    name = "unbucketed-program-key"
+    severity = "warning"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_scope(ctx, fn)
+
+    def _check_scope(self, ctx: FileContext,
+                     fn: ast.AST) -> Iterable[Finding]:
+        tainted: Set[str] = set()      # names holding len()/shape vals
+        tuples: dict = {}              # local name -> ast.Tuple
+        for node in _walk_function(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                dynamic = _is_len_call(value) or _is_shape_read(value)
+                for t in targets:
+                    names = ([t] if isinstance(t, ast.Name)
+                             else list(t.elts)
+                             if isinstance(t, ast.Tuple) else [])
+                    for el in names:
+                        if not isinstance(el, ast.Name):
+                            continue
+                        # `b, n = x.shape` taints every unpacked name
+                        unpacked = (isinstance(t, ast.Tuple)
+                                    and _is_shape_read(value))
+                        if dynamic or unpacked:
+                            tainted.add(el.id)
+                        if isinstance(value, ast.Tuple) \
+                                and isinstance(t, ast.Name):
+                            tuples[el.id] = value
+        seen: Set[Tuple[int, int]] = set()  # a key tuple built once
+        # and passed to two call sites (mesh/no-mesh branches) is ONE
+        # problem — report each offending element once per scope
+        for node in _walk_function(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = ctx.resolve_call(node.func) or ""
+            if callee.rsplit(".", 1)[-1] not in _PROGRAM_CACHE_CALLEES:
+                continue
+            for arg in node.args:
+                key = arg
+                if isinstance(key, ast.Name):
+                    key = tuples.get(key.id)
+                if not isinstance(key, ast.Tuple):
+                    continue
+                for elt in key.elts:
+                    bad = (_is_len_call(elt) or _is_shape_read(elt)
+                           or (isinstance(elt, ast.Name)
+                               and elt.id in tainted))
+                    if not bad:
+                        continue
+                    at = (elt.lineno, elt.col_offset)
+                    if at in seen:
+                        continue
+                    seen.add(at)
+                    desc = ast.unparse(elt)
+                    fname = getattr(fn, "name", "<module>")
+                    yield self.finding(
+                        ctx, elt,
+                        f"program cache key in {fname}() carries raw "
+                        f"dynamic length {desc!r} — one compiled "
+                        "program per distinct value; bucket it to a "
+                        "ladder and pad-then-mask (serving's "
+                        "hpx.serving.prefill_buckets discipline), or "
+                        "baseline it with a justification")

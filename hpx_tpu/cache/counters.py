@@ -13,6 +13,10 @@ Every server gets the serving counters::
     /serving{locality#L/server#i}/slots/occupancy   live slots / slots
     /serving{locality#L/server#i}/tokens/rate       decode tokens/sec
                                                     (windowed RateCounter)
+    /serving{locality#L/server#i}/prefill/chunks    prefill chunk dispatches
+    /serving{locality#L/server#i}/prefill/pending   in-flight chunked prefills
+    /serving{locality#L/server#i}/programs/cache-hits    program-cache hits
+    /serving{locality#L/server#i}/programs/cache-misses  program builds (compiles)
 
 Paged servers additionally export the cache counters::
 
@@ -74,6 +78,14 @@ def register_server(srv) -> str:
     # the server's own windowed tokens/sec counter, registered as-is
     # (RateCounter IS a Counter); it holds no reference back
     put("serving", "tokens/rate", srv._rate)
+    put("serving", "prefill/chunks",
+        pc.CallbackCounter(_read(ref, lambda s: s._chunks)))
+    put("serving", "prefill/pending",
+        pc.CallbackCounter(_read(ref, lambda s: len(s._pending))))
+    put("serving", "programs/cache-hits",
+        pc.CallbackCounter(_read(ref, lambda s: s._prog_hits)))
+    put("serving", "programs/cache-misses",
+        pc.CallbackCounter(_read(ref, lambda s: s._prog_misses)))
 
     if getattr(srv, "paged", False):
         put("cache", "hit-rate",

@@ -17,15 +17,25 @@ scatter harmlessly instead of corrupting live data.
 
 from __future__ import annotations
 
+import itertools
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 __all__ = ["PageTable", "materialize"]
 
+_UIDS = itertools.count()
+
 
 class PageTable:
-    """Block map for one request: `blocks[i]` backs logical block i."""
+    """Block map for one request: `blocks[i]` backs logical block i.
+
+    `version` counts mutations through the mutator methods
+    (`append_block` / `replace_block` / `extend_blocks`); the serving
+    step loop keys its materialized-table device cache on it, so a
+    steady-state decode step re-uploads nothing. Callers that poke
+    `blocks` directly must bump `version` themselves.
+    """
 
     def __init__(self, block_size: int) -> None:
         if block_size < 1:
@@ -33,9 +43,22 @@ class PageTable:
         self.block_size = block_size
         self.blocks: List[int] = []
         self.tokens = 0            # logical length in token rows
+        self.version = 0           # bumped by every mutator
+        self.uid = next(_UIDS)     # process-unique (id() can recycle)
 
     def append_block(self, bid: int) -> None:
         self.blocks.append(bid)
+        self.version += 1
+
+    def extend_blocks(self, bids: Sequence[int]) -> None:
+        self.blocks.extend(bids)
+        self.version += 1
+
+    def replace_block(self, idx: int, bid: int) -> None:
+        """Swap the physical block backing logical block `idx`
+        (copy-on-write fork installs the private copy here)."""
+        self.blocks[idx] = bid
+        self.version += 1
 
     @property
     def capacity(self) -> int:

@@ -57,6 +57,10 @@ DEFAULTS: Dict[str, str] = {
     "hpx.cache.num_blocks": "auto",       # pool size (auto: 2x worst case)
     "hpx.cache.radix_budget_blocks": "auto",  # prefix-tree HBM budget
     "hpx.cache.prefix_reuse": "1",        # radix prefix matching on admit
+    "hpx.serving.prefill_chunk": "128",   # prompt tokens per prefill chunk
+    "hpx.serving.prefill_buckets": "auto",  # chunk-width ladder (csv|auto)
+    "hpx.serving.async_dispatch": "1",    # decode without per-step sync
+    "hpx.serving.max_async_steps": "32",  # buffered steps before a sync
     "hpx.trace.enabled": "0",             # svc/tracing off by default
     "hpx.trace.buffer_events": "65536",   # ring capacity (drop-oldest)
     "hpx.trace.counter_interval": "0.05", # s between counter samples

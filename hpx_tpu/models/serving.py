@@ -4,17 +4,38 @@ Reference analog: none (HPX ships no serving runtime); this is the
 standard TPU serving-loop shape — a FIXED batch of decode slots, each
 at its OWN sequence position, stepping together in one jitted program.
 Requests admit into free slots between steps (their prompt prefills on
-the side as one window forward, then SPLICES into the slot's cache
-rows) and retire on eos/max_new, so short requests never wait for long
-ones and the chip never idles on a ragged batch. Static shapes
-throughout: the per-row cache write is a batched scatter at the slot's
-position vector, the causal mask compares against per-row positions,
-and dead slots simply compute masked work (the XLA way — uniform work,
-no dynamic batch).
+the side in BUCKETED CHUNKS, then SPLICES into the slot's cache rows)
+and retire on eos/max_new, so short requests never wait for long ones
+and the chip never idles on a ragged batch. Static shapes throughout:
+the per-row cache write is a batched scatter at the slot's position
+vector, the causal mask compares against per-row positions, and dead
+slots simply compute masked work (the XLA way — uniform work, no
+dynamic batch).
+
+Three throughput disciplines shape the hot loop:
+
+* BUCKETED prefill: prompts run through fixed-width chunk programs
+  (widths from the ``hpx.serving.prefill_buckets`` ladder, padded then
+  causally masked), so the program cache is O(buckets) instead of
+  O(distinct prompt lengths) — mixed-length traffic compiles a handful
+  of programs, ever.
+* CHUNKED prefill interleaved with decode (Sarathi-style): a prompt
+  longer than ``hpx.serving.prefill_chunk`` advances one chunk per
+  step between decode dispatches, so an admit never stalls the live
+  batch; pending prefills are served shortest-remaining-first, so a
+  short prompt is never stuck behind a long one's tail chunks.
+* ASYNC dispatch: the step loop feeds each step's sampled tokens back
+  device-side and only syncs to the host when a token VALUE is needed
+  (eos check, retirement) or the ``hpx.serving.max_async_steps`` cap
+  hits — host Python overlaps device execution.
 
 Differential contract (the test): every request's tokens are EXACTLY
 what transformer.generate() emits for that prompt alone — continuous
-batching changes THROUGHPUT, never content.
+batching changes THROUGHPUT, never content. Chunk padding preserves
+this bit-for-bit: per-token hidden states and K/V rows are independent
+of how the prompt is partitioned into windows (row-independent ops +
+exact-zero causal masking of pad rows), and the first sampled token
+comes from a 1-token logits probe of the last prompt position.
 
 Build on the single-sequence machinery in models/transformer.py; the
 per-row-position block lives here (the scalar-position `_block_decode`
@@ -25,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -36,7 +58,11 @@ from ..cache.block_allocator import BlockAllocator, CacheOOM
 from ..cache.page_table import PageTable, materialize
 from ..cache.radix import RadixCache
 from ..svc import tracing
-from ..ops.paged_attention import gather_block_kv, paged_decode_attention
+from ..ops.paged_attention import (
+    gather_block_kv,
+    paged_decode_attention,
+    scatter_seq_blocks,
+)
 from .transformer import (
     _PREFILL_CHUNK,
     TransformerConfig,
@@ -44,7 +70,6 @@ from .transformer import (
     _decode_window,
     _dq,
     _ln,
-    _prefill_window,
     _qkv_proj,
     _sample_row,
     _tree_key,
@@ -76,6 +101,36 @@ def _normalize_key(key):
             f"jax.random.PRNGKey(...) of shape {raw.shape}; got shape "
             f"{arr.shape} dtype {arr.dtype}")
     return arr
+
+
+def _resolve_buckets(spec, chunk: int) -> Tuple[int, ...]:
+    """The chunk-width ladder: ``auto`` doubles from 8 up to the chunk
+    size; a csv spec is parsed, clamped to the chunk (a chunk program
+    never sees a wider window), and always completed with the full
+    chunk width so every chunk has a bucket."""
+    if spec is None or str(spec).strip() in ("", "auto"):
+        ladder, w = [], 8
+        while w < chunk:
+            ladder.append(w)
+            w *= 2
+        ladder.append(chunk)
+        return tuple(sorted(set(ladder)))
+    vals: List[int] = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        v = int(part)
+        if v < 1:
+            raise ValueError(
+                f"hpx.serving.prefill_buckets entries must be >= 1, "
+                f"got {v}")
+        vals.append(min(v, chunk))
+    if not vals:
+        raise ValueError(
+            f"hpx.serving.prefill_buckets parsed to nothing: {spec!r}")
+    vals.append(chunk)
+    return tuple(sorted(set(vals)))
 
 
 def _rope_rows(x, pos, cfg: TransformerConfig):
@@ -202,6 +257,27 @@ class _Request:
     temperature: float = 0.0       # 0: greedy; >0: sample with `key`
     key: Any = None
     tokens: List[int] = dataclasses.field(default_factory=list)
+    sent: int = 0                  # tokens DISPATCHED (>= len(tokens))
+    t_submit: float = 0.0          # monotonic submit time (TTFT)
+
+
+@dataclasses.dataclass
+class _PendingPrefill:
+    """One in-flight chunked prefill: owns a reserved slot and a b=1
+    scratch cache; `done` is the absolute prompt cursor (starts at the
+    radix-matched prefix length in paged mode)."""
+    req: _Request
+    slot: int
+    caches: Any                    # b=1 [1, smax] scratch, per layer
+    done: int                      # prompt tokens already in scratch
+    seq: int                       # admission order (FIFO tiebreak)
+    pt: Optional[PageTable] = None  # paged: blocks held for the request
+    trow: Any = None               # paged: device [maxb] table row
+    flow: Optional[int] = None     # tracing flow id chaining the chunks
+
+    @property
+    def remaining(self) -> int:
+        return len(self.req.prompt) - self.done
 
 
 class ContinuousServer:
@@ -215,24 +291,42 @@ class ContinuousServer:
         out = srv.run()            # {a: [tokens...], b: [tokens...]}
 
     One jitted step decodes every live slot at its own position;
-    finished slots retire and queued requests admit between steps
-    (prompt prefilled as one window forward on a b=1 cache, K/V rows
-    spliced into the slot). Dead slots compute masked no-op work
-    (static shapes). PER-REQUEST decoding mode: greedy by default, or
-    submit(..., temperature=t, key=k) to sample — the key folds follow
-    generate()'s exactly (fold position, then row 0), so a sampled
-    request emits the SAME tokens it would get from a solo
-    generate(temperature=t, key=k) run. top_k truncation is not wired
-    (it is a static shape choice; bucket by top_k if needed). Programs
-    are memoized per (cfg, slots, smax) and per prompt length (bucket
-    prompts in production)."""
+    finished slots retire and queued requests admit between steps.
+    Prompts prefill on a b=1 scratch cache in BUCKETED fixed-width
+    chunks (pad-then-mask; widths from the ``hpx.serving.
+    prefill_buckets`` ladder), then a 1-token probe of the last prompt
+    position yields the seeding logits and the whole scratch splices
+    into the slot — so the program cache holds O(buckets) prefill
+    programs regardless of the prompt-length mix. A prompt whose
+    remaining tokens exceed ``hpx.serving.prefill_chunk`` becomes a
+    PENDING prefill: it advances one chunk per step interleaved with
+    live decode (shortest-remaining-first across pendings), so admits
+    never stall the running batch. Dead slots compute masked no-op
+    work (static shapes).
+
+    With ``hpx.serving.async_dispatch`` (default on) the step loop
+    keeps the sampled-token feedback on device and defers the
+    device->host read until a token value is needed (eos check or a
+    retirement) or ``hpx.serving.max_async_steps`` steps are buffered;
+    results and retirement timing are unchanged — only the forced
+    per-step sync goes away.
+
+    PER-REQUEST decoding mode: greedy by default, or submit(...,
+    temperature=t, key=k) to sample — the key folds follow generate()'s
+    exactly (fold position, then row 0), so a sampled request emits the
+    SAME tokens it would get from a solo generate(temperature=t, key=k)
+    run. top_k truncation is not wired (it is a static shape choice;
+    bucket by top_k if needed)."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int = 4,
                  smax: int = 512, mesh=None, paged: bool = False,
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  radix_budget_blocks: Optional[int] = None,
-                 prefix_reuse: Optional[bool] = None):
+                 prefix_reuse: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_buckets: Optional[str] = None,
+                 async_dispatch: Optional[bool] = None):
         self.cfg = cfg
         self.slots = slots
         self.smax = smax
@@ -267,6 +361,24 @@ class ContinuousServer:
         self.params = params
         self._cache_sh = cache_sh
 
+        from ..core.config import runtime_config
+        rc = runtime_config()
+        if prefill_chunk is None:
+            prefill_chunk = rc.get_int("hpx.serving.prefill_chunk",
+                                       _PREFILL_CHUNK)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        if prefill_buckets is None:
+            prefill_buckets = rc.get("hpx.serving.prefill_buckets",
+                                     "auto")
+        self.prefill_buckets = _resolve_buckets(prefill_buckets,
+                                                self.prefill_chunk)
+        if async_dispatch is None:
+            async_dispatch = rc.get_bool("hpx.serving.async_dispatch",
+                                         True)
+        self._async = bool(async_dispatch)
+        self._max_async = max(1, rc.get_int(
+            "hpx.serving.max_async_steps", 32))
+
         if self.paged:
             self._init_paged(block_size, num_blocks,
                              radix_budget_blocks, prefix_reuse)
@@ -295,6 +407,20 @@ class ContinuousServer:
         self._queue: deque = deque()
         self._done: Dict[int, List[int]] = {}
         self._next_rid = 0
+        # chunked-prefill state: slot -> in-flight pending
+        self._pending: Dict[int, _PendingPrefill] = {}
+        self._pf_seq = 0
+        # async-dispatch state: buffered (nxt, [(slot, req)]) steps
+        # plus device-resident mirrors of the per-slot host vectors
+        self._buf: deque = deque()
+        self._cur_dev = None            # [slots] int32 token feedback
+        self._temp_dev = None           # [slots] f32 (with _keys_dev)
+        self._keys_dev = None
+        # observability
+        self._chunks = 0                # prefill chunk dispatches
+        self._prog_hits = 0             # program-cache hits
+        self._prog_misses = 0           # program-cache misses (compiles)
+        self.ttft: Dict[int, float] = {}  # rid -> submit->seed seconds
         from ..cache.counters import register_server
         self.counter_instance = register_server(self)
 
@@ -350,10 +476,23 @@ class ContinuousServer:
         self._pools = [(pzeros(), pzeros())
                        for _ in range(cfg.n_layers)]
         self._tables: List[Optional[PageTable]] = [None] * slots
+        self._tables_sig = None     # (uid, version) per slot
+        self._tables_arr = None     # cached device [slots, maxb] map
         self._prefill_saved = 0
         self._prefill_computed = 0
 
     # -- jitted pieces (memoized on the baked constants) ----------------
+
+    def _program(self, ck, build):
+        """All program lookups go through here so the compile-cache
+        hit/miss counters see every build (the /serving programs/*
+        counters; the compile-count guard test reads them too)."""
+        from .transformer import _PROGRAMS
+        if ck in _PROGRAMS:
+            self._prog_hits += 1
+        else:
+            self._prog_misses += 1
+        return _cached_program(ck, build)
 
     def _step_prog(self):
         cfg, slots, smax = self.cfg, self.slots, self.smax
@@ -380,30 +519,51 @@ class ContinuousServer:
                 nxt = jax.vmap(pick)(logits, keys, temp, pos)
                 return caches, nxt
             return jax.jit(step, donate_argnums=(1,))
-        return _cached_program(ck, build)
+        return self._program(ck, build)
 
-    def _prefill_prog(self, plen: int):
+    def _chunk_prog(self, width: int):
+        """One bucketed prefill chunk: toks [1, width] (tail-padded
+        with token 0) written into the b=1 scratch at absolute
+        positions pos0..pos0+width-1. Keyed per LADDER WIDTH, not per
+        prompt length — the whole point. Pad rows land past the real
+        frontier; they are never attended (causal mask) and the next
+        chunk or the decode steps overwrite them before their
+        positions ever go live."""
         cfg, smax = self.cfg, self.smax
-        ck = ("cb_prefill", cfg, plen, smax, self.mesh,
+        ck = ("cb_chunk", cfg, width, smax, self.mesh,
               _tree_key(self.params))
 
         def build():
-            def prefill(params, prompt):
-                nkv, hd = cfg.kv_heads, cfg.head_dim
-                fresh = [
-                    (jnp.zeros((1, smax, nkv, hd), cfg.dtype),
-                     jnp.zeros((1, smax, nkv, hd), cfg.dtype))
-                    for _ in range(cfg.n_layers)]
-                # THE shared chunked prefill (same code path as
-                # generate/beam/speculative): 128-token windows,
-                # unembedding only on the last chunk
-                return _prefill_window(params, cfg, fresh, prompt)
-            return jax.jit(prefill)
-        return _cached_program(ck, build)
+            def chunk(params, caches, toks, pos0):
+                caches, _ = _decode_window(params, caches, toks, pos0,
+                                           cfg, need_logits=False)
+                return caches
+            return jax.jit(chunk, donate_argnums=(1,))
+        return self._program(ck, build)
 
-    def _splice_prog(self, plen: int):
+    def _probe_prog(self):
+        """Seed-logits probe: rerun the LAST prompt token at its own
+        position (an idempotent K/V rewrite — same bytes) and return
+        its logits. One program serves every prompt length, so the
+        chunk programs never need a logits variant per bucket."""
+        cfg, smax = self.cfg, self.smax
+        ck = ("cb_probe", cfg, smax, self.mesh, _tree_key(self.params))
+
+        def build():
+            def probe(params, caches, tok, pos):
+                caches, lg = _decode_window(params, caches, tok, pos,
+                                            cfg, need_logits=True)
+                return caches, lg[:, -1]
+            return jax.jit(probe, donate_argnums=(1,))
+        return self._program(ck, build)
+
+    def _splice_prog(self):
+        """Copy the b=1 scratch cache into one slot's rows — ALL smax
+        rows, so one program serves every prompt length (the garbage
+        rows past plen are exactly what the slot held before: never
+        read until decode overwrites them)."""
         slots, smax = self.slots, self.smax
-        ck = ("cb_splice", self.cfg, plen, slots, smax, self.mesh,
+        ck = ("cb_splice", self.cfg, slots, smax, self.mesh,
               _tree_key(self.params))
 
         def build():
@@ -417,15 +577,13 @@ class ContinuousServer:
                 out = []
                 for (kc, vc), (k1, v1) in zip(caches, one):
                     kc = jax.lax.dynamic_update_slice(
-                        kc, k1[:, :plen].astype(kc.dtype),
-                        (slot, 0, 0, 0))
+                        kc, k1.astype(kc.dtype), (slot, 0, 0, 0))
                     vc = jax.lax.dynamic_update_slice(
-                        vc, v1[:, :plen].astype(vc.dtype),
-                        (slot, 0, 0, 0))
+                        vc, v1.astype(vc.dtype), (slot, 0, 0, 0))
                     out.append((kc, vc))
                 return out
             return jax.jit(splice, donate_argnums=(0,))
-        return _cached_program(ck, build)
+        return self._program(ck, build)
 
     # -- paged programs (models live in pools; tables map positions) -----
 
@@ -448,72 +606,55 @@ class ContinuousServer:
 
                 nxt = jax.vmap(pick)(logits, keys, temp, pos)
                 return pools, nxt
-            return jax.jit(step, donate_argnums=(1,))
-        return _cached_program(ck, build)
+            return self._jit_step(step)
+        return self._program(ck, build)
 
-    def _paged_prefill_prog(self, slen: int, plen: int):
-        """Suffix prefill: gather the slot's (possibly prefix-matched)
-        blocks into a contiguous b=1 scratch cache, then run ONLY the
-        last `slen` prompt tokens through windowed forwards at their
-        absolute positions — the prefix-reuse saving. slen == plen is
-        the no-match case (and bitwise the dense prefill: the garbage
-        scratch rows beyond the write frontier are causally masked to
-        exact-zero weight, like the dense path's zeros)."""
-        cfg, smax = self.cfg, self.smax
+    def _jit_step(self, step):
+        return jax.jit(step, donate_argnums=(1,))
+
+    def _paged_gather_prog(self):
+        """Materialize one request's (possibly prefix-matched) blocks
+        into a contiguous b=1 scratch cache the shared chunk/probe
+        programs run over. Keyed once per server shape."""
+        cfg = self.cfg
         nb, bs = self._alloc.num_blocks, self.block_size
-        ck = ("pg_prefill", cfg, slen, plen, smax, nb, bs,
+        ck = ("pg_gather", cfg, self.smax, nb, bs,
               _tree_key(self.params))
 
         def build():
-            matched = plen - slen
+            def gather(pools, trow):
+                return [(gather_block_kv(kp, trow[None]),
+                         gather_block_kv(vp, trow[None]))
+                        for kp, vp in pools]
+            return jax.jit(gather)
+        return self._program(ck, build)
 
-            def prefill(params, pools, trow, suffix):
-                caches = [(gather_block_kv(kp, trow[None]),
-                           gather_block_kv(vp, trow[None]))
-                          for kp, vp in pools]
-                # windows on the ABSOLUTE chunk grid, so long-prompt
-                # suffix chunking lines up with a from-zero prefill
-                last = None
-                s = matched
-                while s < plen:
-                    e = min(plen,
-                            (s // _PREFILL_CHUNK + 1) * _PREFILL_CHUNK)
-                    caches, lg = _decode_window(
-                        params, caches,
-                        suffix[:, s - matched:e - matched], s, cfg,
-                        need_logits=e == plen)
-                    if lg is not None:
-                        last = lg
-                    s = e
-                return caches, last[:, -1]
-            return jax.jit(prefill)
-        return _cached_program(ck, build)
-
-    def _paged_splice_prog(self, slen: int, plen: int):
-        """Write the freshly prefilled suffix rows from the b=1
-        scratch cache into the request's newly allocated pool blocks
-        (whole-block scatter; the shared prefix blocks are untouched)."""
-        cfg, smax = self.cfg, self.smax
+    def _paged_splice_prog(self):
+        """Write the request's WHOLE padded block row back from the
+        b=1 scratch (chunked-prefill splice). One program for every
+        (matched, plen) combination: re-writing the matched prefix
+        blocks is an identity copy of the bytes the gather read (no
+        other writer can touch them meanwhile — decode COW-guards
+        shared blocks, and concurrent pendings write identical gathered
+        bytes), and the trash-padded tail is garbage-on-garbage (see
+        scatter_seq_blocks)."""
+        cfg = self.cfg
         nb, bs = self._alloc.num_blocks, self.block_size
-        ck = ("pg_splice", cfg, slen, plen, smax, nb, bs,
+        maxb = self._maxb
+        ck = ("pg_splice", cfg, self.smax, nb, bs,
               _tree_key(self.params))
 
         def build():
-            from ..ops.paged_attention import scatter_blocks
-            matched = plen - slen
-            nsuf = -(-slen // bs)      # suffix blocks (matched % bs == 0)
-            lo, hi = matched, matched + nsuf * bs
-
-            def splice(pools, one, bids):
+            def splice(pools, one, trow):
                 out = []
                 for (kp, vp), (kc, vc) in zip(pools, one):
-                    kseg = kc[0, lo:hi].reshape(nsuf, bs, *kc.shape[2:])
-                    vseg = vc[0, lo:hi].reshape(nsuf, bs, *vc.shape[2:])
-                    out.append((scatter_blocks(kp, bids, kseg),
-                                scatter_blocks(vp, bids, vseg)))
+                    kseg = kc[0].reshape(maxb, bs, *kc.shape[2:])
+                    vseg = vc[0].reshape(maxb, bs, *vc.shape[2:])
+                    out.append((scatter_seq_blocks(kp, trow, kseg),
+                                scatter_seq_blocks(vp, trow, vseg)))
                 return out
             return jax.jit(splice, donate_argnums=(0,))
-        return _cached_program(ck, build)
+        return self._program(ck, build)
 
     def _copy_block_prog(self):
         """Device side of allocator copy-on-write: duplicate one
@@ -528,7 +669,7 @@ class ContinuousServer:
                          vp.at[dst].set(vp[src]))
                         for kp, vp in pools]
             return jax.jit(copy, donate_argnums=(0,))
-        return _cached_program(ck, build)
+        return self._program(ck, build)
 
     # -- paged host-side bookkeeping -------------------------------------
 
@@ -559,42 +700,20 @@ class ContinuousServer:
             if copied:
                 self._pools = self._copy_block_prog()(
                     self._pools, jnp.int32(bid), jnp.int32(new))
-                pt.blocks[pos // self.block_size] = new
+                pt.replace_block(pos // self.block_size, new)
 
-    def _admit_paged(self, req: "_Request"):
-        """Paged admission: longest-cached-prefix lookup, fresh blocks
-        for the suffix, suffix-only prefill, splice into the pool.
-        Returns the last prompt position's logits [1, V]."""
-        plen = len(req.prompt)
-        matched, mbids = (0, [])
-        if self._prefix_reuse:
-            # always leave >= 1 suffix token: admission needs the LAST
-            # prompt token's logits to seed generation
-            matched, mbids = self._radix.match(req.prompt[:-1])
-        pt = PageTable(self.block_size)
-        pt.blocks.extend(mbids)
-        try:
-            while pt.capacity < plen:
-                pt.append_block(self._alloc_block())
-        except CacheOOM:
-            for bid in pt.blocks:
-                self._alloc.decref(bid)
-            raise
-        pt.tokens = plen
-        slen = plen - matched
-        with tracing.span("serving.prefill", "serving", rid=req.rid,
-                          plen=plen, matched=matched, suffix=slen):
-            trow = jnp.asarray(pt.as_row(self._maxb, self._trash))
-            suffix = jnp.asarray([req.prompt[matched:]], jnp.int32)
-            one, last_logits = self._paged_prefill_prog(slen, plen)(
-                self.params, self._pools, trow, suffix)
-            sbids = jnp.asarray(pt.blocks[matched // self.block_size:],
-                                jnp.int32)
-            self._pools = self._paged_splice_prog(slen, plen)(
-                self._pools, one, sbids)
-        self._prefill_saved += matched
-        self._prefill_computed += slen
-        return pt, last_logits
+    def _tables_dev(self):
+        """The [slots, maxb] int32 device map for one decode step,
+        rebuilt ONLY when some table mutated (PageTable.version) or a
+        slot's table was swapped — steady-state decode re-uploads
+        nothing."""
+        sig = tuple((pt.uid, pt.version) if pt is not None else None
+                    for pt in self._tables)
+        if sig != self._tables_sig or self._tables_arr is None:
+            self._tables_arr = jnp.asarray(materialize(
+                self._tables, self._maxb, self._trash))
+            self._tables_sig = sig
+        return self._tables_arr
 
     def _release_slot(self, slot: int, req: "_Request") -> None:
         """Paged retire: publish the request's FULL prompt blocks into
@@ -652,14 +771,133 @@ class ContinuousServer:
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(rid, prompt, max_new, eos_id,
-                                    temperature, key))
+                                    temperature, key,
+                                    t_submit=time.monotonic()))
         return rid
 
+    # -- chunked prefill -------------------------------------------------
+
+    def _bucket_width(self, n: int) -> int:
+        """Smallest ladder width covering n chunk tokens."""
+        for w in self.prefill_buckets:
+            if w >= n:
+                return w
+        return self.prefill_buckets[-1]
+
+    def _start_prefill(self, req: "_Request",
+                       slot: int) -> _PendingPrefill:
+        """Reserve `slot` and stand up the b=1 scratch cache (paged:
+        match the radix prefix, hold blocks for the whole prompt, and
+        gather them into the scratch)."""
+        self._pf_seq += 1
+        if self.paged:
+            p = self._start_paged(req, slot)
+        else:
+            nkv, hd = self.cfg.kv_heads, self.cfg.head_dim
+
+            def z():
+                return jnp.zeros((1, self.smax, nkv, hd),
+                                 self.cfg.dtype)
+            scratch = [(z(), z()) for _ in range(self.cfg.n_layers)]
+            p = _PendingPrefill(req=req, slot=slot, caches=scratch,
+                                done=0, seq=self._pf_seq)
+        self._pending[slot] = p
+        return p
+
+    def _start_paged(self, req: "_Request",
+                     slot: int) -> _PendingPrefill:
+        plen = len(req.prompt)
+        matched, mbids = 0, []
+        if self._prefix_reuse:
+            # always leave >= 1 suffix token: admission needs the LAST
+            # prompt token's logits to seed generation
+            matched, mbids = self._radix.match(req.prompt[:-1])
+        pt = PageTable(self.block_size)
+        pt.extend_blocks(mbids)
+        try:
+            while pt.capacity < plen:
+                pt.append_block(self._alloc_block())
+        except CacheOOM:
+            for bid in pt.blocks:
+                self._alloc.decref(bid)
+            raise
+        pt.tokens = plen
+        self._prefill_saved += matched
+        self._prefill_computed += plen - matched
+        trow = jnp.asarray(pt.as_row(self._maxb, self._trash))
+        caches = self._paged_gather_prog()(self._pools, trow)
+        return _PendingPrefill(req=req, slot=slot, caches=caches,
+                               done=matched, seq=self._pf_seq, pt=pt,
+                               trow=trow)
+
+    def _advance_chunk(self, p: _PendingPrefill) -> None:
+        """Run ONE bucketed chunk of p's prompt into its scratch."""
+        req, plen = p.req, len(p.req.prompt)
+        n = min(self.prefill_chunk, plen - p.done)
+        width = self._bucket_width(n)
+        toks = req.prompt[p.done:p.done + n] + [0] * (width - n)
+        with tracing.span("serving.prefill_chunk", "serving",
+                          rid=req.rid, pos0=p.done, tokens=n,
+                          width=width):
+            if p.flow is not None:
+                tracing.flow_end(p.flow, "serving.prefill_chunks")
+                p.flow = None
+            p.caches = self._chunk_prog(width)(
+                self.params, p.caches, jnp.asarray([toks], jnp.int32),
+                jnp.asarray(p.done, jnp.int32))
+            p.done += n
+            self._chunks += 1
+            if p.done < plen:
+                p.flow = tracing.flow_begin("serving.prefill_chunks")
+
+    def _finish_prefill(self, p: _PendingPrefill) -> None:
+        """Prompt fully chunked: probe the last position's logits,
+        splice the scratch into the slot (dense rows / paged blocks),
+        seed the first generated token, go live."""
+        req, slot = p.req, p.slot
+        plen = len(req.prompt)
+        tok = jnp.asarray([[req.prompt[-1]]], jnp.int32)
+        caches, logits = self._probe_prog()(
+            self.params, p.caches, tok,
+            jnp.asarray(plen - 1, jnp.int32))
+        if p.flow is not None:
+            tracing.flow_end(p.flow, "serving.prefill_chunks")
+            p.flow = None
+        if self.paged:
+            self._pools = self._paged_splice_prog()(
+                self._pools, caches, p.trow)
+            self._tables[slot] = p.pt
+        else:
+            self._caches = self._splice_prog()(
+                self._caches, caches, jnp.asarray(slot, jnp.int32))
+        del self._pending[slot]
+        if req.temperature > 0.0:
+            # generate()'s tok0 draw: position plen-1, row 0
+            tok0 = int(_sample_row(logits[0], req.temperature,
+                                   req.key, plen - 1, 0))
+        else:
+            tok0 = int(jnp.argmax(logits[0]))
+        req.tokens.append(tok0)
+        req.sent = 1
+        self._slot_req[slot] = req
+        self._pos[slot] = plen
+        self._cur[slot] = tok0
+        if self._cur_dev is not None:
+            self._cur_dev = self._cur_dev.at[slot].set(tok0)
+        self._temp[slot] = req.temperature
+        self._key[slot] = (req.key if req.key is not None
+                           else jax.random.PRNGKey(0))
+        self._temp_dev = None          # rebuilt with keys next step
+        self.ttft[req.rid] = time.monotonic() - req.t_submit
+        self._maybe_retire(slot)
+
     def _admit(self) -> None:
-        """Fill free slots from the queue: prefill the prompt on a b=1
-        cache (one window forward; paged mode prefills only past the
-        longest cached prefix), splice its K/V rows into the slot (or
-        pool blocks), seed the slot's first generated token.
+        """Fill free slots from the queue. A prompt whose remaining
+        tokens fit one chunk prefills INLINE (admission latency = one
+        chunk + probe, and instant retires drain without decode
+        steps); a longer prompt reserves the slot as a PENDING prefill
+        and advances chunk-by-chunk in _prefill_tick, interleaved with
+        decode.
 
         A request that retires DURING admission (max_new == 1, or an
         instant eos) frees its slot immediately — the inner loop
@@ -667,38 +905,42 @@ class ContinuousServer:
         one-token requests drains through one slot without burning a
         full decode step per request on an empty batch."""
         for slot in range(self.slots):
-            while self._slot_req[slot] is None and self._queue:
+            while (self._slot_req[slot] is None
+                   and slot not in self._pending and self._queue):
                 req = self._queue.popleft()
                 plen = len(req.prompt)
                 with tracing.span("serving.admit", "serving",
                                   rid=req.rid, slot=slot, plen=plen):
-                    if self.paged:
-                        pt, last_logits = self._admit_paged(req)
-                        self._tables[slot] = pt
-                    else:
+                    p = self._start_prefill(req, slot)
+                    if p.remaining <= self.prefill_chunk:
                         with tracing.span("serving.prefill", "serving",
-                                          rid=req.rid, plen=plen):
-                            prompt = jnp.asarray([req.prompt],
-                                                 jnp.int32)
-                            one, last_logits = self._prefill_prog(
-                                plen)(self.params, prompt)
-                            self._caches = self._splice_prog(plen)(
-                                self._caches, one, jnp.int32(slot))
-                    if req.temperature > 0.0:
-                        # generate()'s tok0 draw: position plen-1, row 0
-                        tok0 = int(_sample_row(last_logits[0],
-                                               req.temperature,
-                                               req.key, plen - 1, 0))
+                                          rid=req.rid, plen=plen,
+                                          matched=p.done,
+                                          suffix=p.remaining):
+                            self._advance_chunk(p)
+                            self._finish_prefill(p)
                     else:
-                        tok0 = int(jnp.argmax(last_logits[0]))
-                    req.tokens.append(tok0)
-                    self._slot_req[slot] = req
-                    self._pos[slot] = plen
-                    self._cur[slot] = tok0
-                    self._temp[slot] = req.temperature
-                    self._key[slot] = (req.key if req.key is not None
-                                       else jax.random.PRNGKey(0))
-                    self._maybe_retire(slot)
+                        p.flow = tracing.flow_begin(
+                            "serving.prefill_chunks")
+
+    def _prefill_tick(self) -> None:
+        """Advance chunked prefills: ONE chunk per step, given to the
+        pending with the FEWEST remaining prompt tokens (ready-chunk
+        ordering — a short prompt admitted behind a long one overtakes
+        its tail chunks; FIFO breaks ties). The finishing pending
+        splices and goes live the same step."""
+        if not self._pending:
+            return
+        p = min(self._pending.values(),
+                key=lambda q: (q.remaining, q.seq))
+        self._advance_chunk(p)
+        if p.remaining == 0:
+            with tracing.span("serving.prefill", "serving",
+                              rid=p.req.rid, plen=len(p.req.prompt),
+                              chunked=True):
+                self._finish_prefill(p)
+
+    # -- retirement ------------------------------------------------------
 
     def _maybe_retire(self, slot: int) -> None:
         req = self._slot_req[slot]
@@ -707,59 +949,107 @@ class ContinuousServer:
         hit_eos = (req.eos_id is not None
                    and req.tokens[-1] == req.eos_id)
         if len(req.tokens) >= req.max_new or hit_eos:
-            if hit_eos:
-                # generate() keeps emitting pinned eos to max_new; the
-                # slot retires early and pads the same tail
-                req.tokens = req.tokens + [req.eos_id] * (
-                    req.max_new - len(req.tokens))
-            with tracing.span("serving.retire", "serving",
-                              rid=req.rid, slot=slot,
-                              tokens=len(req.tokens), eos=hit_eos):
-                self._done[req.rid] = req.tokens
+            self._finalize(slot, req, hit_eos)
+
+    def _finalize(self, slot: int, req: "_Request",
+                  hit_eos: bool) -> None:
+        """Retire one request: pad the eos tail exactly like
+        generate()'s pinning, publish to _done, free the slot if it
+        still holds this request (async max_new retires free it at
+        dispatch time, before the token values arrive)."""
+        if req.rid in self._done:
+            return
+        if hit_eos:
+            # generate() keeps emitting pinned eos to max_new; the
+            # slot retires early and pads the same tail
+            req.tokens = req.tokens + [req.eos_id] * (
+                req.max_new - len(req.tokens))
+        with tracing.span("serving.retire", "serving",
+                          rid=req.rid, slot=slot,
+                          tokens=len(req.tokens), eos=hit_eos):
+            self._done[req.rid] = req.tokens
+            if self._slot_req[slot] is req:
                 self._slot_req[slot] = None
                 if self.paged:
                     self._release_slot(slot, req)
 
+    def _flush(self) -> None:
+        """Materialize every buffered step's token vector and replay
+        the per-slot bookkeeping in dispatch order — the ONLY
+        device->host read in the decode loop."""
+        while self._buf:
+            nxt, lanes = self._buf.popleft()
+            vals = np.asarray(nxt)
+            for s, req in lanes:
+                t = int(vals[s])
+                req.tokens.append(t)
+                self._cur[s] = t
+                hit_eos = (req.eos_id is not None
+                           and t == req.eos_id)
+                if hit_eos or len(req.tokens) >= req.max_new:
+                    self._finalize(s, req, hit_eos)
+
     def step(self) -> bool:
-        """Admit + one decode step for every live slot. Returns True
-        while any work remains (live slots or queued requests)."""
+        """Admit + one prefill chunk + one decode step for every live
+        slot. Returns True while any work remains (live slots, pending
+        prefills, or queued requests)."""
         self._admit()
+        self._prefill_tick()
         live = [s for s in range(self.slots)
                 if self._slot_req[s] is not None]
         if not live:
-            return bool(self._queue)
+            self._flush()
+            return bool(self._queue or self._pending)
         with tracing.span("serving.decode", "serving",
                           live=len(live),
                           rids=[self._slot_req[s].rid for s in live]):
-            tok = jnp.asarray(self._cur, jnp.int32)
             # dense: dead slots re-write their own last position
             # (harmless: never read — admission overwrites rows
             # 0..plen first). Paged: dead slots' tables are all-trash,
             # so their writes land in the reserved trash block instead
-            # of a recycled live block.
+            # of a recycled live block. Dead slots' feedback tokens
+            # are stale argmax/sample outputs — always valid ids.
+            tok = (jnp.asarray(self._cur, jnp.int32)
+                   if self._cur_dev is None else self._cur_dev)
             pos = jnp.asarray(self._pos, jnp.int32)
-            temp = jnp.asarray(self._temp, jnp.float32)
-            keys = jnp.stack(self._key)
+            if self._temp_dev is None:
+                self._temp_dev = jnp.asarray(self._temp, jnp.float32)
+                self._keys_dev = jnp.stack(self._key)
             if self.paged:
                 for s in live:
                     self._ensure_block(s, self._pos[s])
-                tables = jnp.asarray(materialize(
-                    self._tables, self._maxb, self._trash))
                 self._pools, nxt = self._paged_step_prog()(
-                    self.params, self._pools, tok, pos, tables, temp,
-                    keys)
+                    self.params, self._pools, tok, pos,
+                    self._tables_dev(), self._temp_dev, self._keys_dev)
             else:
                 self._caches, nxt = self._step_prog()(
-                    self.params, self._caches, tok, pos, temp, keys)
-            nxt_host = np.asarray(nxt).tolist()  # ONE device->host read
+                    self.params, self._caches, tok, pos,
+                    self._temp_dev, self._keys_dev)
+            self._cur_dev = nxt
             self._rate.mark(float(len(live)))
+            lanes = []
+            need_sync = not self._async
             for s in live:
                 req = self._slot_req[s]
                 assert req is not None
-                req.tokens.append(nxt_host[s])
+                lanes.append((s, req))
                 self._pos[s] += 1
-                self._cur[s] = nxt_host[s]
-                self._maybe_retire(s)
+                req.sent += 1
+                if req.eos_id is not None:
+                    # the eos check needs this step's VALUE before the
+                    # next dispatch — retire timing must not drift
+                    need_sync = True
+                elif req.sent >= req.max_new:
+                    # bookkeeping retire at dispatch: the slot frees
+                    # NOW (admissible next step); token values land at
+                    # the flush this triggers
+                    self._slot_req[s] = None
+                    if self.paged:
+                        self._release_slot(s, req)
+                    need_sync = True
+            self._buf.append((nxt, lanes))
+            if need_sync or len(self._buf) >= self._max_async:
+                self._flush()
         return True
 
     def run(self) -> Dict[int, List[int]]:

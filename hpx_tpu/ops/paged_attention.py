@@ -34,6 +34,7 @@ __all__ = [
     "gather_block_kv",
     "paged_decode_attention",
     "scatter_blocks",
+    "scatter_seq_blocks",
     "scatter_token",
 ]
 
@@ -70,6 +71,22 @@ def scatter_blocks(pool: jax.Array, bids: jax.Array,
     """Bulk-write whole blocks (prefill splice): bids [n] int32, rows
     [n, block_size, n_kv, head_dim]."""
     return pool.at[bids].set(rows.astype(pool.dtype))
+
+
+def scatter_seq_blocks(pool: jax.Array, table_row: jax.Array,
+                       rows: jax.Array) -> jax.Array:
+    """Write ONE sequence's whole padded block row back into the pool
+    (the chunked-prefill splice): table_row [max_blocks] int32 as
+    produced by `PageTable.as_row`, rows [max_blocks, block_size,
+    n_kv, head_dim] from its contiguous b=1 scratch cache.
+
+    The row's tail entries are the server's trash-block pad, so the
+    scatter carries DUPLICATE indices there; which garbage write wins
+    is unspecified and irrelevant — trash rows are only ever gathered
+    under an exact-zero mask. Real block ids are unique within a row
+    (the allocator hands each out once), so live blocks get exactly
+    their own scratch rows."""
+    return pool.at[table_row].set(rows.astype(pool.dtype))
 
 
 def paged_decode_attention(q: jax.Array, k_new: jax.Array,

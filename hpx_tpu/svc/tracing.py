@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "Tracer", "TaskCtx", "active_tracer", "start_tracing",
     "stop_tracing", "start_if_configured", "trace", "span", "instant",
-    "current_span_id",
+    "current_span_id", "flow_begin", "flow_end",
 ]
 
 # Ring entries are flat 8-tuples — the cheapest thing CPython can
@@ -226,6 +226,29 @@ class Tracer:
     def current_span_id(self) -> Optional[int]:
         st = self._stack()
         return st[-1] if st else None
+
+    def flow_begin(self, name: str, cat: str = "flow") -> Optional[int]:
+        """Emit the source half of a flow arrow anchored at the
+        current slice; returns the flow id for :meth:`flow_end`.
+        Returns None outside any span (no slice to anchor to) — the
+        export janitor would drop a danging arrow anyway."""
+        st = self._stack()
+        if not st:
+            return None
+        fid = next(self._ids)
+        self._record(("s", name, cat, time.perf_counter(), self._tid(),
+                      fid, None, None))
+        return fid
+
+    def flow_end(self, fid: Optional[int], name: str,
+                 cat: str = "flow") -> None:
+        """Bind the arrow head of flow `fid` to the current slice.
+        No-op for fid None (flow_begin outside a span) — callers can
+        thread the id through unconditionally."""
+        if fid is None or not self._stack():
+            return
+        self._record(("f", name, cat, time.perf_counter(), self._tid(),
+                      fid, None, None))
 
     # -- causal capture (submit side) -----------------------------------
 
@@ -470,3 +493,19 @@ def instant(name: str, cat: str = "user", **args: Any) -> None:
     tr = _active
     if tr is not None:
         tr.instant(name, cat, **args)
+
+
+def flow_begin(name: str, cat: str = "flow") -> Optional[int]:
+    """Module-level flow-arrow tail: links the current slice to a later
+    one across steps/threads (serving uses it to tie an admit span to
+    the chunked-prefill spans it scheduled). None when tracing is off
+    or no span is live; feed the result to :func:`flow_end` as-is."""
+    tr = _active
+    return tr.flow_begin(name, cat) if tr is not None else None
+
+
+def flow_end(fid: Optional[int], name: str, cat: str = "flow") -> None:
+    """Module-level flow-arrow head; no-op when off or fid is None."""
+    tr = _active
+    if tr is not None:
+        tr.flow_end(fid, name, cat)

@@ -1,0 +1,74 @@
+"""Count XLA backend compiles via jax.monitoring.
+
+The serving program-cache work (bucketed prefill) is ultimately about
+COMPILES, not dict hits — so tests and benchmarks measure the real
+thing: jax emits a ``/jax/core/compile/backend_compile_duration``
+event for every backend compilation, and `count_compiles` tallies
+them over a region.
+
+One process-wide listener is registered on first use and never
+removed (jax.monitoring has no unregister API); it fans out to a
+stack of active counters, so nested regions each see their own
+tally. Note the event fires for EVERY backend compile in the
+process — including first-touch eager ops and other threads — so
+assertions over a region should either warm unrelated paths first or
+allow a small constant slack.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, List
+
+__all__ = ["count_compiles"]
+
+_lock = threading.Lock()
+_installed = False
+_active: List["_Tally"] = []
+
+
+class _Tally:
+    """Mutable compile counter handed to the caller; reads as int."""
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    def __int__(self) -> int:
+        return self.count
+
+    def __repr__(self) -> str:
+        return f"_Tally(count={self.count})"
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    if "backend_compile" not in event:
+        return
+    with _lock:
+        for t in _active:
+            t.count += 1
+
+
+def _install() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    import jax
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+
+
+@contextlib.contextmanager
+def count_compiles() -> Iterator[_Tally]:
+    """``with count_compiles() as c: ...; int(c)`` — backend compiles
+    that happened inside the region (process-wide)."""
+    _install()
+    tally = _Tally()
+    with _lock:
+        _active.append(tally)
+    try:
+        yield tally
+    finally:
+        with _lock:
+            _active.remove(tally)

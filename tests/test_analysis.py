@@ -308,6 +308,71 @@ def test_hpx007_kept_result_is_silent():
 
 
 # ---------------------------------------------------------------------------
+# HPX008 — program cache keyed on a raw dynamic length
+# ---------------------------------------------------------------------------
+
+HPX008_BAD = """\
+from hpx_tpu.models.transformer import _cached_program
+def prefill(params, prompt, cfg):
+    plen = len(prompt)
+    ck = ("prefill", cfg, plen)
+    return _cached_program(ck, lambda: None)
+"""
+
+HPX008_GOOD = """\
+from hpx_tpu.models.transformer import _cached_program
+def prefill(params, prompt, cfg, buckets):
+    width = next(w for w in buckets if w >= len(prompt))
+    ck = ("prefill", cfg, width)
+    return _cached_program(ck, lambda: None)
+"""
+
+
+def test_hpx008_len_keyed_cache_fires():
+    fs = findings(HPX008_BAD)
+    assert rules_of(fs) == ["HPX008"]
+    assert "'plen'" in fs[0].message
+
+
+def test_hpx008_bucketed_key_is_silent():
+    assert findings(HPX008_GOOD) == []
+
+
+def test_hpx008_shape_unpack_and_inline_tuple():
+    # `b, n = x.shape` taints both names; the key tuple may also be
+    # passed inline and carry a bare `.shape` read
+    src = ("from hpx_tpu.core.programs import cached_program\n"
+           "P = {}\n"
+           "def run(x, cfg):\n"
+           "    b, n = x.shape\n"
+           "    return cached_program(P, (cfg, n, x.shape),\n"
+           "                          lambda: None)\n")
+    fs = findings(src)
+    assert rules_of(fs) == ["HPX008", "HPX008"]
+
+
+def test_hpx008_two_call_sites_report_once():
+    # one key construction feeding mesh/no-mesh branches is ONE finding
+    src = ("from hpx_tpu.models.transformer import _cached_program\n"
+           "def gen(params, prompt, cfg, mesh):\n"
+           "    plen = len(prompt)\n"
+           "    ck = ('gen', cfg, plen)\n"
+           "    if mesh is None:\n"
+           "        return _cached_program(ck, lambda: None)\n"
+           "    return _cached_program(ck, lambda: None)\n")
+    assert rules_of(findings(src)) == ["HPX008"]
+
+
+def test_hpx008_static_key_is_silent():
+    src = ("from hpx_tpu.core.programs import cached_program\n"
+           "P = {}\n"
+           "def run(v, mesh, axis):\n"
+           "    return cached_program(P, ('sort', mesh, axis),\n"
+           "                          lambda: None)\n")
+    assert findings(src) == []
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, syntax errors, baseline
 # ---------------------------------------------------------------------------
 
@@ -402,8 +467,8 @@ def test_finding_format():
 
 def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
-    assert ids == ["HPX001", "HPX002", "HPX003",
-                   "HPX004", "HPX005", "HPX006", "HPX007"]
+    assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
+                   "HPX005", "HPX006", "HPX007", "HPX008"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,72 @@
+"""Compile-count guard for the serving hot path.
+
+The bucketed-prefill contract measured at the REAL boundary: jax's
+``/jax/core/compile/backend_compile_duration`` monitoring event fires
+per XLA backend compilation, so these tests pin the number of
+compiles a mixed-length serving workload may trigger.  The bound is
+O(buckets) + a constant (step/probe/splice programs plus first-touch
+eager ops) — NOT O(distinct prompt lengths): pre-bucketing, 12
+distinct lengths meant 12 prefill + 12 splice programs.
+
+A dedicated config (d_ff=48) keeps these counts isolated from other
+test modules warming the shared program cache in the same process."""
+
+import jax
+import numpy as np
+import pytest
+
+from hpx_tpu.models import transformer as tfm
+from hpx_tpu.models.serving import ContinuousServer
+from hpx_tpu.utils.compilemon import count_compiles
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=2, d_ff=48)
+
+PLENS = [3, 5, 9, 12, 17, 23, 4, 8, 16, 21, 6, 14]   # 12 mixed lengths
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(1))
+
+
+def _workload(srv, plens, seed):
+    r = np.random.RandomState(seed)
+    for plen in plens:
+        srv.submit([int(t) for t in r.randint(1, CFG.vocab, plen)],
+                   max_new=5)
+    return srv.run()
+
+
+def test_mixed_length_workload_compiles_o_buckets(params):
+    with count_compiles() as c:
+        srv = ContinuousServer(params, CFG, slots=4, smax=64,
+                               prefill_chunk=8, prefill_buckets="4,8")
+        out = _workload(srv, PLENS, seed=0)
+    assert len(out) == len(PLENS)
+    buckets = len(srv.prefill_buckets)
+    # program builds: one chunk program per bucket + probe + splice +
+    # step, NOT one per prompt length
+    assert srv._prog_misses <= buckets + 3
+    # total backend compiles: program builds plus a constant floor of
+    # first-touch eager ops (argmax/sampling/zeros); 12 per-length
+    # prefill+splice programs would blow far past this
+    assert int(c) <= buckets + 22
+
+
+def test_new_lengths_reuse_everything(params, recwarn):
+    # warm wave (may share compiles with the test above when it ran
+    # first — irrelevant, we only pin the SECOND wave)
+    srv = ContinuousServer(params, CFG, slots=4, smax=64,
+                           prefill_chunk=8, prefill_buckets="4,8")
+    _workload(srv, PLENS, seed=1)
+    # fresh server, prompt lengths NOT seen above: zero new programs,
+    # and (modulo jax-internal noise) zero backend compiles
+    with count_compiles() as c:
+        srv2 = ContinuousServer(params, CFG, slots=4, smax=64,
+                                prefill_chunk=8, prefill_buckets="4,8")
+        out = _workload(srv2, [7, 11, 19, 22], seed=2)
+    assert len(out) == 4
+    assert srv2._prog_misses == 0
+    assert srv2._prog_hits > 0
+    assert int(c) <= 2
