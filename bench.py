@@ -189,11 +189,43 @@ def _load_fallback(skip=()):
     return out
 
 
-def _emit_fallback(skip=()) -> bool:
-    lines = _load_fallback(skip)
+def _emit_fallback(skip=(), lines=None) -> bool:
+    if lines is None:
+        lines = _load_fallback(skip)
     for line in lines:
         print(json.dumps(line), flush=True)
     return bool(lines)
+
+
+def _fallback_age(lines=None):
+    """How stale the builder-session medians being re-emitted are:
+    oldest/newest per-line `measured_at` stamp plus the worst-case age
+    in hours. A reader of a `bench_unavailable` record must be able to
+    tell 2-hour-old numbers from 2-week-old ones without opening the
+    fallback file. Lines with no usable stamp are skipped; an empty or
+    stampless record reports unknown."""
+    import datetime
+    if lines is None:
+        lines = _load_fallback()
+    stamps = sorted(ln["measured_at"] for ln in lines
+                    if ln.get("measured_at", "unknown") != "unknown")
+    if not stamps:
+        return {"fallback_measured_at": "unknown",
+                "fallback_age_hours": -1}
+    out = {"fallback_measured_at": stamps[0]}
+    if stamps[-1] != stamps[0]:
+        # assembled across runs: report the span, age from the oldest
+        out["fallback_measured_at_newest"] = stamps[-1]
+    try:
+        oldest = datetime.datetime.fromisoformat(stamps[0])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if oldest.tzinfo is None:
+            oldest = oldest.replace(tzinfo=datetime.timezone.utc)
+        out["fallback_age_hours"] = round(
+            (now - oldest).total_seconds() / 3600, 1)
+    except ValueError:
+        out["fallback_age_hours"] = -1
+    return out
 
 
 def bench_triad(jax, jnp):
@@ -693,14 +725,19 @@ def main() -> None:
         sys.exit(2)
 
     if not _probe_device():
-        print(json.dumps({
+        fb_lines = _load_fallback()
+        rec = {
             "metric": "bench_unavailable", "value": 0, "unit": "none",
             "vs_baseline": 0,
             "error": "device tunnel unresponsive (jax.devices() probe "
                      "retried with backoff for ~20 min in bounded "
                      "subprocesses); re-emitting most recent "
-                     "builder-session medians below"}), flush=True)
-        if _emit_fallback():
+                     "builder-session medians below"}
+        # stamp how stale the re-emitted medians are, so the record
+        # carries its own trust signal
+        rec.update(_fallback_age(fb_lines))
+        print(json.dumps(rec), flush=True)
+        if _emit_fallback(lines=fb_lines):
             sys.exit(0)        # labeled fallback data is still data
         sys.exit(1)
 

@@ -17,6 +17,15 @@ host; CPU for smoke runs with --cpu):
                            long system prompt); reports radix cache hit
                            rate and the fraction of prefill tokens the
                            prefix cache eliminated
+  5. serving_spec        — the speculation wave: the SAME mixed
+                           repetitive + non-repetitive request mix
+                           through a spec-off and a spec-on server
+                           (prompt-lookup drafts, per-slot adaptive k);
+                           reports acceptance rate, tokens per decode
+                           step, warm tokens/s for both runs, and the
+                           sha256 of every request's output — the
+                           hashes MUST match, speculation only changes
+                           how fast identical tokens appear
 
 Prints one JSON line per engine. This is an operator harness, not part
 of bench.py's driver metrics — serving throughput depends on the
@@ -28,7 +37,7 @@ spans, flow arrows, /serving + /cache counter tracks — is written to
 PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
 
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
-                                          [--prefix-only]
+                                          [--prefix-only] [--spec-only]
                                           [--trace-out PATH]
 """
 
@@ -114,6 +123,52 @@ def main() -> int:
              prefill_tokens_computed=computed,
              prefill_saved_frac=round(saved / (saved + computed), 3))
 
+    # 5. the speculation wave: half the mix is repetitive (periodic
+    # prompts whose continuations prompt-lookup nails), half is random
+    # (drafts mostly rejected — the floor case). Byte-identity is
+    # CHECKED here, not assumed: both servers' outputs are hashed.
+    def spec_wave_bench():
+        import hashlib
+        rep = [(([11, 23, 7, 42] * 12)[:40], 48) for _ in range(4)]
+        rnd = [(rng.integers(1, 1000, 24).tolist(),
+                int(rng.integers(24, 49))) for _ in range(4)]
+        sreqs = rep + rnd
+        stotal = sum(m for _, m in sreqs)
+
+        def run_wave(spec):
+            srv = ContinuousServer(params, cfg, slots=4, smax=128,
+                                   spec=spec, spec_k=4)
+            for p, m in sreqs:
+                srv.submit(p, max_new=m)
+            srv.run()                                  # compile
+            srv = ContinuousServer(params, cfg, slots=4, smax=128,
+                                   spec=spec, spec_k=4)
+            for p, m in sreqs:
+                srv.submit(p, max_new=m)
+            t0 = time.perf_counter()
+            out = srv.run()
+            secs = time.perf_counter() - t0
+            sha = hashlib.sha256(json.dumps(
+                [out[r] for r in sorted(out)]).encode()).hexdigest()
+            return srv, secs, sha
+
+        base_srv, base_secs, base_sha = run_wave(False)
+        srv, secs, sha = run_wave(True)
+        st = srv.spec_stats()
+        emit("serving_spec", stotal, secs,
+             mix="4 periodic + 4 random reqs new24-48 over 4 slots",
+             draft="prompt", spec_k=4,
+             acceptance_rate=round(st["acceptance_rate"], 3),
+             tokens_per_step=round(st["tokens_per_step"], 2),
+             baseline_tokens_per_s=round(stotal / base_secs, 1),
+             output_sha=sha[:16],
+             output_identical=(sha == base_sha))
+        if sha != base_sha:
+            print(json.dumps({"error": "spec output diverged",
+                              "baseline_sha": base_sha[:16],
+                              "spec_sha": sha[:16]}), flush=True)
+            raise SystemExit(2)
+
     def finish() -> int:
         if tracer is not None:
             from hpx_tpu.svc import tracing
@@ -128,6 +183,10 @@ def main() -> int:
 
     if "--prefix-only" in sys.argv:
         paged_prefix_bench()
+        return finish()
+
+    if "--spec-only" in sys.argv:
+        spec_wave_bench()
         return finish()
 
     # 1. uniform batched greedy
@@ -201,6 +260,7 @@ def main() -> int:
                  1e3 * float(np.percentile(stalls, 99)), 2))
 
     mixed_length_bench()
+    spec_wave_bench()
 
     # 3. speculative greedy (single stream: the latency case)
     sp = jnp.asarray(rng.integers(1, 1000, (1, plen)), jnp.int32)

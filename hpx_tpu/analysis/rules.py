@@ -536,3 +536,70 @@ class UnbucketedProgramKeyRule(Rule):
                         "ladder and pad-then-mask (serving's "
                         "hpx.serving.prefill_buckets discipline), or "
                         "baseline it with a justification")
+
+
+# serving hot-loop functions whose device values must stay on device
+# (HPX009's scope): the decode/speculation dispatch path in
+# models/serving.py.  Admission/prefill code syncs legitimately (seed
+# tokens need VALUES); these functions run once per decode step.
+_SERVING_HOT_FUNCS = ("step", "run", "_flush", "_spec_step",
+                      "_draft_model_tokens", "_prompt_drafts")
+
+
+@register
+class SpecHostSyncRule(Rule):
+    """HPX009: host-device synchronization (``np.asarray`` /
+    ``jax.device_get`` / ``.item()``) on draft/verify intermediates
+    inside the serving hot loop (``models/serving.py``'s step, flush
+    and speculation functions).
+
+    The decode loop owes exactly ONE device->host read per step — the
+    speculative path's packed targets+acceptance commit, or the
+    non-speculative path's flush of buffered token vectors.  Syncing
+    any other draft/verify intermediate (draft token columns, verify
+    logits, acceptance counts read one at a time) serializes draft,
+    verify and dispatch and turns the one-sync-per-window win back
+    into one-sync-per-token.  The designed sync points stay in the
+    baseline with a justification; anything new this rule flags is a
+    regression.
+    """
+
+    id = "HPX009"
+    name = "serving-hot-loop-host-sync"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_subpath("hpx_tpu/models/serving"):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name not in _SERVING_HOT_FUNCS:
+                continue
+            for node in _walk_function(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = ctx.resolve_call(node.func)
+                if dotted == "numpy.asarray":
+                    yield self.finding(
+                        ctx, node,
+                        f"np.asarray() in serving hot-loop "
+                        f"{fn.name}() syncs the device — the decode "
+                        "loop owes ONE host read per step; keep "
+                        "draft/verify intermediates on device and "
+                        "commit through the step's single packed read")
+                elif dotted == "jax.device_get":
+                    yield self.finding(
+                        ctx, node,
+                        f"jax.device_get() in serving hot-loop "
+                        f"{fn.name}() syncs the device — commit "
+                        "through the step's single packed read")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    yield self.finding(
+                        ctx, node,
+                        f".item() in serving hot-loop {fn.name}() "
+                        "materializes a device scalar per call — pack "
+                        "scalars into the step's single device->host "
+                        "read instead")

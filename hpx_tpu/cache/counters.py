@@ -18,6 +18,17 @@ Every server gets the serving counters::
     /serving{locality#L/server#i}/programs/cache-hits    program-cache hits
     /serving{locality#L/server#i}/programs/cache-misses  program builds (compiles)
 
+Speculative servers (``hpx.serving.spec.enable``) add::
+
+    /serving{locality#L/server#i}/spec/drafted          draft tokens proposed
+    /serving{locality#L/server#i}/spec/accepted         draft tokens accepted
+    /serving{locality#L/server#i}/spec/acceptance-rate  accepted / drafted
+    /serving{locality#L/server#i}/spec/tokens-per-step  emitted / spec steps
+
+(the default ``hpx.trace.counters`` pattern ``/serving*`` matches
+these, so the Chrome-trace counter sampler picks up an
+acceptance-rate track with no extra config).
+
 Paged servers additionally export the cache counters::
 
     /cache{locality#L/server#i}/hit-rate                radix prefix hit rate
@@ -86,6 +97,20 @@ def register_server(srv) -> str:
         pc.CallbackCounter(_read(ref, lambda s: s._prog_hits)))
     put("serving", "programs/cache-misses",
         pc.CallbackCounter(_read(ref, lambda s: s._prog_misses)))
+
+    if getattr(srv, "_spec", False):
+        put("serving", "spec/drafted",
+            pc.CallbackCounter(_read(ref, lambda s: s._spec_drafted)))
+        put("serving", "spec/accepted",
+            pc.CallbackCounter(_read(ref, lambda s: s._spec_accepted)))
+        put("serving", "spec/acceptance-rate",
+            pc.CallbackCounter(_read(ref, lambda s: (
+                s._spec_accepted / s._spec_drafted
+                if s._spec_drafted else 0.0))))
+        put("serving", "spec/tokens-per-step",
+            pc.CallbackCounter(_read(ref, lambda s: (
+                s._spec_emitted / s._spec_steps
+                if s._spec_steps else 0.0))))
 
     if getattr(srv, "paged", False):
         put("cache", "hit-rate",

@@ -1,0 +1,43 @@
+"""Zero-model prompt-lookup drafting: n-gram continuation mining.
+
+The draft source that needs no second checkpoint (the "prompt lookup
+decoding" trick): if the last n tokens of a slot's history (prompt +
+everything generated so far) occurred earlier in that same history,
+propose the tokens that followed the earlier occurrence as the draft.
+Summarization, code editing, and any workload with self-repetition
+accept these drafts at high rates; on non-repetitive text the proposal
+is simply rejected by the verify pass — correctness never depends on
+draft quality, only throughput does.
+
+Pure host-side integer matching — O(n * len(history)) per call with
+tiny constants, negligible next to a decode step — and deterministic:
+longest n first, most recent earlier occurrence first, so replays
+draft identically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["propose"]
+
+
+def propose(history: Sequence[int], k: int, max_n: int = 3) -> List[int]:
+    """Up to `k` draft tokens continuing `history`, or [] if no suffix
+    n-gram (n = max_n down to 1) recurs earlier in the history. The
+    continuation may be shorter than `k` when the match sits near the
+    end; matches that overlap the suffix itself are allowed — that is
+    exactly what makes periodic output (the high-acceptance case)
+    match."""
+    length = len(history)
+    if k <= 0 or length < 2:
+        return []
+    for n in range(min(max_n, length - 1), 0, -1):
+        suffix = tuple(int(t) for t in history[length - n:])
+        for i in range(length - n - 1, -1, -1):
+            if tuple(int(t) for t in history[i:i + n]) != suffix:
+                continue
+            cont = history[i + n:i + n + k]
+            if cont:
+                return [int(t) for t in cont]
+    return []

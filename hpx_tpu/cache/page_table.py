@@ -60,6 +60,25 @@ class PageTable:
         self.blocks[idx] = bid
         self.version += 1
 
+    def rollback(self, tokens: int) -> List[int]:
+        """Rewind the logical frontier to `tokens` rows and return the
+        block ids no longer needed to cover it (caller owns the
+        decrefs). This is how speculative rejection stays cheap: draft
+        rows past the accepted frontier are simply abandoned — the
+        physical rows still hold stale K/V, but the decode mask only
+        exposes positions < `tokens`, and any block kept here has its
+        stale tail rewritten by the next write at that position before
+        it can ever be attended."""
+        if tokens < 0:
+            raise ValueError(f"cannot rollback to {tokens} tokens")
+        keep = self.blocks_for(tokens)
+        dropped = self.blocks[keep:]
+        if dropped:
+            del self.blocks[keep:]
+            self.version += 1
+        self.tokens = tokens
+        return dropped
+
     @property
     def capacity(self) -> int:
         return len(self.blocks) * self.block_size

@@ -117,6 +117,43 @@ class RadixCache:
                             requested=len(tokens), blocks=len(bids))
         return matched, bids
 
+    def peek(self, tokens: Sequence[int], k: int) -> List[int]:
+        """Read-only continuation probe for prompt-lookup drafting:
+        walk the longest cached whole-block prefix of `tokens`, then
+        follow the child chain whose keys continue the ragged tail and
+        return up to `k` of the tokens that FOLLOW `tokens` in the
+        tree. Unlike `match` this takes no allocator leases and does
+        not touch recency or hit-rate stats — the caller only wants
+        token VALUES to propose as a draft (the verify pass rejects
+        bad guesses anyway), not the blocks behind them. Ties between
+        sibling continuations go to the most recently used chain."""
+        if k <= 0:
+            return []
+        with self._lock:
+            node = self._root
+            consumed = 0
+            for chunk in self._chunks(tokens):
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                node = child
+                consumed += self.block_size
+            tail = tuple(int(t) for t in tokens[consumed:])
+            out: List[int] = []
+            while len(out) < k:
+                best: Optional[_Node] = None
+                for child in node.children.values():
+                    if child.key[:len(tail)] != tail:
+                        continue
+                    if best is None or child.last_used > best.last_used:
+                        best = child
+                if best is None:
+                    break
+                out.extend(best.key[len(tail):])
+                tail = ()
+                node = best
+            return [int(t) for t in out[:k]]
+
     def insert(self, tokens: Sequence[int],
                block_ids: Sequence[int]) -> int:
         """Publish a block chain for `tokens` (full blocks only; a

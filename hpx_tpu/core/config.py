@@ -61,6 +61,12 @@ DEFAULTS: Dict[str, str] = {
     "hpx.serving.prefill_buckets": "auto",  # chunk-width ladder (csv|auto)
     "hpx.serving.async_dispatch": "1",    # decode without per-step sync
     "hpx.serving.max_async_steps": "32",  # buffered steps before a sync
+    "hpx.serving.spec.enable": "0",       # speculative decode in serving
+    "hpx.serving.spec.k": "4",            # draft tokens per slot per step
+    "hpx.serving.spec.draft": "prompt",   # draft source: prompt | model
+    "hpx.serving.spec.ngram": "3",        # max n-gram for prompt lookup
+    "hpx.serving.spec.min_accept": "0.3", # adaptive-k backoff threshold
+    "hpx.serving.spec.adapt": "1",        # per-slot adaptive k on/off
     "hpx.trace.enabled": "0",             # svc/tracing off by default
     "hpx.trace.buffer_events": "65536",   # ring capacity (drop-oldest)
     "hpx.trace.counter_interval": "0.05", # s between counter samples

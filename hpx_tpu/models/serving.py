@@ -28,6 +28,17 @@ Three throughput disciplines shape the hot loop:
   device-side and only syncs to the host when a token VALUE is needed
   (eos check, retirement) or the ``hpx.serving.max_async_steps`` cap
   hits — host Python overlaps device execution.
+* SPECULATIVE decode steps (``hpx.serving.spec.*``): each step drafts
+  k tokens per slot — zero-model prompt-lookup over the slot's own
+  history (plus the radix prefix tree), or a smaller draft checkpoint
+  — and verifies the window with ONE forward, emitting 1..k+1 tokens
+  per sync instead of one. Acceptance compares drafts against the
+  EXACT token the sequential step would pick (same ``_pick_row``
+  key-fold contract), so spec output stays byte-identical, greedy and
+  sampled; the paged path rolls rejected window blocks back
+  (``PageTable.rollback``). Verify programs ride the prefill bucket
+  ladder — still O(buckets) programs — and k adapts per slot on an
+  acceptance EMA.
 
 Differential contract (the test): every request's tokens are EXACTLY
 what transformer.generate() emits for that prompt alone — continuous
@@ -55,12 +66,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..cache.block_allocator import BlockAllocator, CacheOOM
+from ..cache.ngram import propose as _ngram_propose
 from ..cache.page_table import PageTable, materialize
 from ..cache.radix import RadixCache
 from ..svc import tracing
 from ..ops.paged_attention import (
     gather_block_kv,
     paged_decode_attention,
+    paged_window_attention,
     scatter_seq_blocks,
 )
 from .transformer import (
@@ -70,6 +83,7 @@ from .transformer import (
     _decode_window,
     _dq,
     _ln,
+    _pick_row,
     _qkv_proj,
     _sample_row,
     _tree_key,
@@ -133,19 +147,27 @@ def _resolve_buckets(spec, chunk: int) -> Tuple[int, ...]:
     return tuple(sorted(set(vals)))
 
 
-def _rope_rows(x, pos, cfg: TransformerConfig):
-    """Rotate-half RoPE with PER-ROW positions: x [B, 1, N, H],
-    pos [B] int32 (transformer._rope takes one shared [S] vector)."""
+def _rope_win(x, posw, cfg: TransformerConfig):
+    """Rotate-half RoPE over a PER-ROW position GRID: x [B, W, N, H],
+    posw [B, W] int32 — each (row, window-column) pair rotates at its
+    own absolute position (transformer._rope takes one shared [S]
+    vector; `_rope_rows` is the W == 1 special case)."""
     hd = x.shape[-1]
     half = hd // 2
     freq = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32)
                               / half)
-    ang = pos.astype(jnp.float32)[:, None] * freq[None, :]  # [B, half]
-    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    ang = posw.astype(jnp.float32)[..., None] * freq  # [B, W, half]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x1 * sin + x2 * cos], axis=-1)
+
+
+def _rope_rows(x, pos, cfg: TransformerConfig):
+    """Rotate-half RoPE with PER-ROW positions: x [B, 1, N, H],
+    pos [B] int32."""
+    return _rope_win(x, pos[:, None], cfg)
 
 
 def _block_decode_rows(x, lp, kv, pos, cfg: TransformerConfig):
@@ -248,6 +270,145 @@ def _paged_decode_rows(params, pools, tok, table, pos, cfg):
     return new_pools, logits[:, 0, :].astype(jnp.float32)
 
 
+def _window_rows(x, lp, kv, pos0, cfg: TransformerConfig):
+    """One decoder block for a W-token VERIFY WINDOW per slot at
+    PER-SLOT positions: x [B, W, D]; slot b's window row i lands at
+    cache position pos0[b] + i and attends positions <= pos0[b] + i.
+
+    This is `_block_decode_rows` stretched to W columns — same
+    projections, same einsum contractions over the same smax rows,
+    same -inf mask and f32 softmax — so window column i's output is
+    byte-identical to what the i-th SEQUENTIAL step would compute
+    (K/V rows are functions of (token, position) alone, and column
+    i's horizon includes exactly the window rows < i it would have
+    already written). Window columns past smax-1 (a dead slot's stale
+    cursor, or batch-width padding beyond a short slot's budget)
+    scatter with ``mode="drop"``: clamping would corrupt row smax-1,
+    which can hold live K/V."""
+    kc, vc = kv
+    b, w = x.shape[0], x.shape[1]
+    h = _ln(x, lp["ln1"])
+    q, k, v = _qkv_proj(h, lp)
+    posw = pos0[:, None] + jnp.arange(w)[None, :]      # [B, W]
+    if cfg.rope:
+        q = _rope_win(q, posw, cfg)
+        k = _rope_win(k, posw, cfg)
+    rows = jnp.arange(b)[:, None]
+    kc = kc.at[rows, posw].set(k, mode="drop")
+    vc = vc.at[rows, posw].set(v, mode="drop")
+    nq, hd = q.shape[2], q.shape[3]
+    nkv = kc.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, w, nkv, g, hd)
+    s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
+    kpos = jnp.arange(kc.shape[1])
+    live = kpos[None, None, :] <= posw[:, :, None]     # [B, W, Smax]
+    s = jnp.where(live[:, None, None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+    att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, w, nq, hd)
+    o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
+    x = x + o
+    h = _ln(x, lp["ln2"])
+    if "moe" in lp:
+        from .moe import moe_ffn
+        from .transformer import _moe_cfg
+        d = h.shape[-1]
+        mcfg = dataclasses.replace(_moe_cfg(cfg),
+                                   capacity_factor=float(cfg.n_experts))
+        out, _aux = moe_ffn(h.reshape(b * w, d), lp["moe"], mcfg)
+        return x + out.reshape(b, w, d), (kc, vc)
+    h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
+    return x + h, (kc, vc)
+
+
+def _decode_window_rows(params, caches, toks, pos0, cfg):
+    """W tokens per slot through every block at per-slot positions
+    (the speculative-verify forward); toks [B, W] int32, pos0 [B]
+    int32. Returns (caches, f32 logits [B, W, V])."""
+    x = params["emb"][toks]
+    new_caches = []
+    for lp, kv in zip(params["layers"], caches):
+        x, kv = _window_rows(x, lp, kv, pos0, cfg)
+        new_caches.append(kv)
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+    return new_caches, logits.astype(jnp.float32)
+
+
+def _paged_window_rows(x, lp, pools, table, pos0,
+                       cfg: TransformerConfig):
+    """`_window_rows` over paged pools: the scatter/gather and the
+    per-query horizon live in `ops.paged_attention.
+    paged_window_attention`; projections/rope/ffn are byte-identical
+    to the dense window, which keeps paged == dense token-exact under
+    speculation too."""
+    kp, vp = pools
+    b, w = x.shape[0], x.shape[1]
+    h = _ln(x, lp["ln1"])
+    q, k, v = _qkv_proj(h, lp)
+    posw = pos0[:, None] + jnp.arange(w)[None, :]
+    if cfg.rope:
+        q = _rope_win(q, posw, cfg)
+        k = _rope_win(k, posw, cfg)
+    att, kp, vp = paged_window_attention(q, k, v, kp, vp, table, pos0)
+    o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
+    x = x + o
+    h = _ln(x, lp["ln2"])
+    if "moe" in lp:
+        from .moe import moe_ffn
+        from .transformer import _moe_cfg
+        d = h.shape[-1]
+        mcfg = dataclasses.replace(_moe_cfg(cfg),
+                                   capacity_factor=float(cfg.n_experts))
+        out, _aux = moe_ffn(h.reshape(b * w, d), lp["moe"], mcfg)
+        return x + out.reshape(b, w, d), (kp, vp)
+    h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
+    return x + h, (kp, vp)
+
+
+def _paged_decode_window_rows(params, pools, toks, table, pos0, cfg):
+    """W tokens per slot over paged pools; returns (pools, f32 logits
+    [B, W, V]) — the `_decode_window_rows` analog."""
+    x = params["emb"][toks]
+    new_pools = []
+    for lp, pl in zip(params["layers"], pools):
+        x, pl = _paged_window_rows(x, lp, pl, table, pos0, cfg)
+        new_pools.append(pl)
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
+    return new_pools, logits.astype(jnp.float32)
+
+
+def _verify_tail(logits, toks, kvec, temp, keys, pos0, width):
+    """Shared device-side tail of both verify programs: pick the
+    target token at every window position with the SAME `_pick_row`
+    the sequential step uses, then count the longest prefix of drafts
+    agreeing with them.
+
+    Window column i holds draft d_i (column 0 the committed cur
+    token); target t_i = pick(logits[i]) is the token the sequential
+    decode would emit after consuming column i. Draft d_i is accepted
+    iff d_i == t_{i-1} AND every earlier draft was (cumprod), capped
+    by the slot's real draft count kvec. The committed emission is
+    t_0..t_acc — acc+1 tokens, always >= 1 — so content NEVER depends
+    on the drafts, only on the targets the step program would have
+    produced (greedy argmax, or the deterministic (key, pos)
+    categorical draw: acceptance-rejection against a deterministic
+    sampler collapses to exact token match). Everything returns in ONE
+    packed [B, width+1] int32 array (targets ‖ acc) = one host read
+    per spec step."""
+    offs = jnp.arange(width)
+    tgt = jax.vmap(
+        lambda rows, key, t, p0: jax.vmap(
+            lambda row, p: _pick_row(row, key, t, p))(rows, p0 + offs)
+    )(logits, keys, temp, pos0)
+    match = jnp.logical_and(toks[:, 1:] == tgt[:, :-1],
+                            offs[None, 1:] <= kvec[:, None])
+    acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    return jnp.concatenate(
+        [tgt.astype(jnp.int32), acc[:, None]], axis=1)
+
+
 @dataclasses.dataclass
 class _Request:
     rid: int
@@ -316,7 +477,14 @@ class ContinuousServer:
     exactly (fold position, then row 0), so a sampled request emits the
     SAME tokens it would get from a solo generate(temperature=t, key=k)
     run. top_k truncation is not wired (it is a static shape choice;
-    bucket by top_k if needed)."""
+    bucket by top_k if needed).
+
+    ``spec=True`` turns each decode step speculative: per-slot drafts
+    (``spec_draft='prompt'`` mines the slot's token history;
+    ``'model'`` runs ``draft_params``/``draft_cfg``) are verified by
+    one window forward and committed only where they match the
+    sequential pick — same tokens, fewer host syncs per token. See
+    ``spec_stats()`` and the ``/serving{...}/spec/*`` counters."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int = 4,
                  smax: int = 512, mesh=None, paged: bool = False,
@@ -326,7 +494,12 @@ class ContinuousServer:
                  prefix_reuse: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
                  prefill_buckets: Optional[str] = None,
-                 async_dispatch: Optional[bool] = None):
+                 async_dispatch: Optional[bool] = None,
+                 spec: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 spec_draft: Optional[str] = None,
+                 draft_params=None,
+                 draft_cfg: Optional[TransformerConfig] = None):
         self.cfg = cfg
         self.slots = slots
         self.smax = smax
@@ -378,6 +551,80 @@ class ContinuousServer:
         self._async = bool(async_dispatch)
         self._max_async = max(1, rc.get_int(
             "hpx.serving.max_async_steps", 32))
+
+        # speculative decoding (hpx.serving.spec.*): draft k tokens
+        # per slot, verify the window in ONE forward. Spec steps sync
+        # every step (the packed targets+acceptance read) — they
+        # multiply tokens-per-host-sync instead of deferring the sync.
+        if spec is None:
+            spec = rc.get_bool("hpx.serving.spec.enable", False)
+        self._spec = bool(spec)
+        if spec_draft is None:
+            spec_draft = rc.get("hpx.serving.spec.draft", "prompt")
+            if draft_params is not None:
+                spec_draft = "model"  # a checkpoint implies the source
+        if spec_draft not in ("prompt", "model"):
+            raise ValueError(
+                "hpx.serving.spec.draft must be 'prompt' or 'model', "
+                f"got {spec_draft!r}")
+        self._spec_source = spec_draft
+        if spec_k is None:
+            spec_k = rc.get_int("hpx.serving.spec.k", 4)
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        # the verify window (k drafts + the current token) rides the
+        # prefill bucket ladder, so k is capped at the widest rung - 1
+        self._spec_k = min(int(spec_k), self.prefill_buckets[-1] - 1)
+        self._spec_ngram = max(1, rc.get_int(
+            "hpx.serving.spec.ngram", 3))
+        self._spec_min_accept = rc.get_float(
+            "hpx.serving.spec.min_accept", 0.3)
+        self._spec_adapt = rc.get_bool("hpx.serving.spec.adapt", True)
+        self._slot_k = [self._spec_k] * slots   # per-slot adaptive k
+        self._slot_acc = [1.0] * slots          # acceptance-rate EMA
+        self._spec_drafted = 0                  # /serving/spec/* feed
+        self._spec_accepted = 0
+        self._spec_steps = 0
+        self._spec_emitted = 0
+        self._draft_params = None
+        self._draft_cfg = None
+        self._draft_caches = None
+        if self._spec and self._spec_source == "model":
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "spec draft source 'model' needs draft_params and "
+                    "draft_cfg (or use spec_draft='prompt' for "
+                    "zero-model prompt-lookup drafting)")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab} != target vocab "
+                    f"{cfg.vocab}")
+            if mesh is not None:
+                # the draft shares the serving mesh: same placement
+                # contract, slots in the batch role
+                from .transformer import (_decode_mesh_check,
+                                          _decode_pspecs, _place)
+                try:
+                    _decode_mesh_check(draft_cfg, mesh, slots)
+                except ValueError as e:
+                    raise ValueError(
+                        "draft model cannot share the serving mesh: "
+                        + str(e).replace("batch", "slots")) from None
+                draft_params = _place(
+                    draft_params,
+                    _decode_pspecs(draft_params, draft_cfg), mesh)
+            self._draft_params = draft_params
+            self._draft_cfg = draft_cfg
+            dn, dh = draft_cfg.kv_heads, draft_cfg.head_dim
+
+            def dzeros():
+                if cache_sh is not None:
+                    return jnp.zeros((slots, smax, dn, dh),
+                                     draft_cfg.dtype, device=cache_sh)
+                return jnp.zeros((slots, smax, dn, dh),
+                                 draft_cfg.dtype)
+            self._draft_caches = [(dzeros(), dzeros())
+                                  for _ in range(draft_cfg.n_layers)]
 
         if self.paged:
             self._init_paged(block_size, num_blocks,
@@ -509,14 +756,7 @@ class ContinuousServer:
                             c, cache_sh), caches)
                 caches, logits = _decode_rows(params, caches, tok, pos,
                                               cfg)
-
-                def pick(row, key, t, p):
-                    greedy = jnp.argmax(row)
-                    sampled = _sample_row(row, jnp.maximum(t, 1e-6),
-                                          key, p, 0)
-                    return jnp.where(t > 0, sampled, greedy)
-
-                nxt = jax.vmap(pick)(logits, keys, temp, pos)
+                nxt = jax.vmap(_pick_row)(logits, keys, temp, pos)
                 return caches, nxt
             return jax.jit(step, donate_argnums=(1,))
         return self._program(ck, build)
@@ -597,14 +837,7 @@ class ContinuousServer:
             def step(params, pools, tok, pos, tables, temp, keys):
                 pools, logits = _paged_decode_rows(params, pools, tok,
                                                    tables, pos, cfg)
-
-                def pick(row, key, t, p):
-                    greedy = jnp.argmax(row)
-                    sampled = _sample_row(row, jnp.maximum(t, 1e-6),
-                                          key, p, 0)
-                    return jnp.where(t > 0, sampled, greedy)
-
-                nxt = jax.vmap(pick)(logits, keys, temp, pos)
+                nxt = jax.vmap(_pick_row)(logits, keys, temp, pos)
                 return pools, nxt
             return self._jit_step(step)
         return self._program(ck, build)
@@ -671,6 +904,92 @@ class ContinuousServer:
             return jax.jit(copy, donate_argnums=(0,))
         return self._program(ck, build)
 
+    # -- speculative programs (verify windows + draft model) -------------
+
+    def _verify_prog(self, width: int):
+        """Dense window-verify: ONE forward over a width-W window at
+        per-slot positions, returning packed targets+acceptance. Keyed
+        per LADDER WIDTH (same ladder as the prefill chunks), so the
+        program cache stays O(buckets) however adaptive k wanders."""
+        cfg, slots, smax = self.cfg, self.slots, self.smax
+        ck = ("cb_verify", cfg, slots, smax, width, self.mesh,
+              _tree_key(self.params))
+
+        def build():
+            cache_sh = self._cache_sh
+
+            def verify(params, caches, toks, pos0, kvec, temp, keys):
+                if cache_sh is not None:
+                    caches = jax.tree.map(
+                        lambda c: jax.lax.with_sharding_constraint(
+                            c, cache_sh), caches)
+                caches, logits = _decode_window_rows(
+                    params, caches, toks, pos0, cfg)
+                return caches, _verify_tail(
+                    logits, toks, kvec, temp, keys, pos0, width)
+            return jax.jit(verify, donate_argnums=(1,))
+        return self._program(ck, build)
+
+    def _paged_verify_prog(self, width: int):
+        cfg, slots, smax = self.cfg, self.slots, self.smax
+        nb, bs = self._alloc.num_blocks, self.block_size
+        ck = ("pg_verify", cfg, slots, smax, width, nb, bs,
+              _tree_key(self.params))
+
+        def build():
+            def verify(params, pools, toks, pos0, tables, kvec, temp,
+                       keys):
+                pools, logits = _paged_decode_window_rows(
+                    params, pools, toks, tables, pos0, cfg)
+                return pools, _verify_tail(
+                    logits, toks, kvec, temp, keys, pos0, width)
+            return jax.jit(verify, donate_argnums=(1,))
+        return self._program(ck, build)
+
+    def _draft_step_prog(self):
+        """One greedy draft-model step at per-slot positions. The
+        draft ALWAYS proposes greedily — draft quality moves only the
+        acceptance rate, never the emitted tokens."""
+        dcfg, slots, smax = self._draft_cfg, self.slots, self.smax
+        ck = ("cb_draft", dcfg, slots, smax,
+              _tree_key(self._draft_params))
+
+        def build():
+            def step(params, caches, tok, pos):
+                caches, logits = _decode_rows(params, caches, tok, pos,
+                                              dcfg)
+                return caches, jnp.argmax(logits, axis=-1) \
+                                  .astype(jnp.int32)
+            return jax.jit(step, donate_argnums=(1,))
+        return self._program(ck, build)
+
+    def _draft_chunk_prog(self, width: int):
+        """One bucketed prefill chunk for ONE slot of the draft-model
+        cache: slice the slot's b=1 rows, run the shared window
+        forward, write them back. Same ladder widths as the target's
+        chunks — O(buckets) draft programs."""
+        dcfg, smax = self._draft_cfg, self.smax
+        ck = ("cb_dchunk", dcfg, width, smax, self.slots,
+              _tree_key(self._draft_params))
+
+        def build():
+            def chunk(params, caches, toks, pos0, slot):
+                one = [(jax.lax.dynamic_slice_in_dim(kc, slot, 1, 0),
+                        jax.lax.dynamic_slice_in_dim(vc, slot, 1, 0))
+                       for kc, vc in caches]
+                one, _ = _decode_window(params, one, toks, pos0, dcfg,
+                                        need_logits=False)
+                out = []
+                for (kc, vc), (k1, v1) in zip(caches, one):
+                    kc = jax.lax.dynamic_update_slice(
+                        kc, k1.astype(kc.dtype), (slot, 0, 0, 0))
+                    vc = jax.lax.dynamic_update_slice(
+                        vc, v1.astype(vc.dtype), (slot, 0, 0, 0))
+                    out.append((kc, vc))
+                return out
+            return jax.jit(chunk, donate_argnums=(1,))
+        return self._program(ck, build)
+
     # -- paged host-side bookkeeping -------------------------------------
 
     def _alloc_block(self) -> int:
@@ -684,6 +1003,17 @@ class ContinuousServer:
                 raise
             return self._alloc.alloc()
 
+    def _cow_guard(self, pt: PageTable, bi: int) -> None:
+        """Make the block backing logical block `bi` exclusively ours
+        before writing into it (copy-on-write fork + device copy)."""
+        bid = pt.blocks[bi]
+        if self._alloc.refcount(bid) > 1:
+            new, copied = self._alloc.fork(bid)
+            if copied:
+                self._pools = self._copy_block_prog()(
+                    self._pools, jnp.int32(bid), jnp.int32(new))
+                pt.replace_block(bi, new)
+
     def _ensure_block(self, slot: int, pos: int) -> None:
         """Before a decode write at `pos`: extend the slot's table to
         cover it, and make the target block exclusively ours (COW
@@ -694,13 +1024,23 @@ class ContinuousServer:
         assert pt is not None
         while pt.capacity <= pos:
             pt.append_block(self._alloc_block())
-        bid = pt.block_of(pos)
-        if self._alloc.refcount(bid) > 1:
-            new, copied = self._alloc.fork(bid)
-            if copied:
-                self._pools = self._copy_block_prog()(
-                    self._pools, jnp.int32(bid), jnp.int32(new))
-                pt.replace_block(pos // self.block_size, new)
+        self._cow_guard(pt, pos // self.block_size)
+
+    def _ensure_window(self, slot: int, pos0: int, last: int) -> None:
+        """`_ensure_block` generalized to a speculative verify window:
+        cover every write position in [pos0, last] and COW-guard each
+        covered block — draft rows must never land in a radix-shared
+        block. Window pad columns past `last` need no coverage: the
+        table row pads with the trash block, so their scatters land in
+        rows nothing ever reads."""
+        last = min(last, self.smax - 1)
+        pt = self._tables[slot]
+        assert pt is not None
+        while pt.capacity <= last:
+            pt.append_block(self._alloc_block())
+        for bi in range(pos0 // self.block_size,
+                        last // self.block_size + 1):
+            self._cow_guard(pt, bi)
 
     def _tables_dev(self):
         """The [slots, maxb] int32 device map for one decode step,
@@ -743,6 +1083,21 @@ class ContinuousServer:
         st["prefill_tokens_saved"] = self._prefill_saved
         st["prefill_tokens_computed"] = self._prefill_computed
         return st
+
+    def spec_stats(self) -> Dict[str, float]:
+        """Speculation observability snapshot (the same numbers the
+        /serving{...}/spec/* performance counters export)."""
+        drafted, steps = self._spec_drafted, self._spec_steps
+        return {
+            "drafted": float(drafted),
+            "accepted": float(self._spec_accepted),
+            "acceptance_rate": (self._spec_accepted / drafted)
+                               if drafted else 0.0,
+            "steps": float(steps),
+            "emitted": float(self._spec_emitted),
+            "tokens_per_step": (self._spec_emitted / steps)
+                               if steps else 0.0,
+        }
 
     # -- public API ------------------------------------------------------
 
@@ -888,6 +1243,11 @@ class ContinuousServer:
         self._key[slot] = (req.key if req.key is not None
                            else jax.random.PRNGKey(0))
         self._temp_dev = None          # rebuilt with keys next step
+        if self._spec:
+            self._slot_k[slot] = self._spec_k     # fresh adaptive k
+            self._slot_acc[slot] = 1.0
+            if self._draft_params is not None:
+                self._draft_prefill(slot, req.prompt)
         self.ttft[req.rid] = time.monotonic() - req.t_submit
         self._maybe_retire(slot)
 
@@ -939,6 +1299,180 @@ class ContinuousServer:
                               rid=p.req.rid, plen=len(p.req.prompt),
                               chunked=True):
                 self._finish_prefill(p)
+
+    # -- speculative decode ----------------------------------------------
+
+    def _draft_prefill(self, slot: int, prompt: List[int]) -> None:
+        """Build the draft model's K/V rows 0..plen-1 for a freshly
+        admitted slot: bucketed chunks over the whole prompt (same
+        ladder as the target's prefill, so draft chunk programs are
+        O(buckets) too)."""
+        done, plen = 0, len(prompt)
+        while done < plen:
+            n = min(self.prefill_chunk, plen - done)
+            width = self._bucket_width(n)
+            toks = prompt[done:done + n] + [0] * (width - n)
+            self._draft_caches = self._draft_chunk_prog(width)(
+                self._draft_params, self._draft_caches,
+                jnp.asarray([toks], jnp.int32),
+                jnp.asarray(done, jnp.int32),
+                jnp.asarray(slot, jnp.int32))
+            done += n
+
+    def _prompt_drafts(self, live: List[int],
+                       kcap: Dict[int, int]) -> Dict[int, List[int]]:
+        """Zero-model draft proposals per live slot: n-gram
+        continuation mining over the slot's own history (prompt +
+        generated so far), falling back to the radix tree's cached
+        continuations when the history has no recurring suffix (paged
+        mode with prefix reuse keeps whole retired prompts around —
+        `RadixCache.peek` reads them without taking leases)."""
+        drafts: Dict[int, List[int]] = {}
+        for s in live:
+            req = self._slot_req[s]
+            k = kcap[s]
+            hist = req.prompt + req.tokens
+            d = _ngram_propose(hist, k, self._spec_ngram) if k else []
+            if not d and k and self.paged and self._prefix_reuse:
+                d = self._radix.peek(hist, k)
+            drafts[s] = d[:k]
+        return drafts
+
+    def _draft_model_tokens(self, kbatch: int):
+        """kbatch+1 chained greedy draft-model steps, entirely
+        device-side. The extra (kbatch+1)-th feed lands the LAST draft
+        token's K/V rows so the next round's draft attention never
+        reads a never-written position (speculative_generate's KV-hole
+        discipline); its proposal is discarded. Positions clamp at
+        smax-1 for lanes whose window runs past the budget — those
+        rows are rewritten by the real feed at that position before
+        the causal mask can ever expose them. Returns [slots,
+        1 + kbatch] int32 (column 0 = the committed cur tokens)."""
+        prog = self._draft_step_prog()
+        tok = jnp.asarray(self._cur, jnp.int32)
+        pos = jnp.asarray(self._pos, jnp.int32)
+        cols = [tok]
+        for i in range(kbatch + 1):
+            self._draft_caches, tok = prog(
+                self._draft_params, self._draft_caches, tok,
+                jnp.minimum(pos + i, self.smax - 1))
+            if i < kbatch:
+                cols.append(tok)
+        return jnp.stack(cols, axis=1)
+
+    def _spec_adapt_k(self, slot: int, accepted: int,
+                      drafted: int) -> None:
+        """Per-slot adaptive k: EMA the acceptance rate; back off when
+        it sinks below hpx.serving.spec.min_accept (wasted draft+verify
+        work), creep back toward the configured k when acceptance runs
+        high. The EMA resets on change so one adjustment gets a fresh
+        measurement window before the next."""
+        if not drafted or not self._spec_adapt:
+            return
+        ema = 0.5 * self._slot_acc[slot] + 0.5 * (accepted / drafted)
+        self._slot_acc[slot] = ema
+        if ema < self._spec_min_accept and self._slot_k[slot] > 1:
+            self._slot_k[slot] -= 1
+            self._slot_acc[slot] = 1.0
+        elif ema > 0.8 and self._slot_k[slot] < self._spec_k:
+            self._slot_k[slot] += 1
+            self._slot_acc[slot] = 1.0
+
+    def _spec_step(self, live: List[int]) -> None:
+        """One speculative decode step: draft up to k tokens per live
+        slot, verify the whole batch with ONE window forward at
+        per-slot positions, commit the longest target-agreeing prefix
+        plus the bonus target token. Content is byte-identical to the
+        sequential step loop (see `_verify_tail`); only the number of
+        tokens per host sync changes. Rejection is cheap by
+        construction: dense scratch rows past the committed frontier
+        are dead under the causal mask, and paged tables just rewind
+        their cursor (`PageTable.rollback`) and drop window-extension
+        blocks."""
+        self._flush()              # spec commits synchronously
+        kcap: Dict[int, int] = {}
+        for s in live:
+            req = self._slot_req[s]
+            remaining = req.max_new - len(req.tokens)
+            kcap[s] = max(0, min(self._slot_k[s], remaining - 1))
+        kbatch = max(kcap.values())
+        width = self._bucket_width(1 + kbatch)
+        kvec_host = [0] * self.slots
+        f_draft = tracing.flow_begin("serving.spec")
+        with tracing.span("serving.spec.draft", "serving",
+                          source=self._spec_source, k=kbatch,
+                          slots=len(live)):
+            tracing.flow_end(f_draft, "serving.spec.draft")
+            f_verify = tracing.flow_begin("serving.spec")
+            if self._draft_params is not None:
+                toks = self._draft_model_tokens(kbatch)
+                if width > 1 + kbatch:
+                    toks = jnp.pad(toks,
+                                   ((0, 0), (0, width - 1 - kbatch)))
+                for s in live:
+                    kvec_host[s] = kcap[s]
+            else:
+                mat = np.zeros((self.slots, width), np.int32)
+                mat[:, 0] = self._cur
+                for s, d in self._prompt_drafts(live, kcap).items():
+                    mat[s, 1:1 + len(d)] = d
+                    kvec_host[s] = len(d)
+                toks = jnp.asarray(mat)
+        drafted = sum(kvec_host[s] for s in live)
+        with tracing.span("serving.spec.verify", "serving",
+                          width=width, drafted=drafted,
+                          slots=len(live)):
+            tracing.flow_end(f_verify, "serving.spec.verify")
+            pos = jnp.asarray(self._pos, jnp.int32)
+            kvec = jnp.asarray(kvec_host, jnp.int32)
+            if self._temp_dev is None:
+                self._temp_dev = jnp.asarray(self._temp, jnp.float32)
+                self._keys_dev = jnp.stack(self._key)
+            if self.paged:
+                for s in live:
+                    self._ensure_window(s, self._pos[s],
+                                        self._pos[s] + kvec_host[s])
+                self._pools, packed = self._paged_verify_prog(width)(
+                    self.params, self._pools, toks, pos,
+                    self._tables_dev(), kvec, self._temp_dev,
+                    self._keys_dev)
+            else:
+                self._caches, packed = self._verify_prog(width)(
+                    self.params, self._caches, toks, pos, kvec,
+                    self._temp_dev, self._keys_dev)
+            # the speculative step's single designed host sync: one
+            # packed [slots, width+1] read carries every slot's target
+            # tokens AND acceptance count together
+            vals = np.asarray(packed)
+        emitted_total = 0
+        for s in live:
+            req = self._slot_req[s]
+            acc = int(vals[s, width])
+            m = min(acc + 1, req.max_new - len(req.tokens))
+            emis = [int(t) for t in vals[s, :m]]
+            if req.eos_id is not None and req.eos_id in emis:
+                emis = emis[:emis.index(req.eos_id) + 1]
+            req.tokens.extend(emis)
+            req.sent = len(req.tokens)
+            self._pos[s] += len(emis)
+            self._cur[s] = emis[-1]
+            emitted_total += len(emis)
+            self._spec_drafted += kvec_host[s]
+            self._spec_accepted += min(acc, kvec_host[s])
+            self._spec_adapt_k(s, min(acc, kvec_host[s]),
+                               kvec_host[s])
+            if self.paged:
+                # rewind the table cursor past rejected draft rows;
+                # _release_slot (below, on retire) must see the
+                # post-rollback block list or it would double-release
+                pt = self._tables[s]
+                for bid in pt.rollback(self._pos[s]):
+                    self._alloc.decref(bid)
+            self._maybe_retire(s)
+        self._spec_steps += 1
+        self._spec_emitted += emitted_total
+        self._rate.mark(float(emitted_total))
+        self._cur_dev = None
 
     # -- retirement ------------------------------------------------------
 
@@ -1000,6 +1534,13 @@ class ContinuousServer:
         if not live:
             self._flush()
             return bool(self._queue or self._pending)
+        if self._spec:
+            with tracing.span("serving.decode", "serving",
+                              live=len(live), spec=True,
+                              rids=[self._slot_req[s].rid
+                                    for s in live]):
+                self._spec_step(live)
+            return True
         with tracing.span("serving.decode", "serving",
                           live=len(live),
                           rids=[self._slot_req[s].rid for s in live]):

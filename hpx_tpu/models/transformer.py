@@ -1059,6 +1059,22 @@ def _sample_row(logits_row, temperature, key, pos, row):
         k, logits_row.astype(jnp.float32) / temperature)
 
 
+def _pick_row(logits_row, key, temperature, pos):
+    """Greedy-or-sampled next token for ONE batch row — the serving
+    wrapper of the `_sample_row` contract: argmax at temperature 0,
+    the shared categorical draw otherwise (row index pinned to 0: the
+    server keys are folded per slot, so the batch row carries no
+    entropy). The speculative-verify window picks its targets with
+    this exact function at each window position, which is what makes
+    acceptance collapse to exact token match: the window's position-p
+    pick IS the token the sequential step program would have emitted
+    at p."""
+    sampled = _sample_row(logits_row, jnp.maximum(temperature, 1e-6),
+                          key, pos, 0)
+    return jnp.where(temperature > 0, sampled,
+                     jnp.argmax(logits_row))
+
+
 def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):
     """Shared decode-mesh contract for generate()/speculative_generate:
     ("dp","tp") axes, dense model, heads/batch divisible. Returns

@@ -33,9 +33,11 @@ import jax.numpy as jnp
 __all__ = [
     "gather_block_kv",
     "paged_decode_attention",
+    "paged_window_attention",
     "scatter_blocks",
     "scatter_seq_blocks",
     "scatter_token",
+    "scatter_window",
 ]
 
 
@@ -64,6 +66,34 @@ def scatter_token(pool: jax.Array, table: jax.Array, pos: jax.Array,
     rows = jnp.arange(table.shape[0])
     bidx = table[rows, pos // bs]
     return pool.at[bidx, pos % bs].set(val)
+
+
+def scatter_window(pool: jax.Array, table: jax.Array, pos0: jax.Array,
+                   vals: jax.Array) -> jax.Array:
+    """Write a W-token window of rows per slot into the pool.
+
+    pool: [num_blocks, block_size, n_kv, head_dim]; table: [B,
+    max_blocks]; pos0: [B] int32 first logical position per slot; vals:
+    [B, W, n_kv, head_dim]. Slot b's window row i lands at
+    (table[b, (pos0[b]+i)//bs], (pos0[b]+i)%bs) — the speculative
+    verify scatter, where the tail of a slot's window may run past its
+    mapped (or even mappable) range.
+
+    Out-of-range positions must DROP, never clamp: a clamped table
+    gather (`min(p//bs, max_blocks-1)`) lands on the row's LAST column,
+    which for a fully-mapped table is a REAL block — a clamped write
+    would corrupt a live logical position ~block_size tokens back. So
+    positions past the table's extent are routed to block index
+    `num_blocks` (one past the pool) and the scatter uses
+    ``mode="drop"``."""
+    nb, bs = pool.shape[0], pool.shape[1]
+    b, w = vals.shape[0], vals.shape[1]
+    rows = jnp.arange(b)[:, None]
+    p = pos0[:, None] + jnp.arange(w)[None, :]          # [B, W]
+    maxb = table.shape[1]
+    bidx = table[rows, jnp.minimum(p // bs, maxb - 1)]
+    bidx = jnp.where(p < maxb * bs, bidx, nb)           # OOB -> dropped
+    return pool.at[bidx, p % bs].set(vals, mode="drop")
 
 
 def scatter_blocks(pool: jax.Array, bids: jax.Array,
@@ -116,4 +146,41 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
     s = jnp.where(live[:, None, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, 1, nq, hd)
+    return att, k_pool, v_pool
+
+
+def paged_window_attention(q: jax.Array, k_new: jax.Array,
+                           v_new: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, table: jax.Array,
+                           pos0: jax.Array):
+    """W-token speculative-verify attention over paged K/V.
+
+    q: [B, W, n_q, head_dim] (post-rope); k_new/v_new: [B, W, n_kv,
+    head_dim] the window's K/V rows; table: [B, max_blocks]; pos0: [B]
+    int32 first position per slot (window row i sits at pos0+i).
+    Returns (att [B, W, n_q, head_dim], k_pool, v_pool).
+
+    Per-query causal horizon: window row i attends positions
+    `<= pos0 + i` — exactly the horizon W sequential `scatter_token` +
+    `paged_decode_attention` steps would see, so the verify logits are
+    byte-identical to the sequential decode the window replaces.
+    Rejected draft rows stay in the pool as garbage, which is safe for
+    the same write-precedes-gather reason as the dense scratch tail:
+    a position is only ever attended once the frontier reaches it, and
+    the frontier only advances past freshly (re)written rows."""
+    k_pool = scatter_window(k_pool, table, pos0, k_new)
+    v_pool = scatter_window(v_pool, table, pos0, v_new)
+    kc = gather_block_kv(k_pool, table)
+    vc = gather_block_kv(v_pool, table)
+    b, w, nq, hd = q.shape
+    nkv = kc.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, w, nkv, g, hd)
+    s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
+    kpos = jnp.arange(kc.shape[1])
+    posw = pos0[:, None] + jnp.arange(w)[None, :]       # [B, W]
+    live = kpos[None, None, :] <= posw[:, :, None]      # [B, W, S]
+    s = jnp.where(live[:, None, None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, w, nq, hd)
     return att, k_pool, v_pool

@@ -373,6 +373,68 @@ def test_hpx008_static_key_is_silent():
 
 
 # ---------------------------------------------------------------------------
+# HPX009 — host sync on draft/verify intermediates in the serving hot loop
+# ---------------------------------------------------------------------------
+
+SERVING_PATH = "hpx_tpu/models/serving.py"
+
+HPX009_BAD = """\
+import numpy as np
+class ContinuousServer:
+    def _spec_step(self, live):
+        packed = self._verify_prog(4)(None)
+        vals = np.asarray(packed)
+        return vals
+"""
+
+HPX009_GOOD = """\
+import numpy as np
+class ContinuousServer:
+    def _finish_prefill(self, slot, req):
+        # outside the hot set: prefill boundary syncs are expected
+        first = np.asarray(req.first_logits)
+        return first
+"""
+
+
+def test_hpx009_asarray_in_hot_loop_fires():
+    fs = findings(HPX009_BAD, path=SERVING_PATH)
+    assert rules_of(fs) == ["HPX009"]
+    assert "_spec_step()" in fs[0].message
+
+
+def test_hpx009_item_and_device_get_fire():
+    src = ("import jax\n"
+           "class ContinuousServer:\n"
+           "    def step(self):\n"
+           "        acc = self._acc_dev.item()\n"
+           "        tgt = jax.device_get(self._tgt_dev)\n"
+           "        return acc, tgt\n")
+    fs = findings(src, path=SERVING_PATH)
+    assert rules_of(fs) == ["HPX009", "HPX009"]
+
+
+def test_hpx009_non_hot_function_is_silent():
+    assert findings(HPX009_GOOD, path=SERVING_PATH) == []
+
+
+def test_hpx009_outside_serving_path_is_silent():
+    assert findings(HPX009_BAD, path="hpx_tpu/models/other.py") == []
+
+
+def test_hpx009_nested_def_not_attributed_to_hot_parent():
+    # a helper DEFINED inside a hot function is not the hot loop
+    # itself (it runs wherever it is called; builders run at compile)
+    src = ("import numpy as np\n"
+           "class ContinuousServer:\n"
+           "    def _spec_step(self, live):\n"
+           "        def build():\n"
+           "            return np.asarray([1, 2])\n"
+           "        return build\n")
+    assert findings(src, path=SERVING_PATH) == []
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, syntax errors, baseline
 # ---------------------------------------------------------------------------
 
@@ -468,7 +530,8 @@ def test_finding_format():
 def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
-                   "HPX005", "HPX006", "HPX007", "HPX008"]
+                   "HPX005", "HPX006", "HPX007", "HPX008",
+                   "HPX009"]
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,33 @@ def test_emit_fallback_without_record(tmp_path):
     assert not m._emit_fallback()
 
 
+def test_fallback_age_stamps_the_unavailable_record(tmp_path):
+    # probe-exhausted runs must say HOW OLD the medians they re-emit
+    # are: oldest stamp + age in hours, span when assembled across runs
+    m = _load_bench(tmp_path, [
+        {"metric": "stream_triad_gbs", "value": 700.0, "unit": "GB/s",
+         "vs_baseline": 0.85},
+    ])
+    age = m._fallback_age(m._load_fallback())
+    assert age["fallback_measured_at"] == "2026-01-01T00:00:00+00:00"
+    assert age["fallback_age_hours"] > 0
+    mixed = m._fallback_age([
+        {"measured_at": "2026-01-01T00:00:00+00:00"},
+        {"measured_at": "2026-02-01T00:00:00+00:00"},
+        {"measured_at": "unknown"},
+    ])
+    assert mixed["fallback_measured_at"] == "2026-01-01T00:00:00+00:00"
+    assert mixed["fallback_measured_at_newest"] == \
+        "2026-02-01T00:00:00+00:00"
+
+
+def test_fallback_age_without_record(tmp_path):
+    m = _load_bench(tmp_path, None)
+    age = m._fallback_age([])
+    assert age == {"fallback_measured_at": "unknown",
+                   "fallback_age_hours": -1}
+
+
 def test_save_fallback_roundtrip(tmp_path, capsys):
     m = _load_bench(tmp_path, None)
     m.emit("x_metric", 1.234, "u", 0.5, spread=0.01)
