@@ -7,8 +7,17 @@ measurement, and writes the winners to
 hpx_tpu/ops/flash_blocks.json, which ops/attention_pallas.resolve_blocks
 consults whenever callers don't pass blocks explicitly.
 
-Usage: python benchmarks/flash_tune.py [--quick]
+With --paged the sweep instead covers the FUSED PAGED DECODE kernel's
+knob grid — cache block_size {8, 16, 32, 64} x kv_dtype {bf16, int8} —
+on a serving-decode shape (8 slots near a 2k horizon, N8 H128), and
+banks each kv_dtype's winning block size to
+hpx_tpu/ops/paged_blocks.json keyed ``hd<head_dim>x<kv_dtype>``, which
+`ops/attention_pallas.resolve_paged_block` (and through it
+``hpx.cache.block_size=auto``) consults.
+
+Usage: python benchmarks/flash_tune.py [--quick] [--paged]
   --quick: S in {2k, 4k} only and fewer samples (smoke/dev loops).
+  --paged: tune the paged decode kernel instead of flash forward.
 """
 
 import functools
@@ -75,6 +84,90 @@ def _bank(table, blocks_file) -> int:
     return len(merged)
 
 
+def paged_measure(jax, jnp, S, bs, kvd, samples=3):
+    """Time one fused paged decode attention step at the serving shape:
+    8 slots, every table fully mapped to DISTINCT pool blocks at a
+    near-S horizon (the steady-state worst case — block-size effects
+    show up as grid/tiling overhead, not masked work). Returns
+    (HBM-read GB/s, us per call, spread)."""
+    from hpx_tpu.ops.attention_pallas import fused_paged_attention
+    from hpx_tpu.ops.paged_attention import quantize_blocks
+    B, nq, nkv, H = 8, 8, 8, 128
+    maxb = S // bs
+    nb = B * maxb + 1                  # + a trash-style spare block
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, 1, nq, H), np.float32),
+                    jnp.bfloat16)
+    kp = rng.standard_normal((nb, bs, nkv, H), np.float32)
+    vp = rng.standard_normal((nb, bs, nkv, H), np.float32)
+    table = jnp.asarray(
+        np.arange(1, B * maxb + 1, dtype=np.int32).reshape(B, maxb))
+    pos = jnp.full((B,), S - 1, jnp.int32)
+    itemsize = 2
+    if kvd == "int8":
+        kq, ks = quantize_blocks(jnp.asarray(kp, jnp.float32))
+        vq, vs = quantize_blocks(jnp.asarray(vp, jnp.float32))
+        f = jax.jit(lambda qq: fused_paged_attention(
+            qq, kq, vq, table, pos, k_scale=ks, v_scale=vs))
+        itemsize = 1
+    else:
+        kb = jnp.asarray(kp, jnp.bfloat16)
+        vb = jnp.asarray(vp, jnp.bfloat16)
+        f = jax.jit(lambda qq: fused_paged_attention(
+            qq, kb, vb, table, pos))
+    out = f(q)
+    jax.block_until_ready(out)
+
+    def chain(kk):
+        qq = q
+        t0 = time.perf_counter()
+        for _ in range(kk):
+            qq = f(qq.astype(q.dtype))
+        _ = float(qq[0, 0, 0, 0])
+        return time.perf_counter() - t0
+
+    pers = sorted(slope_time(chain, 8, 50) for _ in range(samples))
+    per = pers[(samples - 1) // 2]
+    hbm = 2 * B * maxb * bs * nkv * H * itemsize    # K + V pool reads
+    if kvd == "int8":
+        hbm += 2 * B * maxb * nkv * 4               # scale sidecars
+    return hbm / per / 1e9, per * 1e6, (pers[-1] - pers[0]) / per
+
+
+def paged_main(jax, jnp, quick: bool) -> int:
+    from hpx_tpu.ops.attention_pallas import _PAGED_BLOCKS_FILE
+    S = 1024 if quick else 2048
+    samples = 2 if quick else 3
+    H = 128
+    table = {}
+    for kvd in ("bf16", "int8"):
+        best = None
+        for bs in (8, 16, 32, 64):
+            try:
+                gbs, us, spread = paged_measure(jax, jnp, S, bs, kvd,
+                                                samples=samples)
+            except Exception as e:  # noqa: BLE001 — eg VMEM OOM
+                print(json.dumps({"S": S, "kv_dtype": kvd,
+                                  "block_size": bs,
+                                  "error": str(e)[:120]}), flush=True)
+                continue
+            print(json.dumps({"S": S, "kv_dtype": kvd,
+                              "block_size": bs,
+                              "hbm_gb_per_s": round(gbs, 1),
+                              "us_per_step": round(us, 1),
+                              "spread": round(spread, 3)}), flush=True)
+            if best is None or us < best[0]:
+                best = (us, bs)
+        if best:
+            table[f"hd{H}x{kvd}"] = best[1]
+            total = _bank(table, _PAGED_BLOCKS_FILE)
+            print(json.dumps({"kv_dtype": kvd, "winner": best[1],
+                              "us_per_step": round(best[0], 1),
+                              "banked": total}), flush=True)
+    print(json.dumps({"wrote": _PAGED_BLOCKS_FILE, "new": len(table)}))
+    return 0
+
+
 def main() -> int:
     quick = "--quick" in sys.argv
     # single-class mode for a flaky tunnel: tune ONE (S, causal) per
@@ -95,6 +188,9 @@ def main() -> int:
         print(json.dumps({"error": "flash_tune needs a real TPU; "
                           f"backend={jax.default_backend()}"}))
         return 1
+
+    if "--paged" in sys.argv:
+        return paged_main(jax, jnp, quick)
 
     seqs = (2048, 4096) if quick else (2048, 4096, 8192, 16384)
     if shape_only:

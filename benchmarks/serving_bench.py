@@ -26,6 +26,19 @@ host; CPU for smoke runs with --cpu):
                            sha256 of every request's output — the
                            hashes MUST match, speculation only changes
                            how fast identical tokens appear
+  6. paged_decode        — the decode-attention roofline wave: one
+                           greedy mix through the paged server in each
+                           (paged_kernel, kv_dtype) mode (fused modes
+                           on TPU only — interpret-mode Pallas is a
+                           test vehicle, not a serving path). Reports
+                           warm tokens/s, decode-attention HBM
+                           bytes/token (sampled at peak occupancy from
+                           the /cache hbm-read-per-token feed, so int8
+                           must show its ~2x reduction MEASURED) and
+                           the effective attention GFLOP/s, plus
+                           token identity across modes (bf16 modes
+                           must match exactly; int8 reports its greedy
+                           match against the bf16 oracle)
 
 Prints one JSON line per engine. This is an operator harness, not part
 of bench.py's driver metrics — serving throughput depends on the
@@ -38,6 +51,7 @@ PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
 
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
+                                          [--paged-decode-only]
                                           [--trace-out PATH]
 """
 
@@ -169,6 +183,75 @@ def main() -> int:
                               "spec_sha": sha[:16]}), flush=True)
             raise SystemExit(2)
 
+    # 6. decode-attention roofline wave: the same greedy mix through
+    # each (paged_kernel, kv_dtype) mode. bytes/token samples the
+    # hbm_read_stats feed at PEAK table occupancy (mid-run max, not
+    # the post-run zero), so the int8 ~2x reduction is a measured
+    # number; effective GFLOP/s models decode attention as its two
+    # matmuls (QK^T + PV: 4 * S * n_heads * head_dim flops per token
+    # per layer over the occupancy-derived S).
+    def paged_decode_bench():
+        dreqs = [(rng.integers(1, 1000, 24).tolist(), 48)
+                 for _ in range(8)]
+        dtotal = sum(m for _, m in dreqs)
+        modes = [("gather", "bf16"), ("gather", "int8")]
+        if on_tpu:
+            modes += [("fused", "bf16"), ("fused", "int8")]
+
+        def run_mode(kern, kvd):
+            def run_once():
+                srv = ContinuousServer(params, cfg, slots=4, smax=128,
+                                       paged=True, paged_kernel=kern,
+                                       kv_dtype=kvd,
+                                       prefix_reuse=False)
+                for p, m in dreqs:
+                    srv.submit(p, max_new=m)
+                t0 = time.perf_counter()
+                peak = {"hbm_read_blocks_per_token": 0.0,
+                        "hbm_read_bytes_per_token": 0.0}
+                while srv.step():
+                    st = srv.hbm_read_stats()
+                    if (st["hbm_read_bytes_per_token"]
+                            > peak["hbm_read_bytes_per_token"]):
+                        peak = st
+                secs = time.perf_counter() - t0
+                out, srv._done = srv._done, {}
+                return (secs, peak, [out[r] for r in sorted(out)],
+                        srv.block_size)
+
+            run_once()                                 # compile
+            return run_once()
+
+        results = {}
+        for kern, kvd in modes:
+            results[(kern, kvd)] = run_mode(kern, kvd)
+        oracle_toks = results[("gather", "bf16")][2]
+        bf16_bytes = results[("gather", "bf16")][1][
+            "hbm_read_bytes_per_token"]
+        for (kern, kvd), (secs, peak, toks, bs) in results.items():
+            tps = dtotal / secs
+            # occupancy-derived attended length: blocks/token * bs
+            s_eff = peak["hbm_read_blocks_per_token"] * bs
+            flops_tok = (4 * s_eff * cfg.n_heads * cfg.head_dim
+                         * cfg.n_layers)
+            match = sum(a == b for a, b in zip(toks, oracle_toks))
+            emit(f"paged_decode_{kern}_{kvd}", dtotal, secs,
+                 mix="8 reqs plen24 new48 over 4 slots",
+                 hbm_blocks_per_token=round(
+                     peak["hbm_read_blocks_per_token"], 2),
+                 hbm_bytes_per_token=int(
+                     peak["hbm_read_bytes_per_token"]),
+                 bytes_vs_bf16=round(
+                     peak["hbm_read_bytes_per_token"]
+                     / bf16_bytes, 3) if bf16_bytes else None,
+                 attn_gflops_per_s=round(flops_tok * tps / 1e9, 2),
+                 outputs_match_bf16_oracle=f"{match}/{len(toks)}")
+            if kvd == "bf16" and toks != oracle_toks:
+                print(json.dumps({"error": "bf16 paged modes "
+                                  "diverged", "mode": kern}),
+                      flush=True)
+                raise SystemExit(2)
+
     def finish() -> int:
         if tracer is not None:
             from hpx_tpu.svc import tracing
@@ -187,6 +270,10 @@ def main() -> int:
 
     if "--spec-only" in sys.argv:
         spec_wave_bench()
+        return finish()
+
+    if "--paged-decode-only" in sys.argv:
+        paged_decode_bench()
         return finish()
 
     # 1. uniform batched greedy
@@ -280,6 +367,7 @@ def main() -> int:
     emit("generate_single_stream", max_new, time.perf_counter() - t0)
 
     paged_prefix_bench()
+    paged_decode_bench()
     return finish()
 
 

@@ -603,3 +603,66 @@ class SpecHostSyncRule(Rule):
                         "materializes a device scalar per call — pack "
                         "scalars into the step's single device->host "
                         "read instead")
+
+
+# modules on the paged-decode data path where a full-pool gather is a
+# silent HBM-bandwidth regression (HPX010's scope); the gather oracle
+# itself (ops/paged_attention.py) fires too and stays in the baseline.
+_PAGED_HOT_SUBPATHS = ("hpx_tpu/models/serving", "hpx_tpu/ops/",
+                       "hpx_tpu/cache/")
+
+
+@register
+class FullPoolGatherRule(Rule):
+    """HPX010: ``pool[table]``-shaped advanced indexing on a KV block
+    pool in the paged serving hot path.
+
+    Indexing a block pool with an int32 index array materializes the
+    full mapped ``[B, max_blocks, block_size, n_kv, head_dim]`` view
+    in HBM — the write-then-gather formulation whose bandwidth the
+    fused Pallas kernel (``ops/attention_pallas.fused_paged_attention``)
+    exists to eliminate: every byte the gather writes is immediately
+    read back by the attention contraction that follows.  Fix: route
+    decode attention through ``paged_decode_attention(..., fused=True)``
+    so K/V stream table-directed through VMEM.  Array-of-blocks reads
+    that must stay in XLA form belong in the designated oracle module
+    (``ops/paged_attention.py``) — its sites are baselined with
+    justification; anything new this rule flags is a regression.
+    Detection is name-based (singular ``*pool*`` arrays are device
+    block pools; plural ``pools`` is the host-side per-layer list) —
+    a false positive takes an inline
+    ``# hpxlint: disable=HPX010 — <why>``.
+    """
+
+    id = "HPX010"
+    name = "full-pool-gather"
+    severity = "error"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_subpath(*_PAGED_HOT_SUBPATHS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            base = node.value
+            name = (base.id if isinstance(base, ast.Name)
+                    else base.attr if isinstance(base, ast.Attribute)
+                    else "")
+            # singular pool names are device block pools (`pool`,
+            # `pool_q`, `k_pool`); plural `pools` is the per-layer
+            # host list (Python-int indexed) and `.at[...]` chains
+            # are scatters, not gathers — both stay out of scope
+            if "pool" not in name or name.endswith("s"):
+                continue
+            # only array-valued (advanced) indexing gathers; constant
+            # subscripts and slices read O(1) blocks
+            if not isinstance(node.slice, (ast.Name, ast.Attribute)):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"advanced indexing {ast.unparse(node)!r} gathers the "
+                "full mapped pool view through HBM — route decode "
+                "attention through paged_decode_attention(..., "
+                "fused=True); XLA-oracle gathers live only in "
+                "ops/paged_attention.py (baselined with justification)")

@@ -24,7 +24,40 @@ from typing import Dict, List
 from ..core.errors import Error, HpxError
 from ..synchronization import Mutex
 
-__all__ = ["BlockAllocator", "CacheOOM"]
+__all__ = ["BlockAllocator", "CacheOOM", "block_bytes",
+           "blocks_for_budget"]
+
+# storage bytes per KV element, by `hpx.cache.kv_dtype`. The scale
+# sidecar rides separately: int8 pools carry one f32 scale per
+# (block, kv-head) per pool (K and V each), accounted by block_bytes.
+_KV_ITEMSIZE = {"bf16": 2, "f32": 4, "int8": 1}
+_SCALE_BYTES = 4          # f32 per (block, kv-head) sidecar entry
+
+
+def block_bytes(block_size: int, n_kv: int, head_dim: int,
+                kv_dtype: str = "bf16", layers: int = 1) -> int:
+    """HBM bytes ONE pool block costs across `layers` layers, K and V
+    pools both, INCLUDING the int8 scale sidecar — the unit for
+    dtype-aware pool sizing and for the bytes/token roofline counters
+    (cache/counters.py). int8 halves the row bytes vs bf16; the
+    sidecar adds 4 bytes per (block, kv-head) per pool, amortized to
+    noise for any real block_size * head_dim."""
+    if kv_dtype not in _KV_ITEMSIZE:
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}; expected one "
+                         f"of {sorted(_KV_ITEMSIZE)}")
+    rows = block_size * n_kv * head_dim * _KV_ITEMSIZE[kv_dtype]
+    sidecar = n_kv * _SCALE_BYTES if kv_dtype == "int8" else 0
+    return 2 * layers * (rows + sidecar)          # K pool + V pool
+
+
+def blocks_for_budget(budget_bytes: int, block_size: int, n_kv: int,
+                      head_dim: int, kv_dtype: str = "bf16",
+                      layers: int = 1) -> int:
+    """How many pool blocks fit an HBM budget at this geometry/dtype —
+    the dtype-aware inverse of block_bytes (int8 fits ~2x the blocks
+    of bf16). Always at least 1 (the reserved trash block)."""
+    per = block_bytes(block_size, n_kv, head_dim, kv_dtype, layers)
+    return max(1, budget_bytes // per)
 
 
 class CacheOOM(HpxError):
@@ -46,13 +79,21 @@ class BlockAllocator:
     and debugging a block-map is far easier when ids are stable.
     """
 
-    def __init__(self, num_blocks: int, block_size: int) -> None:
+    def __init__(self, num_blocks: int, block_size: int,
+                 kv_dtype: str = "bf16") -> None:
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if kv_dtype not in _KV_ITEMSIZE:
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}; expected "
+                             f"one of {sorted(_KV_ITEMSIZE)}")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # storage dtype of the pools this allocator's ids index — int8
+        # pools carry a [num_blocks, n_kv] f32 scale sidecar per pool,
+        # sized/accounted via block_bytes/pool_bytes
+        self.kv_dtype = kv_dtype
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._ref: Dict[int, int] = {}
         self._lock = Mutex()
@@ -141,11 +182,20 @@ class BlockAllocator:
             self.total_cow_copies += 1
             return new, True
 
+    def pool_bytes(self, n_kv: int, head_dim: int,
+                   layers: int = 1) -> int:
+        """Total HBM footprint of the pools this allocator sizes
+        (scale sidecars included for int8) — what the HBM-budget
+        counters and `blocks_for_budget` callers reason about."""
+        return self.num_blocks * block_bytes(
+            self.block_size, n_kv, head_dim, self.kv_dtype, layers)
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {
                 "num_blocks": self.num_blocks,
                 "block_size": self.block_size,
+                "kv_dtype": self.kv_dtype,
                 "free": len(self._free),
                 "in_use": self.num_blocks - len(self._free),
                 "total_allocs": self.total_allocs,

@@ -38,6 +38,10 @@ Paged servers additionally export the cache counters::
     /cache{locality#L/server#i}/count/evictions         LRU chains dropped
     /cache{locality#L/server#i}/prefill-tokens/saved    prompt tokens NOT recomputed
     /cache{locality#L/server#i}/prefill-tokens/computed prompt tokens prefilled
+    /cache{locality#L/server#i}/count/hbm-read-per-token  mapped blocks streamed
+                                                          per decode token
+    /cache{locality#L/server#i}/bytes/hbm-read-per-token  dtype-aware bytes of the
+                                                          above (int8 sidecar incl.)
 """
 
 from __future__ import annotations
@@ -128,6 +132,15 @@ def register_server(srv) -> str:
             pc.CallbackCounter(_read(ref, lambda s: s._prefill_saved)))
         put("cache", "prefill-tokens/computed",
             pc.CallbackCounter(_read(ref, lambda s: s._prefill_computed)))
+        # decode-attention HBM roofline feed: mapped blocks (and their
+        # dtype-aware bytes, int8 scale sidecars included) streamed
+        # per generated token — see ContinuousServer.hbm_read_stats
+        put("cache", "count/hbm-read-per-token",
+            pc.CallbackCounter(_read(ref, lambda s: s.hbm_read_stats()
+                               ["hbm_read_blocks_per_token"])))
+        put("cache", "bytes/hbm-read-per-token",
+            pc.CallbackCounter(_read(ref, lambda s: s.hbm_read_stats()
+                               ["hbm_read_bytes_per_token"])))
 
     with _lock:
         _servers[idx] = (ref, names)

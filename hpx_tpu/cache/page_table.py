@@ -22,7 +22,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["PageTable", "materialize"]
+__all__ = ["PageTable", "materialize", "occupancy"]
 
 _UIDS = itertools.count()
 
@@ -100,6 +100,14 @@ class PageTable:
         row = np.full((max_blocks,), pad, np.int32)
         row[:len(self.blocks)] = self.blocks
         return row
+
+
+def occupancy(tables: Sequence[Optional[PageTable]]) -> int:
+    """Total MAPPED blocks across live slots (dead/None slots count 0)
+    — the table-occupancy input to the decode-attention
+    hbm-read-per-token counters: blocks a decode step actually streams
+    per slot, as opposed to the padded `max_blocks` row width."""
+    return sum(len(pt.blocks) for pt in tables if pt is not None)
 
 
 def materialize(tables: Sequence[Optional[PageTable]], max_blocks: int,

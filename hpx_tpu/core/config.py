@@ -53,10 +53,14 @@ DEFAULTS: Dict[str, str] = {
     "hpx.tpu.watcher_threads": "2",       # future-completion watcher pool
     "hpx.tpu.eager_futures": "1",         # device futures ready at dispatch
     "hpx.counters.enable": "1",
-    "hpx.cache.block_size": "16",         # KV tokens per paged block
+    # KV tokens per paged block (auto: HPX_PAGED_BLOCK env, then the
+    # table banked by `benchmarks/flash_tune.py --paged`, then 16)
+    "hpx.cache.block_size": "auto",
     "hpx.cache.num_blocks": "auto",       # pool size (auto: 2x worst case)
     "hpx.cache.radix_budget_blocks": "auto",  # prefix-tree HBM budget
     "hpx.cache.prefix_reuse": "1",        # radix prefix matching on admit
+    "hpx.cache.kv_dtype": "bf16",         # paged pool storage: bf16 | int8
+    "hpx.serving.paged_kernel": "auto",   # auto | gather | fused
     "hpx.serving.prefill_chunk": "128",   # prompt tokens per prefill chunk
     "hpx.serving.prefill_buckets": "auto",  # chunk-width ladder (csv|auto)
     "hpx.serving.async_dispatch": "1",    # decode without per-step sync

@@ -65,16 +65,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..cache.block_allocator import BlockAllocator, CacheOOM
+from ..cache.block_allocator import BlockAllocator, CacheOOM, block_bytes
 from ..cache.ngram import propose as _ngram_propose
-from ..cache.page_table import PageTable, materialize
+from ..cache.page_table import PageTable, materialize, occupancy
 from ..cache.radix import RadixCache
 from ..svc import tracing
+from ..ops.attention_pallas import resolve_paged_block
 from ..ops.paged_attention import (
     gather_block_kv,
     paged_decode_attention,
     paged_window_attention,
     scatter_seq_blocks,
+    scatter_seq_blocks_q,
 )
 from .transformer import (
     _PREFILL_CHUNK,
@@ -224,15 +226,18 @@ def _decode_rows(params, caches, tok, pos, cfg):
     return new_caches, logits[:, 0, :].astype(jnp.float32)
 
 
-def _paged_block_rows(x, lp, pools, table, pos, cfg: TransformerConfig):
+def _paged_block_rows(x, lp, pools, scales, table, pos,
+                      cfg: TransformerConfig, fused: bool = False):
     """_block_decode_rows with the K/V rows living in a shared BLOCK
     POOL instead of per-slot dense buffers. x: [B, 1, D]; pools:
-    (k_pool, v_pool) each [num_blocks, block_size, Nkv, H]; table:
-    [B, max_blocks] int32 logical->physical block map; pos: [B] int32.
-    Projections/rope/ffn are byte-identical to the dense path; only
-    the cache write (scatter through the table) and read (gather in
-    logical order — same row values at the same logical indices)
-    differ, which is what keeps paged == dense token-exact."""
+    (k_pool, v_pool) each [num_blocks, block_size, Nkv, H]; scales:
+    (k_scale, v_scale) [num_blocks, Nkv] f32 sidecars for int8 pools,
+    or None; table: [B, max_blocks] int32 logical->physical block map;
+    pos: [B] int32. Projections/rope/ffn are byte-identical to the
+    dense path; only the cache write (scatter through the table) and
+    read (gather in logical order — same row values at the same
+    logical indices, or the fused Pallas table walk) differ, which is
+    what keeps paged == dense token-exact."""
     kp, vp = pools
     b = x.shape[0]
     h = _ln(x, lp["ln1"])
@@ -240,8 +245,16 @@ def _paged_block_rows(x, lp, pools, table, pos, cfg: TransformerConfig):
     if cfg.rope:
         q = _rope_rows(q, pos, cfg)
         k = _rope_rows(k, pos, cfg)
-    att, kp, vp = paged_decode_attention(q, k[:, 0], v[:, 0], kp, vp,
-                                         table, pos)
+    if scales is None:
+        att, kp, vp = paged_decode_attention(q, k[:, 0], v[:, 0], kp,
+                                             vp, table, pos,
+                                             fused=fused)
+    else:
+        ks, vs = scales
+        att, kp, vp, ks, vs = paged_decode_attention(
+            q, k[:, 0], v[:, 0], kp, vp, table, pos,
+            k_scale=ks, v_scale=vs, fused=fused)
+        scales = (ks, vs)
     o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
     x = x + o
     h = _ln(x, lp["ln2"])
@@ -252,22 +265,29 @@ def _paged_block_rows(x, lp, pools, table, pos, cfg: TransformerConfig):
         mcfg = dataclasses.replace(_moe_cfg(cfg),
                                    capacity_factor=float(cfg.n_experts))
         out, _aux = moe_ffn(h.reshape(b, d), lp["moe"], mcfg)
-        return x + out.reshape(b, 1, d), (kp, vp)
+        return x + out.reshape(b, 1, d), (kp, vp), scales
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
-    return x + h, (kp, vp)
+    return x + h, (kp, vp), scales
 
 
-def _paged_decode_rows(params, pools, tok, table, pos, cfg):
+def _paged_decode_rows(params, pools, scales, tok, table, pos, cfg,
+                       fused: bool = False):
     """One token per slot through every block over paged pools;
-    returns (pools, f32 logits [B, V]) — the _decode_rows analog."""
+    returns (pools, scales, f32 logits [B, V]) — the _decode_rows
+    analog. `scales` is the per-layer list of (k_scale, v_scale)
+    sidecars for int8 pools, or None (passed through untouched)."""
     x = params["emb"][tok][:, None, :]
-    new_pools = []
-    for lp, pl in zip(params["layers"], pools):
-        x, pl = _paged_block_rows(x, lp, pl, table, pos, cfg)
+    new_pools, new_scales = [], []
+    for i, (lp, pl) in enumerate(zip(params["layers"], pools)):
+        sc = None if scales is None else scales[i]
+        x, pl, sc = _paged_block_rows(x, lp, pl, sc, table, pos, cfg,
+                                      fused)
         new_pools.append(pl)
+        new_scales.append(sc)
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-    return new_pools, logits[:, 0, :].astype(jnp.float32)
+    return (new_pools, None if scales is None else new_scales,
+            logits[:, 0, :].astype(jnp.float32))
 
 
 def _window_rows(x, lp, kv, pos0, cfg: TransformerConfig):
@@ -335,8 +355,8 @@ def _decode_window_rows(params, caches, toks, pos0, cfg):
     return new_caches, logits.astype(jnp.float32)
 
 
-def _paged_window_rows(x, lp, pools, table, pos0,
-                       cfg: TransformerConfig):
+def _paged_window_rows(x, lp, pools, scales, table, pos0,
+                       cfg: TransformerConfig, fused: bool = False):
     """`_window_rows` over paged pools: the scatter/gather and the
     per-query horizon live in `ops.paged_attention.
     paged_window_attention`; projections/rope/ffn are byte-identical
@@ -350,7 +370,15 @@ def _paged_window_rows(x, lp, pools, table, pos0,
     if cfg.rope:
         q = _rope_win(q, posw, cfg)
         k = _rope_win(k, posw, cfg)
-    att, kp, vp = paged_window_attention(q, k, v, kp, vp, table, pos0)
+    if scales is None:
+        att, kp, vp = paged_window_attention(q, k, v, kp, vp, table,
+                                             pos0, fused=fused)
+    else:
+        ks, vs = scales
+        att, kp, vp, ks, vs = paged_window_attention(
+            q, k, v, kp, vp, table, pos0,
+            k_scale=ks, v_scale=vs, fused=fused)
+        scales = (ks, vs)
     o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
     x = x + o
     h = _ln(x, lp["ln2"])
@@ -361,22 +389,27 @@ def _paged_window_rows(x, lp, pools, table, pos0,
         mcfg = dataclasses.replace(_moe_cfg(cfg),
                                    capacity_factor=float(cfg.n_experts))
         out, _aux = moe_ffn(h.reshape(b * w, d), lp["moe"], mcfg)
-        return x + out.reshape(b, w, d), (kp, vp)
+        return x + out.reshape(b, w, d), (kp, vp), scales
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
-    return x + h, (kp, vp)
+    return x + h, (kp, vp), scales
 
 
-def _paged_decode_window_rows(params, pools, toks, table, pos0, cfg):
-    """W tokens per slot over paged pools; returns (pools, f32 logits
-    [B, W, V]) — the `_decode_window_rows` analog."""
+def _paged_decode_window_rows(params, pools, scales, toks, table, pos0,
+                              cfg, fused: bool = False):
+    """W tokens per slot over paged pools; returns (pools, scales, f32
+    logits [B, W, V]) — the `_decode_window_rows` analog."""
     x = params["emb"][toks]
-    new_pools = []
-    for lp, pl in zip(params["layers"], pools):
-        x, pl = _paged_window_rows(x, lp, pl, table, pos0, cfg)
+    new_pools, new_scales = [], []
+    for i, (lp, pl) in enumerate(zip(params["layers"], pools)):
+        sc = None if scales is None else scales[i]
+        x, pl, sc = _paged_window_rows(x, lp, pl, sc, table, pos0, cfg,
+                                       fused)
         new_pools.append(pl)
+        new_scales.append(sc)
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-    return new_pools, logits.astype(jnp.float32)
+    return (new_pools, None if scales is None else new_scales,
+            logits.astype(jnp.float32))
 
 
 def _verify_tail(logits, toks, kvec, temp, keys, pos0, width):
@@ -434,6 +467,8 @@ class _PendingPrefill:
     seq: int                       # admission order (FIFO tiebreak)
     pt: Optional[PageTable] = None  # paged: blocks held for the request
     trow: Any = None               # paged: device [maxb] table row
+    wrow: Any = None               # paged: splice WRITE row (matched
+                                   # prefix entries point at trash)
     flow: Optional[int] = None     # tracing flow id chaining the chunks
 
     @property
@@ -498,6 +533,8 @@ class ContinuousServer:
                  spec: Optional[bool] = None,
                  spec_k: Optional[int] = None,
                  spec_draft: Optional[str] = None,
+                 paged_kernel: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
                  draft_params=None,
                  draft_cfg: Optional[TransformerConfig] = None):
         self.cfg = cfg
@@ -628,9 +665,14 @@ class ContinuousServer:
 
         if self.paged:
             self._init_paged(block_size, num_blocks,
-                             radix_budget_blocks, prefix_reuse)
+                             radix_budget_blocks, prefix_reuse,
+                             paged_kernel, kv_dtype)
             self._caches = None     # dense buffers never allocated
         else:
+            if paged_kernel is not None or kv_dtype is not None:
+                raise ValueError(
+                    "paged_kernel / kv_dtype are paged-mode knobs; "
+                    "pass paged=True to use them")
             def zeros():
                 # allocate DIRECTLY in the sharded layout: a full
                 # buffer on device 0 followed by a redistribute would
@@ -672,15 +714,49 @@ class ContinuousServer:
         self.counter_instance = register_server(self)
 
     def _init_paged(self, block_size, num_blocks, radix_budget_blocks,
-                    prefix_reuse) -> None:
+                    prefix_reuse, paged_kernel=None,
+                    kv_dtype=None) -> None:
         """Resolve the hpx.cache.* knobs and build the paged state:
-        one preallocated block pool per layer, the free-list/ref-count
-        allocator over it, and the radix prefix tree."""
+        one preallocated block pool per layer (plus the [num_blocks,
+        n_kv] f32 scale sidecars when ``hpx.cache.kv_dtype=int8``),
+        the free-list/ref-count allocator over it, and the radix
+        prefix tree."""
         from ..core.config import runtime_config
         cfg, slots, smax = self.cfg, self.slots, self.smax
         rc = runtime_config()
+        if kv_dtype is None:
+            kv_dtype = rc.get("hpx.cache.kv_dtype", "bf16")
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                "hpx.cache.kv_dtype must be 'bf16' (pools in the "
+                "model compute dtype) or 'int8' (quantized blocks "
+                f"with absmax scale sidecars), got {kv_dtype!r}")
+        self._kv_dtype = kv_dtype
+        if paged_kernel is None:
+            paged_kernel = rc.get("hpx.serving.paged_kernel", "auto")
+        if paged_kernel in (None, "", "auto"):
+            # the fused Pallas table-walk kernel is native on TPU;
+            # everywhere else the XLA gather formulation is the fast
+            # path (interpret-mode Pallas is a test vehicle, not a
+            # serving path)
+            paged_kernel = ("fused" if jax.default_backend() == "tpu"
+                            else "gather")
+        if paged_kernel not in ("gather", "fused"):
+            raise ValueError(
+                "hpx.serving.paged_kernel must be 'auto', 'gather' or "
+                f"'fused', got {paged_kernel!r}")
+        self._paged_kernel = paged_kernel
+        self._paged_fused = paged_kernel == "fused"
         if block_size is None:
-            block_size = rc.get_int("hpx.cache.block_size", 16)
+            v = rc.get("hpx.cache.block_size", "auto")
+            if v in (None, "", "auto"):
+                # tuned table banked by `benchmarks/flash_tune.py
+                # --paged` (ops/paged_blocks.json); 16 when no entry
+                # covers this (head_dim, kv_dtype)
+                block_size = resolve_paged_block(cfg.head_dim,
+                                                 self._kv_dtype, 16)
+            else:
+                block_size = int(v)
         bs = int(block_size)
         if bs < 1:
             raise ValueError(f"block_size must be >= 1, got {bs}")
@@ -711,7 +787,8 @@ class ContinuousServer:
         if prefix_reuse is None:
             prefix_reuse = rc.get_bool("hpx.cache.prefix_reuse", True)
         self._prefix_reuse = bool(prefix_reuse)
-        self._alloc = BlockAllocator(num_blocks, bs)
+        self._alloc = BlockAllocator(num_blocks, bs,
+                                     kv_dtype=self._kv_dtype)
         # the trash block: dead slots' tables and table padding point
         # here, so masked decode lanes scatter into rows nothing reads
         self._trash = self._alloc.alloc()
@@ -719,9 +796,20 @@ class ContinuousServer:
         nkv, hd = cfg.kv_heads, cfg.head_dim
 
         def pzeros():
+            if self._kv_dtype == "int8":
+                return jnp.zeros((num_blocks, bs, nkv, hd), jnp.int8)
             return jnp.zeros((num_blocks, bs, nkv, hd), cfg.dtype)
         self._pools = [(pzeros(), pzeros())
                        for _ in range(cfg.n_layers)]
+        if self._kv_dtype == "int8":
+            def sones():
+                # scale 1.0 is quantize_blocks' zero-block convention:
+                # fresh pools dequantize to exact zeros
+                return jnp.ones((num_blocks, nkv), jnp.float32)
+            self._scales = [(sones(), sones())
+                            for _ in range(cfg.n_layers)]
+        else:
+            self._scales = None
         self._tables: List[Optional[PageTable]] = [None] * slots
         self._tables_sig = None     # (uid, version) per slot
         self._tables_arr = None     # cached device [slots, maxb] map
@@ -830,78 +918,114 @@ class ContinuousServer:
     def _paged_step_prog(self):
         cfg, slots, smax = self.cfg, self.slots, self.smax
         nb, bs = self._alloc.num_blocks, self.block_size
-        ck = ("pg_step", cfg, slots, smax, nb, bs,
-              _tree_key(self.params))
+        ck = ("pg_step", cfg, slots, smax, nb, bs, self._kv_dtype,
+              self._paged_kernel, _tree_key(self.params))
 
         def build():
-            def step(params, pools, tok, pos, tables, temp, keys):
-                pools, logits = _paged_decode_rows(params, pools, tok,
-                                                   tables, pos, cfg)
+            fused = self._paged_fused
+
+            def step(params, pools, scales, tok, pos, tables, temp,
+                     keys):
+                pools, scales, logits = _paged_decode_rows(
+                    params, pools, scales, tok, tables, pos, cfg,
+                    fused)
                 nxt = jax.vmap(_pick_row)(logits, keys, temp, pos)
-                return pools, nxt
+                return pools, scales, nxt
             return self._jit_step(step)
         return self._program(ck, build)
 
     def _jit_step(self, step):
-        return jax.jit(step, donate_argnums=(1,))
+        # scales donate too: for bf16 pools the arg is None (an empty
+        # pytree), which donation treats as a no-op
+        return jax.jit(step, donate_argnums=(1, 2))
 
     def _paged_gather_prog(self):
         """Materialize one request's (possibly prefix-matched) blocks
         into a contiguous b=1 scratch cache the shared chunk/probe
-        programs run over. Keyed once per server shape."""
+        programs run over — int8 pools dequantize here, so the scratch
+        (and every chunk program over it) stays in the compute dtype.
+        Keyed once per server shape."""
         cfg = self.cfg
         nb, bs = self._alloc.num_blocks, self.block_size
-        ck = ("pg_gather", cfg, self.smax, nb, bs,
+        ck = ("pg_gather", cfg, self.smax, nb, bs, self._kv_dtype,
               _tree_key(self.params))
 
         def build():
-            def gather(pools, trow):
-                return [(gather_block_kv(kp, trow[None]),
-                         gather_block_kv(vp, trow[None]))
-                        for kp, vp in pools]
+            dt = cfg.dtype
+
+            def gather(pools, scales, trow):
+                if scales is None:
+                    return [(gather_block_kv(kp, trow[None]),
+                             gather_block_kv(vp, trow[None]))
+                            for kp, vp in pools]
+                return [(gather_block_kv(kp, trow[None], ks, dt),
+                         gather_block_kv(vp, trow[None], vs, dt))
+                        for (kp, vp), (ks, vs) in zip(pools, scales)]
             return jax.jit(gather)
         return self._program(ck, build)
 
     def _paged_splice_prog(self):
-        """Write the request's WHOLE padded block row back from the
-        b=1 scratch (chunked-prefill splice). One program for every
-        (matched, plen) combination: re-writing the matched prefix
-        blocks is an identity copy of the bytes the gather read (no
-        other writer can touch them meanwhile — decode COW-guards
-        shared blocks, and concurrent pendings write identical gathered
-        bytes), and the trash-padded tail is garbage-on-garbage (see
-        scatter_seq_blocks)."""
+        """Write the request's padded block row back from the b=1
+        scratch (chunked-prefill splice). One program for every
+        (matched, plen) combination: the WRITE row (`_start_paged`'s
+        `wrow`) redirects radix-matched prefix entries to the trash
+        block, so shared prefix blocks are never rewritten — for bf16
+        the skipped write was an identity copy of the bytes the gather
+        read; for int8 it would be a dequant(bf16)->requant of a
+        SHARED block (a ±1-quantum walk other readers would see), so
+        skipping it is what keeps prefix reuse exact. The trash-padded
+        tail (and the redirected prefix) is garbage-on-garbage (see
+        scatter_seq_blocks); int8 splices quantize whole blocks here
+        (scatter_seq_blocks_q)."""
         cfg = self.cfg
         nb, bs = self._alloc.num_blocks, self.block_size
         maxb = self._maxb
-        ck = ("pg_splice", cfg, self.smax, nb, bs,
+        ck = ("pg_splice", cfg, self.smax, nb, bs, self._kv_dtype,
               _tree_key(self.params))
 
         def build():
-            def splice(pools, one, trow):
-                out = []
-                for (kp, vp), (kc, vc) in zip(pools, one):
+            def splice(pools, scales, one, wrow):
+                outp, outs = [], []
+                for i, ((kp, vp), (kc, vc)) in enumerate(
+                        zip(pools, one)):
                     kseg = kc[0].reshape(maxb, bs, *kc.shape[2:])
                     vseg = vc[0].reshape(maxb, bs, *vc.shape[2:])
-                    out.append((scatter_seq_blocks(kp, trow, kseg),
-                                scatter_seq_blocks(vp, trow, vseg)))
-                return out
-            return jax.jit(splice, donate_argnums=(0,))
+                    if scales is None:
+                        outp.append(
+                            (scatter_seq_blocks(kp, wrow, kseg),
+                             scatter_seq_blocks(vp, wrow, vseg)))
+                    else:
+                        ks, vs = scales[i]
+                        kp, ks = scatter_seq_blocks_q(kp, ks, wrow,
+                                                      kseg)
+                        vp, vs = scatter_seq_blocks_q(vp, vs, wrow,
+                                                      vseg)
+                        outp.append((kp, vp))
+                        outs.append((ks, vs))
+                return outp, (None if scales is None else outs)
+            return jax.jit(splice, donate_argnums=(0, 1))
         return self._program(ck, build)
 
     def _copy_block_prog(self):
         """Device side of allocator copy-on-write: duplicate one
-        block's rows src->dst across every layer's pools."""
+        block's rows src->dst across every layer's pools (int8 pools
+        copy the block's scale sidecar entries too — a forked block
+        must dequantize identically to its source)."""
         nb, bs = self._alloc.num_blocks, self.block_size
-        ck = ("pg_copy", self.cfg, self.smax, nb, bs,
+        ck = ("pg_copy", self.cfg, self.smax, nb, bs, self._kv_dtype,
               _tree_key(self.params))
 
         def build():
-            def copy(pools, src, dst):
-                return [(kp.at[dst].set(kp[src]),
-                         vp.at[dst].set(vp[src]))
-                        for kp, vp in pools]
-            return jax.jit(copy, donate_argnums=(0,))
+            def copy(pools, scales, src, dst):
+                pools = [(kp.at[dst].set(kp[src]),
+                          vp.at[dst].set(vp[src]))
+                         for kp, vp in pools]
+                if scales is not None:
+                    scales = [(ks.at[dst].set(ks[src]),
+                               vs.at[dst].set(vs[src]))
+                              for ks, vs in scales]
+                return pools, scales
+            return jax.jit(copy, donate_argnums=(0, 1))
         return self._program(ck, build)
 
     # -- speculative programs (verify windows + draft model) -------------
@@ -934,16 +1058,20 @@ class ContinuousServer:
         cfg, slots, smax = self.cfg, self.slots, self.smax
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_verify", cfg, slots, smax, width, nb, bs,
+              self._kv_dtype, self._paged_kernel,
               _tree_key(self.params))
 
         def build():
-            def verify(params, pools, toks, pos0, tables, kvec, temp,
-                       keys):
-                pools, logits = _paged_decode_window_rows(
-                    params, pools, toks, tables, pos0, cfg)
-                return pools, _verify_tail(
+            fused = self._paged_fused
+
+            def verify(params, pools, scales, toks, pos0, tables,
+                       kvec, temp, keys):
+                pools, scales, logits = _paged_decode_window_rows(
+                    params, pools, scales, toks, tables, pos0, cfg,
+                    fused)
+                return pools, scales, _verify_tail(
                     logits, toks, kvec, temp, keys, pos0, width)
-            return jax.jit(verify, donate_argnums=(1,))
+            return jax.jit(verify, donate_argnums=(1, 2))
         return self._program(ck, build)
 
     def _draft_step_prog(self):
@@ -1010,8 +1138,9 @@ class ContinuousServer:
         if self._alloc.refcount(bid) > 1:
             new, copied = self._alloc.fork(bid)
             if copied:
-                self._pools = self._copy_block_prog()(
-                    self._pools, jnp.int32(bid), jnp.int32(new))
+                self._pools, self._scales = self._copy_block_prog()(
+                    self._pools, self._scales, jnp.int32(bid),
+                    jnp.int32(new))
                 pt.replace_block(bi, new)
 
     def _ensure_block(self, slot: int, pos: int) -> None:
@@ -1082,7 +1211,44 @@ class ContinuousServer:
         st.update(self._radix.stats())
         st["prefill_tokens_saved"] = self._prefill_saved
         st["prefill_tokens_computed"] = self._prefill_computed
+        st.update(self.hbm_read_stats())
         return st
+
+    def _kv_acct_dtype(self) -> str:
+        """block_bytes key for the POOLS AS ALLOCATED: kv_dtype=bf16
+        stores the model compute dtype, which tier-1's CPU configs set
+        to f32 — account what is actually resident, not the label."""
+        if self._kv_dtype == "int8":
+            return "int8"
+        return ("f32" if jnp.dtype(self.cfg.dtype).itemsize == 4
+                else "bf16")
+
+    def hbm_read_stats(self) -> Dict[str, float]:
+        """Modeled decode-attention HBM read cost per generated token,
+        fed from pool dtype + table occupancy (the
+        /cache{...}/{count,bytes}/hbm-read-per-token counters and the
+        serving-bench roofline columns).
+
+        Each decode step emits one token per live slot and streams
+        every MAPPED block of that slot once per layer, K and V pools
+        both (the fused kernel reads the padded table tail too, but
+        those entries all alias the single resident trash block —
+        occupancy is the honest per-slot traffic). bytes/token uses
+        `cache.block_allocator.block_bytes`, so the int8 sidecar
+        scales are included and bf16-vs-int8 shows the ~2x the
+        roofline claim promises."""
+        if not self.paged:
+            raise ValueError("hbm_read_stats() requires paged=True")
+        live = sum(1 for pt in self._tables if pt is not None)
+        blocks = occupancy(self._tables)
+        per_tok = (blocks / live) if live else 0.0
+        bb = block_bytes(self.block_size, self.cfg.kv_heads,
+                         self.cfg.head_dim, self._kv_acct_dtype(),
+                         layers=self.cfg.n_layers)
+        return {
+            "hbm_read_blocks_per_token": per_tok,
+            "hbm_read_bytes_per_token": per_tok * bb,
+        }
 
     def spec_stats(self) -> Dict[str, float]:
         """Speculation observability snapshot (the same numbers the
@@ -1179,11 +1345,19 @@ class ContinuousServer:
         pt.tokens = plen
         self._prefill_saved += matched
         self._prefill_computed += plen - matched
-        trow = jnp.asarray(pt.as_row(self._maxb, self._trash))
-        caches = self._paged_gather_prog()(self._pools, trow)
+        row = pt.as_row(self._maxb, self._trash)
+        trow = jnp.asarray(row)
+        # the splice's WRITE row: radix-matched prefix blocks are
+        # shared, so their entries redirect to the trash block — the
+        # splice never rewrites them (see _paged_splice_prog)
+        wnp = row.copy()
+        wnp[:matched // self.block_size] = self._trash
+        wrow = jnp.asarray(wnp)
+        caches = self._paged_gather_prog()(self._pools, self._scales,
+                                           trow)
         return _PendingPrefill(req=req, slot=slot, caches=caches,
                                done=matched, seq=self._pf_seq, pt=pt,
-                               trow=trow)
+                               trow=trow, wrow=wrow)
 
     def _advance_chunk(self, p: _PendingPrefill) -> None:
         """Run ONE bucketed chunk of p's prompt into its scratch."""
@@ -1219,8 +1393,8 @@ class ContinuousServer:
             tracing.flow_end(p.flow, "serving.prefill_chunks")
             p.flow = None
         if self.paged:
-            self._pools = self._paged_splice_prog()(
-                self._pools, caches, p.trow)
+            self._pools, self._scales = self._paged_splice_prog()(
+                self._pools, self._scales, caches, p.wrow)
             self._tables[slot] = p.pt
         else:
             self._caches = self._splice_prog()(
@@ -1432,10 +1606,11 @@ class ContinuousServer:
                 for s in live:
                     self._ensure_window(s, self._pos[s],
                                         self._pos[s] + kvec_host[s])
-                self._pools, packed = self._paged_verify_prog(width)(
-                    self.params, self._pools, toks, pos,
-                    self._tables_dev(), kvec, self._temp_dev,
-                    self._keys_dev)
+                self._pools, self._scales, packed = \
+                    self._paged_verify_prog(width)(
+                        self.params, self._pools, self._scales, toks,
+                        pos, self._tables_dev(), kvec, self._temp_dev,
+                        self._keys_dev)
             else:
                 self._caches, packed = self._verify_prog(width)(
                     self.params, self._caches, toks, pos, kvec,
@@ -1559,9 +1734,11 @@ class ContinuousServer:
             if self.paged:
                 for s in live:
                     self._ensure_block(s, self._pos[s])
-                self._pools, nxt = self._paged_step_prog()(
-                    self.params, self._pools, tok, pos,
-                    self._tables_dev(), self._temp_dev, self._keys_dev)
+                self._pools, self._scales, nxt = \
+                    self._paged_step_prog()(
+                        self.params, self._pools, self._scales, tok,
+                        pos, self._tables_dev(), self._temp_dev,
+                        self._keys_dev)
             else:
                 self._caches, nxt = self._step_prog()(
                     self.params, self._caches, tok, pos,

@@ -37,8 +37,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4/0.5;
+# accept either so the kernels run on both
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 __all__ = ["flash_attention", "flash_attention_chunk",
-           "flash_attention_bwd", "resolve_blocks"]
+           "flash_attention_bwd", "fused_paged_attention",
+           "resolve_blocks", "resolve_paged_block"]
 
 
 # ---------------------------------------------------------------------------
@@ -268,7 +274,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
@@ -552,7 +558,7 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
             ],
         ),
         out_shape=[_sds((bn, sq, h), f32, q, k, v, do, delta, lse)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(darr, q, k, v, do, delta, lse)[0]
@@ -587,7 +593,7 @@ def flash_attention_bwd(q, k, v, do, delta, lse, d,
         ),
         out_shape=[_sds((bn, sk, h), f32, q, k, v, do, delta, lse),
                    _sds((bn, sk, h), f32, q, k, v, do, delta, lse)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(darr, q, k, v, do, delta, lse)
@@ -769,8 +775,224 @@ def flash_attention_chunk(q, k, v, acc, m, l, d,
             _sds((bn, sq, 128), f32, q, k, v, acc, m, l),
             _sds((bn, sq, 128), f32, q, k, v, acc, m, l),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.asarray([d], jnp.int32).reshape(1), q, k, v, acc, m, l)
     return acc2, m2, l2
+
+
+# ---------------------------------------------------------------------------
+# fused paged decode attention — the block-table kernel
+# ---------------------------------------------------------------------------
+#
+# The serving decode hot loop: instead of materializing a
+# [B, max_blocks*block_size, n_kv, head_dim] gather per layer per step
+# (ops/paged_attention.gather_block_kv — the XLA oracle), the kernel
+# walks the int32 block table DIRECTLY. Grid (slot, kv-head, block);
+# the K/V BlockSpec index_map resolves logical block i of slot b to its
+# physical pool block via the scalar-prefetched table
+# (table_ref[b, i]), so each (block_size, head_dim) tile streams
+# HBM -> VMEM exactly once and no logical view ever touches HBM.
+#
+# int8 pools dequantize AT THE VMEM BOUNDARY: per-(block, kv-head)
+# absmax scales ride a sibling [num_blocks, n_kv] f32 array whose
+# BlockSpec follows the same table indirection, and
+# (int8 * scale).astype(q.dtype) happens on the freshly-landed tile —
+# HBM moves 1 byte/elem instead of 2.
+#
+# Numerics contract (why this is NOT the classic online softmax): the
+# fused path must keep emitting the SAME TOKENS as the gather oracle
+# and the dense server (tests pin dense == gather-paged == fused-paged
+# greedy/sampled/speculative). A running-max online softmax rescales
+# partial accumulators and drifts O(eps * S) from the oracle's
+# one-pass `jax.nn.softmax`. So the kernel spends its VMEM on
+# exactness instead: per-block score tiles are stashed into an
+# (W*g, S) f32 scratch and dequantized V rows into an (S, hd) scratch
+# along the sequential block axis, and the LAST block step applies the
+# oracle's op order verbatim — mask to -inf, f32 softmax over the full
+# row, cast to q.dtype, one (W*g, S) x (S, hd) dot. Scores and
+# softmax are bitwise-equal to the oracle's; the final PV contraction
+# is the same f32 math but XLA schedules a batched einsum's reduction
+# differently from a 2-D dot, so logits agree to ~1 ulp rather than
+# bit-for-bit — the same variation the repo already carries between
+# its own programs (the oracle's eager and jitted logits differ by the
+# same amount, as do its W=1 decode and W-window verify gemms), and
+# the reason every serving equivalence contract here is pinned at
+# exact TOKENS plus ulp-tight logits. VMEM cost is O(S*(W*g + hd))
+# per (slot, head) step — fine at serving smax — and the HBM story
+# (the thing the roofline cares about) is identical to a flash-style
+# walk.
+
+_PAGED_BLOCKS_FILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "paged_blocks.json")
+_paged_blocks_table: Optional[dict] = None
+
+
+def _load_paged_blocks() -> dict:
+    global _paged_blocks_table
+    if _paged_blocks_table is None:
+        try:
+            with open(_PAGED_BLOCKS_FILE) as f:
+                _paged_blocks_table = dict(json.load(f))
+        except (OSError, ValueError):
+            _paged_blocks_table = {}
+    return _paged_blocks_table
+
+
+def resolve_paged_block(head_dim: int, kv_dtype: str = "bf16",
+                        default: int = 16) -> int:
+    """The cache block_size `hpx.cache.block_size=auto` resolves to.
+
+    Resolution order: HPX_PAGED_BLOCK env > measured table
+    (benchmarks/flash_tune.py --paged writes paged_blocks.json next to
+    this file, keyed ``hd<head_dim>x<kv_dtype>``) > `default`."""
+    env = os.environ.get("HPX_PAGED_BLOCK")
+    if env:
+        return int(env)
+    table = _load_paged_blocks()
+    val = table.get(f"hd{head_dim}x{kv_dtype}")
+    return int(val) if val else default
+
+
+def _paged_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                  block_size: int, nblk: int, group: int,
+                  quantized: bool):
+    """One (slot b, kv-head h, logical block i) grid step.
+
+    q_ref: (1, 1, Wg, hd) the slot's query rows for this kv head
+    (window row w, group lane j flattened as r = w*group + j);
+    k_ref/v_ref: (1, block_size, 1, hd) the PHYSICAL pool block the
+    table maps logical block i to (the index_map did the gather);
+    quantized adds ks_ref/vs_ref (1, 1) per-(block, head) scales.
+    s_s/v_s scratch accumulate the full logical row along the
+    sequential i axis; the last step runs the oracle-order softmax."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, s_s, v_s = rest
+    else:
+        o_ref, s_s, v_s = rest
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    q = q_ref[0, 0]                                # (Wg, hd)
+    k = k_ref[0, :, 0, :]                          # (bs, hd)
+    v = v_ref[0, :, 0, :]
+    if quantized:
+        # dequantize at the VMEM boundary — elementwise-identical to
+        # the oracle's (pool.astype(f32) * scale).astype(q.dtype)
+        k = (k.astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs_ref[0, 0]).astype(q.dtype)
+
+    # same dtype semantics as the oracle's einsum (no forced f32
+    # accumulation — byte-identity beats MXU rate here; the f32 upcast
+    # below is exact for bf16/f32 scores)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+    s = s / math.sqrt(q.shape[-1])
+    s_s[:, pl.ds(i * block_size, block_size)] = s.astype(jnp.float32)
+    v_s[pl.ds(i * block_size, block_size), :] = v.astype(jnp.float32)
+
+    @pl.when(i == nblk - 1)
+    def _finish():
+        pos0 = pos_ref[b]
+        sf = s_s[...]                              # (Wg, S) f32
+        kpos = jax.lax.broadcasted_iota(jnp.int32, sf.shape, 1)
+        wrow = jax.lax.broadcasted_iota(jnp.int32, sf.shape, 0) // group
+        live = kpos <= pos0 + wrow                 # per-window-row horizon
+        sf = jnp.where(live, sf, -jnp.inf)
+        p = jax.nn.softmax(sf, axis=-1)            # oracle op order
+        att = jax.lax.dot_general(
+            p.astype(o_ref.dtype), v_s[...].astype(o_ref.dtype),
+            (((1,), (0,)), ((), ())))
+        o_ref[0, 0] = att.astype(o_ref.dtype)
+
+
+def fused_paged_attention(q: jax.Array, k_pool: jax.Array,
+                          v_pool: jax.Array, table: jax.Array,
+                          pos0: jax.Array,
+                          k_scale: Optional[jax.Array] = None,
+                          v_scale: Optional[jax.Array] = None,
+                          interpret: Optional[bool] = None) -> jax.Array:
+    """Decode/verify attention that walks the block table in-kernel.
+
+    q: [B, W, n_q, head_dim] post-rope queries (W = 1 for plain decode,
+    W = window width for speculative verify); k_pool/v_pool:
+    [num_blocks, block_size, n_kv, head_dim] with this step's rows
+    ALREADY scattered (write precedes attention, exactly like the
+    gather oracle); table: [B, max_blocks] int32; pos0: [B] int32 —
+    window row w attends logical positions <= pos0 + w (W = 1: the
+    inclusive `<= pos` decode mask). k_scale/v_scale: [num_blocks,
+    n_kv] f32 per-(block, head) absmax scales for int8 pools (None for
+    bf16/f32 pools). Returns att [B, W, n_q, head_dim] in q.dtype.
+
+    Every logical block (trash-padded tail included) is processed and
+    masked, never skipped — rows past pos0+w contribute exact-zero
+    probability, matching `paged_decode_attention` element-for-element:
+    bitwise-equal scores and softmax, logits within ~1 ulp (see the
+    section comment), same tokens. GQA via the same grouped-query
+    reshape, so n_q % n_kv == 0.
+
+    Falls back to interpret mode off-TPU (CPU tier-1 stays green).
+    Real-TPU int8 pools want block_size >= 32 (the int8 sublane tile);
+    interpret mode takes any block size."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, w, nq, hd = q.shape
+    bs = k_pool.shape[1]
+    nkv = k_pool.shape[2]
+    maxb = table.shape[1]
+    if nq % nkv:
+        raise ValueError(f"q heads ({nq}) not a multiple of kv heads "
+                         f"({nkv})")
+    g = nq // nkv
+    wg = w * g
+    wg_pad = wg + (-wg % 8)          # 8-sublane f32 tile; pad rows are
+    seq = maxb * bs                  # garbage, sliced off below
+
+    # [B, W, nkv, g, hd] -> [B, nkv, W*g, hd]: row r = w*g + j
+    qk = jnp.moveaxis(q.reshape(b, w, nkv, g, hd), 2, 1)
+    qk = qk.reshape(b, nkv, wg, hd)
+    if wg_pad != wg:
+        qk = jnp.pad(qk, ((0, 0), (0, 0), (0, wg_pad - wg), (0, 0)))
+
+    quantized = k_scale is not None
+    kernel = functools.partial(
+        _paged_kernel, block_size=bs, nblk=maxb, group=g,
+        quantized=quantized)
+
+    q_spec = pl.BlockSpec((1, 1, wg_pad, hd),
+                          lambda bb, hh, ii, *_: (bb, hh, 0, 0))
+    # THE fusion: logical block ii of slot bb reads physical pool
+    # block table[bb, ii] straight from the scalar-prefetched table
+    kv_spec = pl.BlockSpec(
+        (1, bs, 1, hd),
+        lambda bb, hh, ii, tref, pref: (tref[bb, ii], 0, hh, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qk, k_pool, v_pool]
+    if quantized:
+        sc_spec = pl.BlockSpec(
+            (1, 1), lambda bb, hh, ii, tref, pref: (tref[bb, ii], hh))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, nkv, maxb),
+            in_specs=in_specs,
+            out_specs=[q_spec],
+            scratch_shapes=[
+                pltpu.VMEM((wg_pad, seq), jnp.float32),
+                pltpu.VMEM((seq, hd), jnp.float32),
+            ],
+        ),
+        out_shape=[_sds((b, nkv, wg_pad, hd), q.dtype, q, k_pool,
+                        v_pool)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(table.astype(jnp.int32), pos0.astype(jnp.int32), *operands)[0]
+
+    out = out[:, :, :wg]
+    return jnp.moveaxis(out.reshape(b, nkv, w, g, hd), 1, 2
+                        ).reshape(b, w, nq, hd)

@@ -17,10 +17,28 @@ probability, so the garbage content of pad/trash blocks contributes
 exactly 0.0 — paged and dense servers emit byte-identical tokens.
 
 The gather materializes a `[B, S, n_kv, head_dim]` view per layer —
-the XLA-oracle formulation. A fused Pallas kernel that walks the block
-table in VMEM (the vLLM PagedAttention shape) is the follow-on once
-the flash path grows a block-table BlockSpec; this module is the
-equivalence oracle such a kernel will be tested against.
+the XLA-oracle formulation, and the DESIGNATED oracle module: hpxlint
+HPX010 flags `pool[table]`-shaped gathers anywhere else in the serving
+hot paths. The fused Pallas kernel that walks the block table in VMEM
+(`ops/attention_pallas.fused_paged_attention`) is the production
+decode path; `fused=True` on the two attention entry points routes
+through it, and the gather formulation here is what it is tested
+against (exact tokens, ulp-tight logits — see the kernel's numerics
+contract).
+
+Quantized KV (`hpx.cache.kv_dtype=int8`): pools store int8 blocks with
+per-(block, kv-head) symmetric-absmax scales in a sibling
+`[num_blocks, n_kv]` f32 array (the scheme of `models/quant.py`,
+applied per block instead of per output channel — paged blocks make
+per-block mixed precision natural). Writes quantize at the frontier:
+the `*_q` scatter variants read-modify-write the touched block
+(dequantize with the old scale, insert the new rows, recompute the
+block's absmax, requantize). Requantization of UNTOUCHED rows is
+exact whenever the block absmax didn't move (max|q| == 127 by
+construction, so the recomputed scale is bit-identical), and bounded
+by one rounding step when it did. The gather side dequantizes with
+the same elementwise ops the kernel uses at its VMEM boundary, so
+gather-int8 and fused-int8 agree exactly like their bf16 twins.
 """
 
 from __future__ import annotations
@@ -30,27 +48,56 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ..models.quant import _quantize
+from .attention_pallas import fused_paged_attention
+
 __all__ = [
     "gather_block_kv",
     "paged_decode_attention",
     "paged_window_attention",
+    "quantize_blocks",
     "scatter_blocks",
+    "scatter_blocks_q",
     "scatter_seq_blocks",
+    "scatter_seq_blocks_q",
     "scatter_token",
+    "scatter_token_q",
     "scatter_window",
+    "scatter_window_q",
 ]
 
 
-def gather_block_kv(pool: jax.Array, table: jax.Array) -> jax.Array:
+def gather_block_kv(pool: jax.Array, table: jax.Array,
+                    scale: jax.Array = None,
+                    out_dtype=None) -> jax.Array:
     """Materialize logical K or V rows from a block pool.
 
     pool: [num_blocks, block_size, n_kv, head_dim]; table: [B,
     max_blocks] int32. Returns [B, max_blocks * block_size, n_kv,
     head_dim] — slot b's logical row p at index p (pad blocks yield
-    garbage rows the causal mask must exclude)."""
+    garbage rows the causal mask must exclude).
+
+    For int8 pools pass `scale` ([num_blocks, n_kv] f32) and the
+    compute `out_dtype`: blocks dequantize with the same elementwise
+    ops the fused kernel applies at its VMEM boundary
+    ((int8 * scale).astype(out_dtype)), keeping the two int8 paths
+    exactly comparable."""
     g = pool[table]                       # [B, maxb, bs, nkv, hd]
     b, m, s, n, h = g.shape
+    if scale is not None:
+        sc = scale[table]                 # [B, maxb, nkv]
+        g = (g.astype(jnp.float32) * sc[:, :, None, :, None]).astype(
+            out_dtype if out_dtype is not None else jnp.bfloat16)
     return g.reshape(b, m * s, n, h)
+
+
+def quantize_blocks(rows: jax.Array):
+    """Symmetric-absmax int8 per (block, kv-head): rows [..., block_size,
+    n_kv, head_dim] -> (int8 rows, scales [..., n_kv] f32). Zero blocks
+    get scale 1.0 (models/quant._quantize's convention), so fresh pools
+    roundtrip exactly."""
+    qt = _quantize(rows, axes=(-3, -1))
+    return qt.q, jnp.squeeze(qt.s, axis=(-3, -1))
 
 
 def scatter_token(pool: jax.Array, table: jax.Array, pos: jax.Array,
@@ -96,6 +143,80 @@ def scatter_window(pool: jax.Array, table: jax.Array, pos0: jax.Array,
     return pool.at[bidx, p % bs].set(vals, mode="drop")
 
 
+def scatter_token_q(pool_q: jax.Array, scales: jax.Array,
+                    table: jax.Array, pos: jax.Array,
+                    val: jax.Array):
+    """`scatter_token` for int8 pools: read-modify-write the frontier
+    block. pool_q int8 [num_blocks, block_size, n_kv, head_dim]; scales
+    f32 [num_blocks, n_kv]; val [B, n_kv, head_dim] full-precision.
+    Returns (pool_q, scales).
+
+    Each slot's frontier block is gathered (B blocks, not the full
+    table — bounded RMW traffic), dequantized with its old scale, the
+    new row inserted, and the block requantized under its fresh absmax.
+    Live slots own their frontier block exclusively (the COW guard
+    forks shared blocks before the frontier reaches them), so the RMW
+    never races a neighbour; dead slots all point at the trash block,
+    whose duplicate writes are garbage-on-garbage.
+
+    Out-of-range positions DROP, never clamp, for the same reason as
+    `scatter_window`: both the block write and the scale write are
+    routed to block index num_blocks and dropped, so an OOB row can
+    neither corrupt a live block nor skew its scale."""
+    nb, bs = pool_q.shape[0], pool_q.shape[1]
+    maxb = table.shape[1]
+    rows = jnp.arange(table.shape[0])
+    bidx = table[rows, jnp.minimum(pos // bs, maxb - 1)]
+    blk = pool_q[bidx]                    # [B, bs, nkv, hd] int8
+    scl = scales[bidx]                    # [B, nkv]
+    deq = blk.astype(jnp.float32) * scl[:, None, :, None]
+    deq = deq.at[rows, pos % bs].set(val.astype(jnp.float32))
+    q8, s_new = quantize_blocks(deq)
+    bidx = jnp.where(pos < maxb * bs, bidx, nb)         # OOB -> dropped
+    pool_q = pool_q.at[bidx].set(q8, mode="drop")
+    scales = scales.at[bidx].set(s_new, mode="drop")
+    return pool_q, scales
+
+
+def scatter_window_q(pool_q: jax.Array, scales: jax.Array,
+                     table: jax.Array, pos0: jax.Array,
+                     vals: jax.Array):
+    """`scatter_window` for int8 pools: W sequential frontier RMWs.
+
+    vals [B, W, n_kv, head_dim]. The window's rows land one at a time
+    (a Python-unrolled W-step chain, W is static and small) because
+    consecutive rows often share a block: parallel RMWs would each
+    start from the ORIGINAL block and the last writer would erase its
+    siblings' rows. Sequencing makes row i's RMW see rows < i — the
+    quantized analog of `scatter_window`'s in-order semantics, with
+    the same OOB-drop contract per row. Returns (pool_q, scales)."""
+    for i in range(vals.shape[1]):
+        pool_q, scales = scatter_token_q(pool_q, scales, table,
+                                         pos0 + i, vals[:, i])
+    return pool_q, scales
+
+
+def scatter_blocks_q(pool_q: jax.Array, scales: jax.Array,
+                     bids: jax.Array, rows: jax.Array):
+    """`scatter_blocks` for int8 pools: whole blocks quantize in one
+    shot (no RMW — the writes fully replace their targets). Returns
+    (pool_q, scales)."""
+    q8, s = quantize_blocks(rows)
+    return pool_q.at[bids].set(q8), scales.at[bids].set(s)
+
+
+def scatter_seq_blocks_q(pool_q: jax.Array, scales: jax.Array,
+                         table_row: jax.Array, rows: jax.Array):
+    """`scatter_seq_blocks` for int8 pools (the chunked-prefill
+    splice): every block of one sequence quantizes whole. Trash-pad
+    duplicates behave exactly as in the bf16 splice — garbage blocks
+    get garbage scales, gathered only under exact-zero masks. Returns
+    (pool_q, scales)."""
+    q8, s = quantize_blocks(rows)
+    return (pool_q.at[table_row].set(q8),
+            scales.at[table_row].set(s))
+
+
 def scatter_blocks(pool: jax.Array, bids: jax.Array,
                    rows: jax.Array) -> jax.Array:
     """Bulk-write whole blocks (prefill splice): bids [n] int32, rows
@@ -122,7 +243,9 @@ def scatter_seq_blocks(pool: jax.Array, table_row: jax.Array,
 def paged_decode_attention(q: jax.Array, k_new: jax.Array,
                            v_new: jax.Array, k_pool: jax.Array,
                            v_pool: jax.Array, table: jax.Array,
-                           pos: jax.Array):
+                           pos: jax.Array, k_scale: jax.Array = None,
+                           v_scale: jax.Array = None,
+                           fused: bool = False, interpret=None):
     """One decode step of attention over paged K/V.
 
     q: [B, 1, n_q, head_dim] (post-rope); k_new/v_new: [B, n_kv,
@@ -130,35 +253,62 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
     K exactly like the dense caches); table: [B, max_blocks] int32;
     pos: [B] int32 write/attend positions. Returns (att [B, 1, n_q,
     head_dim], k_pool, v_pool) with the new rows written — write
-    precedes the gather so each slot attends its own fresh token
-    (the mask is `<= pos`, inclusive)."""
-    k_pool = scatter_token(k_pool, table, pos, k_new)
-    v_pool = scatter_token(v_pool, table, pos, v_new)
-    kc = gather_block_kv(k_pool, table)
-    vc = gather_block_kv(v_pool, table)
-    b, _, nq, hd = q.shape
-    nkv = kc.shape[2]
-    g = nq // nkv
-    qg = q.reshape(b, 1, nkv, g, hd)
-    s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
-    kpos = jnp.arange(kc.shape[1])
-    live = kpos[None, :] <= pos[:, None]                # [B, S]
-    s = jnp.where(live[:, None, None, None, :], s, -jnp.inf)
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-    att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, 1, nq, hd)
+    precedes the attention so each slot attends its own fresh token
+    (the mask is `<= pos`, inclusive).
+
+    `fused=True` routes the attention through the Pallas block-table
+    kernel instead of the gather formulation (same writes either way).
+    int8 pools pass k_scale/v_scale ([num_blocks, n_kv] f32): the new
+    rows quantize at write time (frontier RMW) and the return grows to
+    (att, k_pool, v_pool, k_scale, v_scale)."""
+    quant = k_scale is not None
+    if quant:
+        k_pool, k_scale = scatter_token_q(k_pool, k_scale, table, pos,
+                                          k_new)
+        v_pool, v_scale = scatter_token_q(v_pool, v_scale, table, pos,
+                                          v_new)
+    else:
+        k_pool = scatter_token(k_pool, table, pos, k_new)
+        v_pool = scatter_token(v_pool, table, pos, v_new)
+    if fused:
+        att = fused_paged_attention(q, k_pool, v_pool, table, pos,
+                                    k_scale=k_scale, v_scale=v_scale,
+                                    interpret=interpret)
+    else:
+        kc = gather_block_kv(k_pool, table, k_scale, q.dtype)
+        vc = gather_block_kv(v_pool, table, v_scale, q.dtype)
+        b, _, nq, hd = q.shape
+        nkv = kc.shape[2]
+        g = nq // nkv
+        qg = q.reshape(b, 1, nkv, g, hd)
+        s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
+        kpos = jnp.arange(kc.shape[1])
+        live = kpos[None, :] <= pos[:, None]            # [B, S]
+        s = jnp.where(live[:, None, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1
+                           ).astype(q.dtype)
+        att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(
+            q.shape[0], 1, nq, hd)
+    if quant:
+        return att, k_pool, v_pool, k_scale, v_scale
     return att, k_pool, v_pool
 
 
 def paged_window_attention(q: jax.Array, k_new: jax.Array,
                            v_new: jax.Array, k_pool: jax.Array,
                            v_pool: jax.Array, table: jax.Array,
-                           pos0: jax.Array):
+                           pos0: jax.Array, k_scale: jax.Array = None,
+                           v_scale: jax.Array = None,
+                           fused: bool = False, interpret=None):
     """W-token speculative-verify attention over paged K/V.
 
     q: [B, W, n_q, head_dim] (post-rope); k_new/v_new: [B, W, n_kv,
     head_dim] the window's K/V rows; table: [B, max_blocks]; pos0: [B]
     int32 first position per slot (window row i sits at pos0+i).
-    Returns (att [B, W, n_q, head_dim], k_pool, v_pool).
+    Returns (att [B, W, n_q, head_dim], k_pool, v_pool) — plus the
+    updated scales when k_scale/v_scale are given, exactly like
+    `paged_decode_attention`; `fused=True` routes through the Pallas
+    block-table kernel (whose per-window-row horizon mask matches).
 
     Per-query causal horizon: window row i attends positions
     `<= pos0 + i` — exactly the horizon W sequential `scatter_token` +
@@ -167,20 +317,40 @@ def paged_window_attention(q: jax.Array, k_new: jax.Array,
     Rejected draft rows stay in the pool as garbage, which is safe for
     the same write-precedes-gather reason as the dense scratch tail:
     a position is only ever attended once the frontier reaches it, and
-    the frontier only advances past freshly (re)written rows."""
-    k_pool = scatter_window(k_pool, table, pos0, k_new)
-    v_pool = scatter_window(v_pool, table, pos0, v_new)
-    kc = gather_block_kv(k_pool, table)
-    vc = gather_block_kv(v_pool, table)
-    b, w, nq, hd = q.shape
-    nkv = kc.shape[2]
-    g = nq // nkv
-    qg = q.reshape(b, w, nkv, g, hd)
-    s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
-    kpos = jnp.arange(kc.shape[1])
-    posw = pos0[:, None] + jnp.arange(w)[None, :]       # [B, W]
-    live = kpos[None, None, :] <= posw[:, :, None]      # [B, W, S]
-    s = jnp.where(live[:, None, None, :, :], s, -jnp.inf)
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-    att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(b, w, nq, hd)
+    the frontier only advances past freshly (re)written rows. Under
+    int8 that garbage ALSO sits under the block's absmax until
+    rewritten — rejected rows can widen their block's scale, which
+    costs the block's live rows at most one extra requantization
+    rounding, identically on the gather and fused paths."""
+    quant = k_scale is not None
+    if quant:
+        k_pool, k_scale = scatter_window_q(k_pool, k_scale, table,
+                                           pos0, k_new)
+        v_pool, v_scale = scatter_window_q(v_pool, v_scale, table,
+                                           pos0, v_new)
+    else:
+        k_pool = scatter_window(k_pool, table, pos0, k_new)
+        v_pool = scatter_window(v_pool, table, pos0, v_new)
+    if fused:
+        att = fused_paged_attention(q, k_pool, v_pool, table, pos0,
+                                    k_scale=k_scale, v_scale=v_scale,
+                                    interpret=interpret)
+    else:
+        kc = gather_block_kv(k_pool, table, k_scale, q.dtype)
+        vc = gather_block_kv(v_pool, table, v_scale, q.dtype)
+        b, w, nq, hd = q.shape
+        nkv = kc.shape[2]
+        g = nq // nkv
+        qg = q.reshape(b, w, nkv, g, hd)
+        s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / math.sqrt(hd)
+        kpos = jnp.arange(kc.shape[1])
+        posw = pos0[:, None] + jnp.arange(w)[None, :]   # [B, W]
+        live = kpos[None, None, :] <= posw[:, :, None]  # [B, W, S]
+        s = jnp.where(live[:, None, None, :, :], s, -jnp.inf)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1
+                           ).astype(q.dtype)
+        att = jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(
+            b, w, nq, hd)
+    if quant:
+        return att, k_pool, v_pool, k_scale, v_scale
     return att, k_pool, v_pool

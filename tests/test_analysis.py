@@ -435,6 +435,62 @@ def test_hpx009_nested_def_not_attributed_to_hot_parent():
 
 
 # ---------------------------------------------------------------------------
+# HPX010 — full-pool gather outside the paged-attention oracle module
+# ---------------------------------------------------------------------------
+
+HPX010_BAD = """\
+def decode_rows(x, k_pool, v_pool, table):
+    k = k_pool[table]
+    v = v_pool[table]
+    return x, k, v
+"""
+
+HPX010_GOOD = """\
+from hpx_tpu.ops.paged_attention import paged_decode_attention
+
+def decode_rows(x, k_pool, v_pool, table, pos):
+    return paged_decode_attention(x, k_pool, v_pool, table, pos,
+                                  fused=True)
+"""
+
+
+def test_hpx010_fires_per_gather():
+    fs = findings(HPX010_BAD, path=SERVING_PATH)
+    assert rules_of(fs) == ["HPX010", "HPX010"]
+    assert "'k_pool[table]'" in fs[0].message
+
+
+def test_hpx010_fused_route_is_silent():
+    assert findings(HPX010_GOOD, path=SERVING_PATH) == []
+
+
+def test_hpx010_bounded_reads_are_silent():
+    # plural `pools` is the host per-layer list; constant subscripts
+    # read O(1) blocks; `.at[...]` chains are scatters, not gathers
+    src = ("def f(pools, pool, bidx, vals):\n"
+           "    kp, vp = pools[0]\n"
+           "    head = pool[0]\n"
+           "    return kp, vp, head, pool.at[bidx].set(vals)\n")
+    assert findings(src, path=SERVING_PATH) == []
+
+
+def test_hpx010_outside_paged_hot_paths_is_silent():
+    assert findings(HPX010_BAD, path="hpx_tpu/svc/fixture.py") == []
+
+
+def test_hpx010_oracle_sites_are_baselined():
+    # the oracle module's two gathers (reference gather + quantized
+    # frontier RMW) fire and are absorbed — with justification — by
+    # the shipped baseline; a third would fail the gate
+    res = lint_paths(
+        [os.path.join(REPO, "hpx_tpu", "ops", "paged_attention.py")],
+        rules=all_rules(["HPX010"]))
+    assert len(res.findings) == 2
+    new, matched = apply_baseline(res.findings, load_baseline())
+    assert new == [] and matched == 2
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, syntax errors, baseline
 # ---------------------------------------------------------------------------
 
@@ -531,7 +587,7 @@ def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
                    "HPX005", "HPX006", "HPX007", "HPX008",
-                   "HPX009"]
+                   "HPX009", "HPX010"]
 
 
 # ---------------------------------------------------------------------------

@@ -54,6 +54,43 @@ def test_mixed_length_workload_compiles_o_buckets(params):
     assert int(c) <= buckets + 22
 
 
+def test_fused_paged_workload_compiles_o_buckets(params):
+    """The fused-kernel paged server rides the same bucket ladder: the
+    paged step/gather/splice programs are keyed on (kv_dtype,
+    paged_kernel) — constants for a given server — so mixed-length
+    traffic still compiles O(buckets), and flipping the pool dtype
+    re-keys only the pool-dtype programs, never the bucket ladder."""
+    with count_compiles() as c:
+        srv = ContinuousServer(params, CFG, slots=4, smax=64,
+                               prefill_chunk=8, prefill_buckets="4,8",
+                               paged=True, paged_kernel="fused")
+        out = _workload(srv, PLENS, seed=3)
+    assert len(out) == len(PLENS)
+    buckets = len(srv.prefill_buckets)
+    # chunk program per bucket + probe + step + gather + splice
+    assert srv._prog_misses <= buckets + 5
+    assert int(c) <= buckets + 24
+    # a fresh fused server, NEW prompt lengths: total reuse
+    with count_compiles() as c2:
+        srv2 = ContinuousServer(params, CFG, slots=4, smax=64,
+                                prefill_chunk=8, prefill_buckets="4,8",
+                                paged=True, paged_kernel="fused")
+        _workload(srv2, [7, 11, 19, 22], seed=4)
+    assert srv2._prog_misses == 0 and srv2._prog_hits > 0
+    assert int(c2) <= 2
+    # int8 pools: only the kv_dtype-keyed programs rebuild (step,
+    # gather, splice); the bucket-ladder chunk programs are reused
+    with count_compiles() as c3:
+        srv3 = ContinuousServer(params, CFG, slots=4, smax=64,
+                                prefill_chunk=8, prefill_buckets="4,8",
+                                paged=True, paged_kernel="fused",
+                                kv_dtype="int8")
+        out3 = _workload(srv3, PLENS, seed=5)
+    assert len(out3) == len(PLENS)
+    assert srv3._prog_misses <= 5
+    assert int(c3) <= 12
+
+
 def test_new_lengths_reuse_everything(params, recwarn):
     # warm wave (may share compiles with the test above when it ran
     # first — irrelevant, we only pin the SECOND wave)

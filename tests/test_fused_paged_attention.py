@@ -1,0 +1,286 @@
+"""Fused Pallas paged decode attention vs the XLA gather oracle.
+
+`ops/attention_pallas.fused_paged_attention` walks the block table
+in-kernel; `ops/paged_attention`'s gather formulation is the DESIGNATED
+oracle it is pinned against. The numerics contract (see the kernel's
+section comment): bitwise-equal scores and softmax, final logits within
+~1 ulp (the PV contraction is the kernel's 2-D dot vs XLA's batched
+einsum), and therefore EXACT tokens — which the server-level tests here
+assert across dense/paged-gather/paged-fused, greedy/sampled,
+speculative/non-speculative, bf16 and int8 KV. All kernel runs use
+interpret mode off-TPU, so this file is CPU-CI green by construction.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpx_tpu.models import transformer as tfm
+from hpx_tpu.models.serving import ContinuousServer
+from hpx_tpu.ops import attention_pallas as ap
+from hpx_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    paged_window_attention,
+    quantize_blocks,
+    scatter_window_q,
+)
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=2, d_ff=64)
+
+REQS = [dict(prompt=[3, 1, 4], max_new=9),
+        dict(prompt=[2, 7], max_new=5),
+        dict(prompt=[5, 6, 7, 8, 9], max_new=12),
+        dict(prompt=[1], max_new=7),
+        dict(prompt=[9, 9, 2, 1], max_new=3),
+        dict(prompt=[4, 4], max_new=10)]
+
+SAMPLED = [dict(prompt=[3, 1, 4], max_new=8, temperature=0.9,
+                key=jax.random.PRNGKey(7)),
+           dict(prompt=[2, 7, 9], max_new=8, temperature=0.7,
+                key=jax.random.PRNGKey(8)),
+           dict(prompt=[5, 5], max_new=6, temperature=1.3,
+                key=jax.random.PRNGKey(9))]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+# -- op level: fused vs gather ----------------------------------------------
+
+def _paged_state(bs, maxb, B=3, nkv=2, nq=4, hd=8, w=1,
+                 dtype=jnp.float32, seed=0):
+    """Random pools + a shuffled table (logical != physical) + ragged
+    positions, one slot pinned to the partial-first-block corner."""
+    rng = np.random.default_rng(seed)
+    nb = B * maxb + 2
+    kp = jnp.asarray(rng.standard_normal((nb, bs, nkv, hd)), dtype)
+    vp = jnp.asarray(rng.standard_normal((nb, bs, nkv, hd)), dtype)
+    perm = rng.permutation(np.arange(1, nb))[:B * maxb]
+    table = jnp.asarray(perm.reshape(B, maxb).astype(np.int32))
+    pos = rng.integers(0, maxb * bs - w, size=B).astype(np.int32)
+    pos[0] = 1                              # nearly-empty slot
+    pos = jnp.asarray(pos)
+    q = jnp.asarray(rng.standard_normal((B, w, nq, hd)), dtype)
+    knew = rng.standard_normal((B, nkv, hd) if w == 1
+                               else (B, w, nkv, hd))
+    vnew = rng.standard_normal((B, nkv, hd) if w == 1
+                               else (B, w, nkv, hd))
+    return (kp, vp, table, pos, q,
+            jnp.asarray(knew, dtype), jnp.asarray(vnew, dtype))
+
+
+@pytest.mark.parametrize("bs", [8, 16, 32])
+def test_fused_decode_matches_gather(bs):
+    kp, vp, table, pos, q, kn, vn = _paged_state(bs, maxb=3, seed=bs)
+    ag, kg, vg = paged_decode_attention(q, kn, vn, kp, vp, table, pos)
+    af, kf, vf = paged_decode_attention(q, kn, vn, kp, vp, table, pos,
+                                        fused=True, interpret=True)
+    # identical writes (same scatter either way), ulp-tight attention
+    assert (np.asarray(kg) == np.asarray(kf)).all()
+    assert (np.asarray(vg) == np.asarray(vf)).all()
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(af),
+                               rtol=2e-6, atol=2e-6)
+
+
+@pytest.mark.parametrize("bs", [8, 16])
+def test_fused_window_matches_gather(bs):
+    # W=4 verify window, GQA (4 q heads over 2 kv heads), ragged pos0
+    kp, vp, table, pos, q, kn, vn = _paged_state(bs, maxb=3, w=4,
+                                                 seed=100 + bs)
+    ag, _, _ = paged_window_attention(q, kn, vn, kp, vp, table, pos)
+    af, _, _ = paged_window_attention(q, kn, vn, kp, vp, table, pos,
+                                      fused=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(af),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_fused_bf16_stays_within_one_ulp():
+    kp, vp, table, pos, q, kn, vn = _paged_state(16, maxb=2, seed=5,
+                                                 dtype=jnp.bfloat16)
+    ag, _, _ = paged_decode_attention(q, kn, vn, kp, vp, table, pos)
+    af, _, _ = paged_decode_attention(q, kn, vn, kp, vp, table, pos,
+                                      fused=True, interpret=True)
+    # scores+softmax are bitwise-equal; the final bf16 PV cast may
+    # differ by one bf16 ulp where the f32 dots rounded apart
+    np.testing.assert_allclose(np.asarray(ag, np.float32),
+                               np.asarray(af, np.float32),
+                               rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("bs", [8, 16])
+def test_fused_int8_matches_gather_int8(bs):
+    kp, vp, table, pos, q, kn, vn = _paged_state(bs, maxb=3,
+                                                 seed=200 + bs)
+    kq, ks = quantize_blocks(kp)
+    vq, vs = quantize_blocks(vp)
+    ag, kg, vg, ksg, vsg = paged_decode_attention(
+        q, kn, vn, kq, vq, table, pos, k_scale=ks, v_scale=vs)
+    af, kf, vf, ksf, vsf = paged_decode_attention(
+        q, kn, vn, kq, vq, table, pos, k_scale=ks, v_scale=vs,
+        fused=True, interpret=True)
+    # int8 pools and scales update identically; both paths dequantize
+    # with the same elementwise ops, so attention stays ulp-tight
+    assert (np.asarray(kg) == np.asarray(kf)).all()
+    assert (np.asarray(ksg) == np.asarray(ksf)).all()
+    assert (np.asarray(vsg) == np.asarray(vsf)).all()
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(af),
+                               rtol=2e-6, atol=2e-6)
+
+
+# -- quantized scatter: OOB drop regression ---------------------------------
+
+def test_scatter_window_q_oob_drops_rows_and_scales():
+    """A window running past the table's extent must corrupt NOTHING:
+    not the frontier block's content via a clamped write, and not any
+    block's scale via the sidecar's own scatter."""
+    bs, maxb, nkv, hd = 4, 2, 2, 8
+    rng = np.random.default_rng(3)
+    base = jnp.asarray(rng.standard_normal((3, bs, nkv, hd)),
+                       jnp.float32)
+    pq, sc = quantize_blocks(base)
+    table = jnp.asarray([[0, 1]], jnp.int32)
+    # pos0=6: rows 6,7 land in block 1; rows 8,9 are PAST the table
+    vals = jnp.asarray(rng.standard_normal((1, 4, nkv, hd)), jnp.float32)
+    npq, nsc = scatter_window_q(pq, sc, table, jnp.asarray([6]), vals)
+    # unmapped/untouched blocks are bit-identical, scales included —
+    # a clamped OOB write would have hit block 1's rows 0/1 instead
+    assert (np.asarray(npq[0]) == np.asarray(pq[0])).all()
+    assert (np.asarray(npq[2]) == np.asarray(pq[2])).all()
+    assert (np.asarray(nsc[0]) == np.asarray(sc[0])).all()
+    assert (np.asarray(nsc[2]) == np.asarray(sc[2])).all()
+    deq = (np.asarray(npq[1], np.float32)
+           * np.asarray(nsc[1])[None, :, None])
+    orig = np.asarray(base[1])
+    amax = np.abs(np.asarray(vals)).max() + np.abs(orig).max()
+    tol = amax / 127 + 1e-6                 # one quantization step
+    # the two in-range rows hold the window's first two values; the
+    # block's pre-existing rows survive the RMW requantization
+    np.testing.assert_allclose(deq[2], np.asarray(vals[0, 0]), atol=tol)
+    np.testing.assert_allclose(deq[3], np.asarray(vals[0, 1]), atol=tol)
+    np.testing.assert_allclose(deq[:2], orig[:2], atol=tol)
+
+
+# -- block-size resolution ---------------------------------------------------
+
+def test_resolve_paged_block_order(monkeypatch):
+    monkeypatch.setattr(ap, "_paged_blocks_table", {"hd8xint8": 32})
+    monkeypatch.delenv("HPX_PAGED_BLOCK", raising=False)
+    assert ap.resolve_paged_block(8, "int8") == 32     # measured table
+    assert ap.resolve_paged_block(8, "bf16") == 16     # default
+    monkeypatch.setenv("HPX_PAGED_BLOCK", "64")
+    assert ap.resolve_paged_block(8, "int8") == 64     # env wins
+
+
+def test_server_auto_block_size_honors_env(params, monkeypatch):
+    monkeypatch.setenv("HPX_PAGED_BLOCK", "8")
+    srv = ContinuousServer(params, CFG, slots=2, smax=64, paged=True)
+    assert srv.block_size == 8
+
+
+# -- server level: dense == gather == fused ---------------------------------
+
+def _serve(params, reqs, **kw):
+    srv = ContinuousServer(params, CFG, slots=3, smax=64, **kw)
+    for r in reqs:
+        srv.submit(**r)
+    return srv.run(), srv
+
+
+@pytest.mark.parametrize("reqs", [REQS, SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_server_fused_matches_dense_and_gather(params, reqs):
+    dense, _ = _serve(params, reqs)
+    gather, _ = _serve(params, reqs, paged=True, paged_kernel="gather")
+    fused, srv = _serve(params, reqs, paged=True, paged_kernel="fused")
+    assert srv._paged_kernel == "fused"
+    assert fused == gather == dense
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_server_fused_spec_matches_nonspec(params, k):
+    base, _ = _serve(params, REQS)
+    spec, srv = _serve(params, REQS, paged=True, paged_kernel="fused",
+                       spec=True, spec_k=k)
+    assert spec == base
+    assert srv.spec_stats()["emitted"] > 0
+
+
+def test_server_int8_fused_matches_int8_gather_exactly(params):
+    # the int8 hard contract: both formulations see the SAME quantized
+    # pools and dequantize identically, so tokens are identical —
+    # greedy AND sampled, speculative included
+    for reqs in (REQS, SAMPLED):
+        g, _ = _serve(params, reqs, paged=True, paged_kernel="gather",
+                      kv_dtype="int8")
+        f, _ = _serve(params, reqs, paged=True, paged_kernel="fused",
+                      kv_dtype="int8")
+        assert f == g
+    gs, _ = _serve(params, REQS, paged=True, paged_kernel="gather",
+                   kv_dtype="int8", spec=True, spec_k=2)
+    fs, _ = _serve(params, REQS, paged=True, paged_kernel="fused",
+                   kv_dtype="int8", spec=True, spec_k=2)
+    assert fs == gs
+
+
+def test_server_int8_greedy_matches_bf16(params):
+    """Greedy token match under KV quantization on the fixed test
+    workload — the ISSUE's acceptance workload. (Not a general
+    guarantee: quantization MAY flip near-ties on other inputs; here
+    the margins dominate one quantization step.)"""
+    dense, _ = _serve(params, REQS)
+    int8, srv = _serve(params, REQS, paged=True, kv_dtype="int8")
+    assert srv._kv_dtype == "int8"
+    assert int8 == dense
+
+
+def test_server_int8_halves_hbm_read_bytes(params):
+    """The tentpole's bandwidth claim at the accounting boundary:
+    int8 blocks cost ~half of bf16 blocks (scale sidecars keep the
+    ratio just above exactly 0.5), and the live hbm_read_stats()
+    counters report exactly block_bytes() x mid-run occupancy for the
+    pool dtype actually in use (f32 pools on CPU account as f32)."""
+    from hpx_tpu.cache.block_allocator import block_bytes
+
+    nkv, hd, nl = CFG.kv_heads, CFG.head_dim, CFG.n_layers
+    stats = {}
+    for kvd in ("bf16", "int8"):
+        srv = ContinuousServer(params, CFG, slots=2, smax=64,
+                               paged=True, kv_dtype=kvd)
+        for r in REQS[:2]:
+            srv.submit(**r)
+        while srv.step():
+            st = srv.hbm_read_stats()
+            if st["hbm_read_bytes_per_token"]:
+                stats.setdefault(kvd, (st, srv.block_size,
+                                       srv._kv_acct_dtype()))
+    for kvd in ("bf16", "int8"):
+        st, bs, acct = stats[kvd]
+        assert st["hbm_read_blocks_per_token"] > 0
+        assert st["hbm_read_bytes_per_token"] == pytest.approx(
+            st["hbm_read_blocks_per_token"]
+            * block_bytes(bs, nkv, hd, acct, layers=nl))
+    bs = stats["int8"][1]
+    ratio = (block_bytes(bs, nkv, hd, "int8", layers=nl)
+             / block_bytes(bs, nkv, hd, "bf16", layers=nl))
+    assert 0.5 < ratio < 0.6
+
+
+def test_paged_kernel_knob_validation(params):
+    with pytest.raises(ValueError, match="paged_kernel"):
+        ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
+                         paged_kernel="nope")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
+                         kv_dtype="fp4")
+    # the knobs are paged-only
+    with pytest.raises(ValueError):
+        ContinuousServer(params, CFG, slots=2, smax=64,
+                         paged_kernel="fused")
+    with pytest.raises(ValueError):
+        ContinuousServer(params, CFG, slots=2, smax=64, kv_dtype="int8")
