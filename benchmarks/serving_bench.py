@@ -49,9 +49,29 @@ tracer (hpx_tpu.svc.tracing) and a Chrome trace-event JSON — serving
 spans, flow arrows, /serving + /cache counter tracks — is written to
 PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
 
+  7. serving_chaos       — the fault-injection wave (--chaos): the
+                           SAME mixed paged+spec request mix through a
+                           fault-free server and one with a seeded
+                           deterministic fault schedule (decode,
+                           chunked-prefill, spec-verify and
+                           allocator-OOM faults; spec degrades to
+                           sequential after repeated verify faults).
+                           Reports goodput for both runs, restores per
+                           fault class, restore p99, shed/degraded
+                           counts, and the sha256 of every request's
+                           output — the hashes MUST match: recovery
+                           replays from slot checkpoints over
+                           still-resident KV, so a faulted run emits
+                           byte-identical tokens, just later. A second
+                           overload sub-run (100% decode fault rate)
+                           demonstrates typed shedding: the retry
+                           budget exhausts and every request fails
+                           into `srv.failed` instead of hanging.
+
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
                                           [--paged-decode-only]
+                                          [--chaos]
                                           [--trace-out PATH]
 """
 
@@ -252,6 +272,93 @@ def main() -> int:
                       flush=True)
                 raise SystemExit(2)
 
+    # 7. the chaos wave: fault-free vs seeded-fault-schedule runs of
+    # one mixed paged+spec mix. The schedule is chosen so every fault
+    # CLASS recovers at least once: two verify faults walk the spec
+    # degradation ladder (speculation off, sequential decode takes
+    # over — which is what lets the later decode faults fire), a
+    # prefill fault restarts a pending chunked prefill while live
+    # slots restore, and an alloc fault with nothing evictable
+    # (prefix_reuse off) escalates to the step-level restore path.
+    # Identity is CHECKED: both runs' outputs are hashed.
+    def chaos_bench():
+        import hashlib
+        from hpx_tpu.svc import faultinject
+        crng = np.random.default_rng(7)
+        creqs = [(crng.integers(1, 1000,
+                                int(crng.integers(6, 40))).tolist(),
+                  int(crng.integers(16, 33))) for _ in range(10)]
+        ctotal = sum(m for _, m in creqs)
+        SCHEDULE = {"verify": {1, 2}, "prefill": {6},
+                    "decode": {3, 11}, "alloc": {50}}
+
+        def run_wave(fi=None):
+            srv = ContinuousServer(params, cfg, slots=4, smax=128,
+                                   paged=True, block_size=8,
+                                   prefix_reuse=False, spec=True,
+                                   prefill_chunk=8)
+            for p, m in creqs:
+                srv.submit(p, max_new=m)
+            if fi is not None:
+                faultinject.install(fi)
+            t0 = time.perf_counter()
+            try:
+                out = srv.run()
+            finally:
+                faultinject.uninstall()
+            secs = time.perf_counter() - t0
+            sha = hashlib.sha256(json.dumps(
+                [out[r] for r in sorted(out)]).encode()).hexdigest()
+            return srv, out, secs, sha
+
+        run_wave()                                     # compile
+        base_srv, base_out, base_secs, base_sha = run_wave()
+        free0 = base_srv._alloc.stats()["free"]
+        srv, out, secs, sha = run_wave(
+            faultinject.FaultInjector(seed=0, schedule=SCHEDULE))
+        st = srv.fault_stats()
+        goodput = sum(len(t) for t in out.values())
+        emit("serving_chaos", goodput, secs,
+             mix="10 reqs plen6-39 new16-32, paged+spec over 4 slots",
+             fault_schedule={k: sorted(v)
+                             for k, v in SCHEDULE.items()},
+             faultfree_tokens_per_s=round(ctotal / base_secs, 1),
+             injected=st["injected"], recovered=st["restored"],
+             restored_by_site=st["restored_by_site"],
+             restore_p99_ms=round(1e3 * st["restore_p99_s"], 3),
+             shed=st["shed"], degraded=st["degraded"],
+             kv_blocks_leaked=free0 - srv._alloc.stats()["free"],
+             output_sha=sha[:16],
+             output_identical=(sha == base_sha))
+        missing = [s for s in ("decode", "prefill", "verify", "alloc")
+                   if not st["restored_by_site"].get(s)]
+        if sha != base_sha or missing:
+            print(json.dumps({
+                "error": "chaos gate failed",
+                "baseline_sha": base_sha[:16], "chaos_sha": sha[:16],
+                "classes_without_restore": missing}), flush=True)
+            raise SystemExit(2)
+
+        # overload sub-run: every decode dispatch faults, recovery
+        # can never complete a step — the retry budget exhausts and
+        # every request sheds TYPED instead of looping forever
+        srv = ContinuousServer(params, cfg, slots=4, smax=128)
+        for p, m in creqs[:4]:
+            srv.submit(p, max_new=m)
+        faultinject.install(faultinject.FaultInjector(
+            seed=0, rate=1.0, sites=["decode"]))
+        try:
+            shed_out = srv.run()
+        finally:
+            faultinject.uninstall()
+        print(json.dumps({
+            "engine": "serving_chaos_overload",
+            "completed": len(shed_out),
+            "shed_typed": len(srv.failed),
+            "errors": sorted({type(e).__name__
+                              for e in srv.failed.values()}),
+        }), flush=True)
+
     def finish() -> int:
         if tracer is not None:
             from hpx_tpu.svc import tracing
@@ -274,6 +381,10 @@ def main() -> int:
 
     if "--paged-decode-only" in sys.argv:
         paged_decode_bench()
+        return finish()
+
+    if "--chaos" in sys.argv:
+        chaos_bench()
         return finish()
 
     # 1. uniform batched greedy

@@ -542,8 +542,9 @@ class UnbucketedProgramKeyRule(Rule):
 # (HPX009's scope): the decode/speculation dispatch path in
 # models/serving.py.  Admission/prefill code syncs legitimately (seed
 # tokens need VALUES); these functions run once per decode step.
-_SERVING_HOT_FUNCS = ("step", "run", "_flush", "_spec_step",
-                      "_draft_model_tokens", "_prompt_drafts")
+_SERVING_HOT_FUNCS = ("step", "run", "_step_inner", "_flush",
+                      "_spec_step", "_draft_model_tokens",
+                      "_prompt_drafts")
 
 
 @register
@@ -666,3 +667,124 @@ class FullPoolGatherRule(Rule):
                 "attention through paged_decode_attention(..., "
                 "fused=True); XLA-oracle gathers live only in "
                 "ops/paged_attention.py (baselined with justification)")
+
+
+# resiliency-bearing layers where ad-hoc retry/except patterns hide
+# real faults (HPX011's scope): the serving/model layer and the
+# distributed layer — the two places `svc/resiliency` policies exist
+# to replace hand-rolled loops.
+_RESILIENCY_SUBPATHS = ("hpx_tpu/models/", "hpx_tpu/dist/")
+
+# calls that make a retry loop polite: cooperative suspension between
+# attempts (exec.execution_base.suspend / yield_while) or a policy
+# helper that owns backoff itself
+_BACKOFF_CALLEES = {"suspend", "sleep", "yield_while", "sync_replay"}
+
+
+@register
+class NakedRetryRule(Rule):
+    """HPX011: hand-rolled retry loops without backoff, and
+    broad-except swallowing, in the serving (``hpx_tpu/models``) and
+    distributed (``hpx_tpu/dist``) layers.
+
+    Two shapes of quiet fault-amplification:
+
+    * a ``for``/``while`` loop whose body catches an exception and
+      goes around again with NO suspension between attempts — under a
+      persistent fault (allocator exhausted, locality gone) that loop
+      is a busy-wait hammering the failed resource; every retry path
+      owes a cooperative backoff (``exec.execution_base.suspend``,
+      never raw ``time.sleep`` — HPX004) or should route through
+      ``svc.resiliency.sync_replay``/``async_replay``, which own the
+      policy;
+    * ``except Exception:``/``except BaseException:``/bare ``except:``
+      whose handler is only ``pass`` — a swallowed fault in these
+      layers silently corrupts serving state the checkpoint/restore
+      ladder exists to keep consistent.  Faults must be typed,
+      counted, or re-raised.
+
+    The deliberate sites (resiliency's own replay loops live in
+    ``svc/`` and are out of scope; in-scope survivors carry a
+    justification) stay in the baseline; anything new this rule flags
+    is a regression.
+    """
+
+    id = "HPX011"
+    name = "naked-retry"
+    severity = "warning"
+
+    def _loop_retries(self, loop: ast.AST) -> bool:
+        """Does some Try directly in this loop catch-and-continue?"""
+        for node in _walk_function(loop):
+            if isinstance(node, (ast.For, ast.While)):
+                continue          # nested loops report themselves
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                body = h.body
+                if body and isinstance(body[-1], ast.Continue):
+                    return True
+                if all(isinstance(s, ast.Pass) for s in body):
+                    return True
+                # the _replay_loop shape: handler records the
+                # exception (assignment only) and falls through to
+                # the next iteration
+                if body and all(isinstance(s, (ast.Assign, ast.Pass))
+                                for s in body):
+                    return True
+        return False
+
+    def _loop_backs_off(self, loop: ast.AST) -> bool:
+        for node in _walk_function(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else node.func.id
+                    if isinstance(node.func, ast.Name) else "")
+            if name in _BACKOFF_CALLEES:
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_subpath(*_RESILIENCY_SUBPATHS):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            fname = fn.name
+            for node in _walk_function(fn):
+                if isinstance(node, (ast.For, ast.While)):
+                    # a RETRY loop iterates attempts (`while ...` or
+                    # `for _ in range(n)`); a for over a data
+                    # collection with a per-item try is error
+                    # ISOLATION, not a retry of the same operation
+                    if isinstance(node, ast.For) and not (
+                            isinstance(node.iter, ast.Call)
+                            and isinstance(node.iter.func, ast.Name)
+                            and node.iter.func.id == "range"):
+                        continue
+                    if self._loop_retries(node) \
+                            and not self._loop_backs_off(node):
+                        yield self.finding(
+                            ctx, node,
+                            f"retry loop in {fname}() re-attempts "
+                            "with no backoff — a persistent fault "
+                            "turns this into a busy-wait; suspend "
+                            "between attempts (exec.execution_base."
+                            "suspend) or route through svc.resiliency."
+                            "sync_replay, which owns the policy")
+                elif isinstance(node, ast.ExceptHandler):
+                    broad = (node.type is None
+                             or (isinstance(node.type, ast.Name)
+                                 and node.type.id in ("Exception",
+                                                      "BaseException")))
+                    if broad and all(isinstance(s, ast.Pass)
+                                     for s in node.body):
+                        yield self.finding(
+                            ctx, node,
+                            f"broad except swallowed in {fname}() — "
+                            "a pass-only Exception handler hides the "
+                            "faults the restore/shed ladder must see; "
+                            "type it, count it, or re-raise")

@@ -21,7 +21,8 @@ from __future__ import annotations
 
 from typing import Dict, List
 
-from ..core.errors import Error, HpxError
+from ..core.errors import CacheOOM
+from ..svc import faultinject
 from ..synchronization import Mutex
 
 __all__ = ["BlockAllocator", "CacheOOM", "block_bytes",
@@ -58,15 +59,6 @@ def blocks_for_budget(budget_bytes: int, block_size: int, n_kv: int,
     of bf16). Always at least 1 (the reserved trash block)."""
     per = block_bytes(block_size, n_kv, head_dim, kv_dtype, layers)
     return max(1, budget_bytes // per)
-
-
-class CacheOOM(HpxError):
-    """The pool has no free block. Recoverable: evict unreferenced
-    radix chains (`RadixCache.evict`) and retry — the serving loop's
-    OOM→evict→retry path."""
-
-    def __init__(self, message: str = "", function: str = ""):
-        super().__init__(Error.out_of_memory, message, function)
 
 
 class BlockAllocator:
@@ -122,7 +114,11 @@ class BlockAllocator:
 
     def alloc(self) -> int:
         """One fresh block at refcount 1, or CacheOOM when the pool is
-        exhausted (callers evict-and-retry; see serving._alloc_block)."""
+        exhausted (callers evict-and-retry; see serving._alloc_block).
+        An installed fault injector can raise InjectedOOM here — a
+        CacheOOM subclass, so it walks the same evict→retry→shed
+        ladder a genuinely exhausted pool does."""
+        faultinject.check("alloc")
         with self._lock:
             if not self._free:
                 raise CacheOOM(

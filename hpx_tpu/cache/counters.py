@@ -102,6 +102,23 @@ def register_server(srv) -> str:
     put("serving", "programs/cache-misses",
         pc.CallbackCounter(_read(ref, lambda s: s._prog_misses)))
 
+    # fault/recovery ladder observability (svc/faultinject +
+    # ContinuousServer.fault_stats): injected faults seen, step
+    # retries, checkpoint restores, typed sheds, degradations
+    put("serving", "faults/injected",
+        pc.CallbackCounter(_read(ref, lambda s: s._flt_injected)))
+    put("serving", "faults/retried",
+        pc.CallbackCounter(_read(ref, lambda s: s._flt_retried)))
+    put("serving", "faults/restored",
+        pc.CallbackCounter(_read(ref, lambda s: s._flt_restored)))
+    put("serving", "faults/shed",
+        pc.CallbackCounter(_read(ref, lambda s: s._flt_shed)))
+    put("serving", "faults/degraded",
+        pc.CallbackCounter(_read(ref, lambda s: s._flt_degraded)))
+    put("serving", "faults/restore-p99-s",
+        pc.CallbackCounter(_read(ref, lambda s: s.fault_stats()
+                           ["restore_p99_s"])))
+
     if getattr(srv, "_spec", False):
         put("serving", "spec/drafted",
             pc.CallbackCounter(_read(ref, lambda s: s._spec_drafted)))

@@ -71,6 +71,19 @@ DEFAULTS: Dict[str, str] = {
     "hpx.serving.spec.ngram": "3",        # max n-gram for prompt lookup
     "hpx.serving.spec.min_accept": "0.3", # adaptive-k backoff threshold
     "hpx.serving.spec.adapt": "1",        # per-slot adaptive k on/off
+    "hpx.serving.spec.max_verify_faults": "2",  # verify faults before
+                                          # speculation self-disables
+    "hpx.serving.ckpt_every": "16",       # tokens between slot checkpoints
+    "hpx.serving.step_retries": "4",      # step attempts before shedding
+    "hpx.serving.retry_backoff_s": "0.005",  # base step-retry backoff
+    "hpx.serving.admit_retries": "8",     # admit OOM deferrals before shed
+    "hpx.serving.default_deadline_s": "0",  # per-request deadline (0=none)
+    "hpx.fault.enable": "0",              # svc/faultinject master switch
+    "hpx.fault.seed": "0",                # rate-mode RNG seed
+    "hpx.fault.rate": "0.0",              # per-check fault probability
+    "hpx.fault.sites": "",                # csv armed sites ("" = all)
+    "hpx.fault.max": "0",                 # total fault cap (0 = unlimited)
+    "hpx.fault.schedule": "",             # csv "site:nth" exact schedule
     "hpx.trace.enabled": "0",             # svc/tracing off by default
     "hpx.trace.buffer_events": "65536",   # ring capacity (drop-oldest)
     "hpx.trace.counter_interval": "0.05", # s between counter samples

@@ -134,6 +134,17 @@ class DeadlockError(HpxError):
         super().__init__(Error.deadlock, message, function)
 
 
+class CacheOOM(HpxError):
+    """A KV block pool has no free block. Recoverable: evict
+    unreferenced radix chains (`RadixCache.evict`) and retry — the
+    serving loop's OOM→evict→retry path. Lives here (not in
+    `cache/block_allocator`) so `svc/faultinject` can subclass it for
+    injected-OOM faults without a cache↔svc import cycle."""
+
+    def __init__(self, message: str = "", function: str = ""):
+        super().__init__(Error.out_of_memory, message, function)
+
+
 def throw_exception(code: Error, message: str = "", function: str = "") -> None:
     """HPX_THROW_EXCEPTION analog."""
     raise HpxError(code, message, function)

@@ -78,14 +78,23 @@ def resolve_action(name: str) -> Callable:
 
 
 def async_action(action: Any, locality: int, *args: Any, **kwargs: Any) -> Future:
-    """hpx::async(Action{}, id, args...) analog: run on `locality`."""
+    """hpx::async(Action{}, id, args...) analog: run on `locality`.
+
+    Fault site "locality": an installed injector raises LocalityLost
+    (a NetworkError) here — the send path is where a died worker
+    becomes visible to the caller, and NetworkError is what
+    `resiliency.async_replay_distributed` retargets on."""
+    from ..svc import faultinject
     from .runtime import get_runtime
+    faultinject.check("locality", locality=locality)
     return get_runtime().send_action(action, locality, args, kwargs,
                                      want_result=True)
 
 
 def post_action(action: Any, locality: int, *args: Any, **kwargs: Any) -> None:
     """hpx::post(Action{}, id, args...): fire-and-forget."""
+    from ..svc import faultinject
     from .runtime import get_runtime
+    faultinject.check("locality", locality=locality)
     get_runtime().send_action(action, locality, args, kwargs,
                               want_result=False)

@@ -48,6 +48,21 @@ of how the prompt is partitioned into windows (row-independent ops +
 exact-zero causal masking of pad rows), and the first sampled token
 comes from a 1-token logits probe of the last prompt position.
 
+RESILIENCY (ROADMAP item 5): the step loop runs under a bounded
+`svc.resiliency.sync_replay`. Every live slot keeps a host-side
+`SlotCheckpoint` (tokens, position, feedback token, paged block pins)
+captured at flush boundaries every ``hpx.serving.ckpt_every`` tokens;
+a step-level fault — injected via `svc/faultinject`, or a KV-pool OOM
+eviction couldn't clear — flushes the completed suffix, rewinds live
+slots to their checkpoints and replays only the lost tail. The
+differential contract is what makes this sha-provable: replayed steps
+re-emit the SAME tokens, so a faulted run's outputs are byte-identical
+to the fault-free run. Paged restores re-enter from still-resident
+pinned blocks (no recompute); dense restores re-prefill prompt ++
+emitted[:-1] through the bucketed chunk programs. Retry exhaustion,
+admission OOM that outlives ``hpx.serving.admit_retries``, and lapsed
+submit() deadlines shed requests with typed errors into `failed`.
+
 Build on the single-sequence machinery in models/transformer.py; the
 per-row-position block lives here (the scalar-position `_block_decode`
 stays the lean fast path for uniform decode).
@@ -69,7 +84,9 @@ from ..cache.block_allocator import BlockAllocator, CacheOOM, block_bytes
 from ..cache.ngram import propose as _ngram_propose
 from ..cache.page_table import PageTable, materialize, occupancy
 from ..cache.radix import RadixCache
-from ..svc import tracing
+from ..core.errors import Error, HpxError
+from ..svc import faultinject, tracing
+from ..svc.resiliency import sync_replay
 from ..ops.attention_pallas import resolve_paged_block
 from ..ops.paged_attention import (
     gather_block_kv,
@@ -91,7 +108,50 @@ from .transformer import (
     _tree_key,
 )
 
-__all__ = ["ContinuousServer"]
+__all__ = ["ContinuousServer", "DeadlineExceededError",
+           "RequestShedError", "ServerClosedError", "SlotCheckpoint"]
+
+
+class ServerClosedError(HpxError):
+    """submit() after shutdown(). Typed (invalid_status) so a client
+    can tell "server is draining" from a malformed request — before
+    this error existed, post-shutdown submissions enqueued silently
+    onto a server nobody was going to drive."""
+
+    def __init__(self, message: str = ""):
+        super().__init__(Error.invalid_status,
+                         message or "server is shut down — submit() no "
+                         "longer accepts requests (queued and in-flight "
+                         "work still drains via run())",
+                         "ContinuousServer.submit")
+
+
+class RequestShedError(HpxError):
+    """The server gave up on one request: step-retry exhaustion,
+    admission OOM that outlived its deferral budget, or overload.
+    Recorded per-rid in ``ContinuousServer.failed``; the code is
+    service_unavailable — shed work is client-retryable, unlike a
+    bad_parameter rejection."""
+
+    def __init__(self, rid: int, reason: str):
+        super().__init__(Error.service_unavailable,
+                         f"request {rid} shed: {reason}",
+                         "ContinuousServer")
+        self.rid = rid
+        self.reason = reason
+
+
+class DeadlineExceededError(RequestShedError):
+    """Shed because the submit()-time deadline lapsed while the
+    request was still queued or prefilling — the overload fail-fast
+    path (a starving queue sheds instead of aging out)."""
+
+    def __init__(self, rid: int, deadline_s: Optional[float]):
+        RequestShedError.__init__(
+            self, rid,
+            f"deadline of {deadline_s or 0.0:g}s lapsed before the "
+            "request went live")
+        self.deadline_s = deadline_s
 
 
 def _normalize_key(key):
@@ -443,6 +503,40 @@ def _verify_tail(logits, toks, kvec, temp, keys, pos0, width):
 
 
 @dataclasses.dataclass
+class SlotCheckpoint:
+    """Host-side restore point for one LIVE slot, captured at flush
+    boundaries (host and device agree there: ``pos = plen +
+    len(tokens) - 1``, cache rows [0, pos) hold prompt ++ tokens[:-1],
+    and ``cur = tokens[-1]`` is the next feedback token) every
+    ``hpx.serving.ckpt_every`` emitted tokens.
+
+    ``pins`` (paged mode) hold ONE extra allocator reference per FULL
+    block below pos (rows [0, pos - pos % block_size)): the pin keeps
+    eviction and slot-retire from recycling the block, and a full
+    block is append-complete — this slot never writes it again, so
+    the extra ref never provokes a `_cow_guard` fork (pinning the
+    partial frontier block would: refcount >= 2 makes the very next
+    token write fork+copy, one extra block per live slot — fatal in a
+    barely-sized pool). The frontier block's rows [0, pos % bs) need
+    no pin at all: KV rows are append-only (written exactly once, at
+    their position) and a COW fork copies every row written so far,
+    so the slot's CURRENT table always holds them byte-exact. Restore
+    rebuilds the PageTable from pins ++ the live table's frontier
+    block; the replayed decode suffix re-enters from still-resident
+    KV. Dense mode pins nothing and restores by re-prefilling
+    prompt ++ tokens[:-1] (byte-identical: K/V rows are functions of
+    (token, position) alone)."""
+
+    rid: int
+    tokens: List[int]              # emitted tokens at capture (copy)
+    pos: int                       # next write position per invariant
+    cur: int                       # feedback token (= tokens[-1])
+    slot_k: int                    # spec adaptive-k at capture
+    slot_acc: float                # spec acceptance EMA at capture
+    pins: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class _Request:
     rid: int
     prompt: Any                    # [plen] int32 host array
@@ -453,6 +547,8 @@ class _Request:
     tokens: List[int] = dataclasses.field(default_factory=list)
     sent: int = 0                  # tokens DISPATCHED (>= len(tokens))
     t_submit: float = 0.0          # monotonic submit time (TTFT)
+    deadline_s: Optional[float] = None   # submit()-time budget
+    t_deadline: Optional[float] = None   # absolute monotonic deadline
 
 
 @dataclasses.dataclass
@@ -710,6 +806,35 @@ class ContinuousServer:
         self._prog_hits = 0             # program-cache hits
         self._prog_misses = 0           # program-cache misses (compiles)
         self.ttft: Dict[int, float] = {}  # rid -> submit->seed seconds
+        # resiliency: checkpoint cadence, step-retry policy, deadline
+        # and shed accounting (ROADMAP item 5). `failed` is the typed
+        # failure surface — run() keeps returning successes only.
+        self._ckpt_every = max(1, rc.get_int(
+            "hpx.serving.ckpt_every", 16))
+        self._step_retries = max(1, rc.get_int(
+            "hpx.serving.step_retries", 4))
+        self._retry_backoff_s = max(0.0, rc.get_float(
+            "hpx.serving.retry_backoff_s", 0.005))
+        self._admit_retries = max(0, rc.get_int(
+            "hpx.serving.admit_retries", 8))
+        self._default_deadline_s = rc.get_float(
+            "hpx.serving.default_deadline_s", 0.0)
+        self._max_verify_faults = max(1, rc.get_int(
+            "hpx.serving.spec.max_verify_faults", 2))
+        self._ckpt: Dict[int, SlotCheckpoint] = {}
+        self._closed = False
+        self.failed: Dict[int, HpxError] = {}
+        self._admit_defers: Dict[int, int] = {}  # rid -> OOM deferrals
+        self._verify_faults = 0     # consecutive verify-site faults
+        self._spec_degraded = False
+        # /serving{...}/faults/* feed (see fault_stats)
+        self._flt_injected = 0
+        self._flt_retried = 0
+        self._flt_restored = 0
+        self._flt_shed = 0
+        self._flt_degraded = 0
+        self._restored_by_site: Dict[str, int] = {}
+        self._restore_s: List[float] = []
         from ..cache.counters import register_server
         self.counter_instance = register_server(self)
 
@@ -1123,12 +1248,21 @@ class ContinuousServer:
     def _alloc_block(self) -> int:
         """allocator.alloc with the OOM→evict→retry discipline: a full
         pool first evicts the least-recently-used idle radix chain
-        (retained prefixes are a cache, not a reservation)."""
+        (retained prefixes are a cache, not a reservation). Injected
+        OOM faults (`svc/faultinject`, site "alloc") walk the SAME
+        ladder — counted, evicted against, retried — and escalate (to
+        the step-level restore path or the admission defer/shed
+        ladder) only when eviction has nothing left to give."""
         try:
             return self._alloc.alloc()
-        except CacheOOM:
+        except CacheOOM as e:
+            injected = isinstance(e, faultinject.InjectedFault)
+            if injected:
+                self._flt_injected += 1
             if not self._radix.evict(1):
                 raise
+            if injected:
+                self._flt_retried += 1
             return self._alloc.alloc()
 
     def _cow_guard(self, pt: PageTable, bi: int) -> None:
@@ -1265,10 +1399,30 @@ class ContinuousServer:
                                if steps else 0.0,
         }
 
+    def fault_stats(self) -> Dict[str, Any]:
+        """Resiliency observability snapshot — the scalar fields feed
+        the /serving{...}/faults/* performance counters; the chaos
+        bench reads `restored_by_site` for its per-fault-class gate
+        and `restore_p99_s` for the restore-latency column."""
+        rs = sorted(self._restore_s)
+        p99 = rs[max(0, math.ceil(0.99 * len(rs)) - 1)] if rs else 0.0
+        return {
+            "injected": self._flt_injected,
+            "retried": self._flt_retried,
+            "restored": self._flt_restored,
+            "shed": self._flt_shed,
+            "degraded": self._flt_degraded,
+            "restore_p99_s": p99,
+            "restored_by_site": dict(self._restored_by_site),
+        }
+
     # -- public API ------------------------------------------------------
 
     def submit(self, prompt, max_new: int, eos_id: Optional[int] = None,
-               temperature: float = 0.0, key=None) -> int:
+               temperature: float = 0.0, key=None,
+               deadline_s: Optional[float] = None) -> int:
+        if self._closed:
+            raise ServerClosedError()
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("continuous batching needs a non-empty "
@@ -1289,12 +1443,27 @@ class ContinuousServer:
                 "temperature > 0 to sample")
         if key is not None:
             key = _normalize_key(key)
+        if deadline_s is None:
+            deadline_s = self._default_deadline_s or None
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 (got {deadline_s}); omit it "
+                "for no deadline")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt, max_new, eos_id,
-                                    temperature, key,
-                                    t_submit=time.monotonic()))
+        now = time.monotonic()
+        self._queue.append(_Request(
+            rid, prompt, max_new, eos_id, temperature, key,
+            t_submit=now, deadline_s=deadline_s,
+            t_deadline=(now + deadline_s) if deadline_s else None))
         return rid
+
+    def shutdown(self) -> None:
+        """Close the intake: every later submit() raises
+        ServerClosedError. Queued and in-flight requests are NOT
+        cancelled — run()/step() still drain them (graceful drain);
+        their results land in `run()`'s dict as usual."""
+        self._closed = True
 
     # -- chunked prefill -------------------------------------------------
 
@@ -1323,6 +1492,7 @@ class ContinuousServer:
             p = _PendingPrefill(req=req, slot=slot, caches=scratch,
                                 done=0, seq=self._pf_seq)
         self._pending[slot] = p
+        self._admit_defers.pop(req.rid, None)   # admitted: ladder done
         return p
 
     def _start_paged(self, req: "_Request",
@@ -1360,7 +1530,15 @@ class ContinuousServer:
                                trow=trow, wrow=wrow)
 
     def _advance_chunk(self, p: _PendingPrefill) -> None:
-        """Run ONE bucketed chunk of p's prompt into its scratch."""
+        """Run ONE bucketed chunk of p's prompt into its scratch.
+
+        Fault site "prefill": the check fires BEFORE the chunk
+        dispatch and before any host mutation, so a fault here leaves
+        the pending internally consistent — recovery restarts it from
+        the prompt (`_restart_pending`; paged restarts re-match the
+        radix prefix, so already-resident blocks are not recomputed).
+        """
+        faultinject.check("prefill")
         req, plen = p.req, len(p.req.prompt)
         n = min(self.prefill_chunk, plen - p.done)
         width = self._bucket_width(n)
@@ -1423,6 +1601,10 @@ class ContinuousServer:
             if self._draft_params is not None:
                 self._draft_prefill(slot, req.prompt)
         self.ttft[req.rid] = time.monotonic() - req.t_submit
+        # seed checkpoint: a fault before the first cadence capture
+        # restores to the freshly-admitted state instead of losing the
+        # slot (the seed token is already part of the checkpoint)
+        self._capture(slot)
         self._maybe_retire(slot)
 
     def _admit(self) -> None:
@@ -1437,25 +1619,59 @@ class ContinuousServer:
         instant eos) frees its slot immediately — the inner loop
         re-scans the same slot within this pass, so a burst of
         one-token requests drains through one slot without burning a
-        full decode step per request on an empty batch."""
+        full decode step per request on an empty batch.
+
+        Admission OOM (the pool is full and `_alloc_block`'s
+        evict→retry already failed, or an injected alloc fault
+        escalated) walks `_defer_admit`'s ladder: requeue at the front
+        for up to hpx.serving.admit_retries passes — retirements
+        between steps free blocks — then shed with a typed error."""
         for slot in range(self.slots):
             while (self._slot_req[slot] is None
                    and slot not in self._pending and self._queue):
                 req = self._queue.popleft()
                 plen = len(req.prompt)
-                with tracing.span("serving.admit", "serving",
-                                  rid=req.rid, slot=slot, plen=plen):
-                    p = self._start_prefill(req, slot)
-                    if p.remaining <= self.prefill_chunk:
-                        with tracing.span("serving.prefill", "serving",
-                                          rid=req.rid, plen=plen,
-                                          matched=p.done,
-                                          suffix=p.remaining):
-                            self._advance_chunk(p)
-                            self._finish_prefill(p)
-                    else:
-                        p.flow = tracing.flow_begin(
-                            "serving.prefill_chunks")
+                try:
+                    with tracing.span("serving.admit", "serving",
+                                      rid=req.rid, slot=slot,
+                                      plen=plen):
+                        p = self._start_prefill(req, slot)
+                        if p.remaining <= self.prefill_chunk:
+                            with tracing.span("serving.prefill",
+                                              "serving", rid=req.rid,
+                                              plen=plen,
+                                              matched=p.done,
+                                              suffix=p.remaining):
+                                self._advance_chunk(p)
+                                self._finish_prefill(p)
+                        else:
+                            p.flow = tracing.flow_begin(
+                                "serving.prefill_chunks")
+                except CacheOOM as e:
+                    if slot in self._pending:
+                        self._drop_pending(slot)
+                    if not self._defer_admit(req, e):
+                        return   # deferred: give retirements a step
+                                 # to free blocks before re-admitting
+
+    def _defer_admit(self, req: "_Request", exc: CacheOOM) -> bool:
+        """Admission OOM ladder, entered after evict→retry failed:
+        requeue the request at the FRONT (bounded by
+        hpx.serving.admit_retries), then shed. Returns True when the
+        request was shed (the admit pass may continue with the next
+        request), False when deferred (the pass should stop)."""
+        n = self._admit_defers.get(req.rid, 0) + 1
+        if n > self._admit_retries:
+            self._admit_defers.pop(req.rid, None)
+            self._shed_req(req, RequestShedError(
+                req.rid,
+                f"admission OOM persisted through {n} attempts "
+                f"({exc})"))
+            return True
+        self._admit_defers[req.rid] = n
+        self._flt_retried += 1
+        self._queue.appendleft(req)
+        return False
 
     def _prefill_tick(self) -> None:
         """Advance chunked prefills: ONE chunk per step, given to the
@@ -1597,6 +1813,11 @@ class ContinuousServer:
                           width=width, drafted=drafted,
                           slots=len(live)):
             tracing.flow_end(f_verify, "serving.spec.verify")
+            # fault site "verify": before the window dispatch and
+            # before any host commit — a fault here costs only the
+            # (restorable) draft-cache advance; repeated ones walk the
+            # degradation ladder in _recover and turn speculation off
+            faultinject.check("verify")
             pos = jnp.asarray(self._pos, jnp.int32)
             kvec = jnp.asarray(kvec_host, jnp.int32)
             if self._temp_dev is None:
@@ -1648,6 +1869,270 @@ class ContinuousServer:
         self._spec_emitted += emitted_total
         self._rate.mark(float(emitted_total))
         self._cur_dev = None
+        self._verify_faults = 0    # a committed verify resets the
+                                   # degradation ladder
+        self._ckpt_sweep()         # spec commits are flush boundaries
+
+    # -- checkpoint / restore / shed (ROADMAP item 5) --------------------
+
+    def _capture(self, slot: int) -> None:
+        """Snapshot one live slot's restore point. Callers guarantee
+        flush-consistency (``req.sent == len(req.tokens)``); paged
+        pins take one extra ref per FULL block below pos — never the
+        partial frontier block, whose pin would force a COW fork on
+        the next token write (see SlotCheckpoint)."""
+        req = self._slot_req[slot]
+        pos = self._pos[slot]
+        pins: List[int] = []
+        if self.paged:
+            pt = self._tables[slot]
+            pins = list(pt.blocks[:pos // self.block_size])
+            for bid in pins:
+                self._alloc.incref(bid)
+        old = self._ckpt.get(slot)
+        self._ckpt[slot] = SlotCheckpoint(
+            rid=req.rid, tokens=list(req.tokens), pos=pos,
+            cur=self._cur[slot], slot_k=self._slot_k[slot],
+            slot_acc=self._slot_acc[slot], pins=pins)
+        if old is not None:
+            for bid in old.pins:
+                self._alloc.decref(bid)
+
+    def _drop_ckpt(self, slot: int) -> None:
+        ck = self._ckpt.pop(slot, None)
+        if ck is not None:
+            for bid in ck.pins:
+                self._alloc.decref(bid)
+
+    def _ckpt_sweep(self) -> None:
+        """Advance checkpoints at a flush boundary: every live slot
+        whose emissions grew by >= hpx.serving.ckpt_every since its
+        last capture (or whose checkpoint is missing/stale) captures
+        now. Runs at the end of _flush and after spec commits — the
+        two points where host and device state provably agree."""
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None or req.sent != len(req.tokens):
+                continue
+            ck = self._ckpt.get(s)
+            if (ck is None or ck.rid != req.rid
+                    or len(req.tokens) - len(ck.tokens)
+                    >= self._ckpt_every):
+                self._capture(s)
+
+    def _restore_slot(self, slot: int) -> None:
+        """Rewind one live slot to its last checkpoint; the decode
+        loop then replays ONLY the lost suffix. Paged: rebuild the
+        table from the pinned full blocks plus the live table's
+        frontier block — its rows [0, pos % bs) are byte-exact
+        because KV rows are append-only and COW forks copy every row
+        written so far. Dense: re-prefill prompt ++ tokens[:-1] through
+        the bucketed chunk programs (byte-identical rows by the
+        differential contract). Replayed tokens re-emit identically,
+        so a restored run's outputs match the fault-free run."""
+        ck = self._ckpt[slot]
+        req = self._slot_req[slot]
+        with tracing.span("serving.restore", "serving", rid=req.rid,
+                          slot=slot, pos=ck.pos,
+                          replayed=len(req.tokens) - len(ck.tokens)):
+            req.tokens = list(ck.tokens)
+            req.sent = len(req.tokens)
+            self._pos[slot] = ck.pos
+            self._cur[slot] = ck.cur
+            self._slot_k[slot] = ck.slot_k
+            self._slot_acc[slot] = ck.slot_acc
+            if self.paged:
+                pt = self._tables[slot]
+                # pins cover the full blocks; the frontier block (if
+                # ck.pos is not block-aligned) rides over from the
+                # current table — it covered ck.pos at capture and
+                # tables only grow, so it is still there
+                keep = list(ck.pins)
+                if pt is not None and ck.pos % self.block_size:
+                    keep.append(pt.blocks[ck.pos // self.block_size])
+                npt = PageTable(self.block_size)
+                for bid in keep:
+                    self._alloc.incref(bid)   # the new table's refs
+                npt.extend_blocks(keep)
+                npt.tokens = ck.pos
+                if pt is not None:            # AFTER increfs: shared
+                    for bid in pt.blocks:     # bids must not hit 0
+                        self._alloc.decref(bid)
+                self._tables[slot] = npt
+            else:
+                self._reprefill_dense(slot, req.prompt
+                                      + req.tokens[:-1])
+            if self._spec and self._draft_params is not None:
+                self._draft_prefill(slot, req.prompt
+                                    + req.tokens[:-1])
+        self._flt_restored += 1
+
+    def _reprefill_dense(self, slot: int, seq: List[int]) -> None:
+        """Dense restore path: rebuild the slot's cache rows
+        [0, len(seq)) by re-running bucketed prefill over the known
+        token sequence into a fresh b=1 scratch, then splice. No
+        probe: the checkpoint already knows the feedback token."""
+        nkv, hd = self.cfg.kv_heads, self.cfg.head_dim
+
+        def z():
+            return jnp.zeros((1, self.smax, nkv, hd), self.cfg.dtype)
+        scratch = [(z(), z()) for _ in range(self.cfg.n_layers)]
+        done = 0
+        while done < len(seq):
+            n = min(self.prefill_chunk, len(seq) - done)
+            width = self._bucket_width(n)
+            toks = seq[done:done + n] + [0] * (width - n)
+            scratch = self._chunk_prog(width)(
+                self.params, scratch,
+                jnp.asarray([toks], jnp.int32),
+                jnp.asarray(done, jnp.int32))
+            done += n
+        self._caches = self._splice_prog()(
+            self._caches, scratch, jnp.asarray(slot, jnp.int32))
+
+    def _drop_pending(self, slot: int) -> _PendingPrefill:
+        """Tear down one in-flight prefill (blocks decref'd, trace
+        flow closed) and return it for requeue/restart."""
+        p = self._pending.pop(slot)
+        if p.flow is not None:
+            tracing.flow_end(p.flow, "serving.prefill_chunks")
+            p.flow = None
+        if p.pt is not None:
+            for bid in p.pt.blocks:
+                self._alloc.decref(bid)
+            p.pt = None
+        return p
+
+    def _restart_pending(self, slot: int) -> None:
+        """Faulted mid-chunked-prefill: drop the pending's scratch and
+        blocks and start over from the prompt — `_start_prefill`
+        re-matches the radix prefix, so the paged restart recomputes
+        only what was never resident. OOM on the restart requeues the
+        request instead of failing recovery."""
+        p = self._drop_pending(slot)
+        try:
+            self._start_prefill(p.req, slot)
+        except CacheOOM:
+            self._queue.appendleft(p.req)
+
+    def _recover(self, attempt: int, exc: BaseException) -> None:
+        """sync_replay's on_retry hook: repair serving state after a
+        step-level fault so the retry runs against a consistent world.
+        Every injection site raises BEFORE its jit dispatch, so each
+        BUFFERED step is a completed device op: flush first (those
+        tokens are real), then rewind live slots to their checkpoints
+        and restart in-flight prefills. Device-side mirrors of the
+        per-slot host vectors reset and rebuild on the next dispatch.
+        """
+        t0 = time.monotonic()
+        site = getattr(exc, "site", type(exc).__name__)
+        if isinstance(exc, faultinject.InjectedFault) \
+                and not isinstance(exc, faultinject.InjectedOOM):
+            self._flt_injected += 1   # OOMs were counted at the ladder
+        self._flt_retried += 1
+        if site == "verify":
+            self._verify_faults += 1
+            if (self._spec and not self._spec_degraded
+                    and self._verify_faults
+                    >= self._max_verify_faults):
+                # degradation ladder: repeated verify faults turn
+                # speculation OFF — sequential steps emit the same
+                # tokens (differential contract), only the
+                # tokens-per-sync multiplier is lost
+                self._spec = False
+                self._spec_degraded = True
+                self._flt_degraded += 1
+                tracing.instant("serving.spec_degraded", "serving",
+                                faults=self._verify_faults)
+        self._flush()
+        restored = 0
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            ck = self._ckpt.get(s)
+            if ck is not None and ck.rid == req.rid:
+                self._restore_slot(s)
+                restored += 1
+            else:
+                # unreachable while admission seeds a checkpoint, but
+                # shedding beats decoding from corrupt state
+                self._slot_req[s] = None
+                self._drop_ckpt(s)
+                if self.paged:
+                    self._release_slot(s, req)
+                self._shed_req(req, RequestShedError(
+                    req.rid, "no checkpoint to restore from"))
+        for s in list(self._pending):
+            self._restart_pending(s)
+        self._cur_dev = None
+        self._temp_dev = None
+        self._keys_dev = None
+        if restored:
+            self._restored_by_site[site] = \
+                self._restored_by_site.get(site, 0) + 1
+            self._restore_s.append(time.monotonic() - t0)
+
+    def _shed_req(self, req: "_Request", err: HpxError) -> None:
+        """Fail one request with a typed error, surfaced via `failed`
+        (run() keeps returning successes only)."""
+        with tracing.span("serving.shed", "serving", rid=req.rid,
+                          reason=type(err).__name__):
+            self.failed[req.rid] = err
+            self._admit_defers.pop(req.rid, None)
+            self._flt_shed += 1
+
+    def _shed_expired(self) -> None:
+        """Deadline policy: a queued or still-prefilling request whose
+        submit()-time deadline lapsed sheds NOW — overload fails fast
+        with a typed error instead of starving the queue. Live decode
+        slots are exempt: they already hold device state and their
+        remaining tokens are the cheapest in the system."""
+        now = time.monotonic()
+        if any(r.t_deadline is not None for r in self._queue):
+            keep: deque = deque()
+            while self._queue:
+                req = self._queue.popleft()
+                if req.t_deadline is not None \
+                        and now >= req.t_deadline:
+                    self._shed_req(req, DeadlineExceededError(
+                        req.rid, req.deadline_s))
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for s, p in list(self._pending.items()):
+            req = p.req
+            if req.t_deadline is not None and now >= req.t_deadline:
+                self._drop_pending(s)
+                self._shed_req(req, DeadlineExceededError(
+                    req.rid, req.deadline_s))
+
+    def _shed_everything(self, exc: BaseException) -> None:
+        """Step-retry budget exhausted: fail FAST and typed. Completed
+        requests keep their results (the flush below finalizes any
+        whose tokens were still buffered); every in-flight and queued
+        request sheds into `failed` — run() terminates instead of
+        spinning on a fault that recovery could not clear."""
+        self._flush()
+        reason = f"step retries exhausted ({exc})"
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            self._slot_req[s] = None
+            self._drop_ckpt(s)
+            if self.paged:
+                self._release_slot(s, req)
+            self._shed_req(req, RequestShedError(req.rid, reason))
+        for s in list(self._pending):
+            p = self._drop_pending(s)
+            self._shed_req(p.req, RequestShedError(p.req.rid, reason))
+        while self._queue:
+            q = self._queue.popleft()
+            self._shed_req(q, RequestShedError(q.rid, reason))
+        self._cur_dev = None
+        self._temp_dev = None
+        self._keys_dev = None
 
     # -- retirement ------------------------------------------------------
 
@@ -1679,6 +2164,7 @@ class ContinuousServer:
             self._done[req.rid] = req.tokens
             if self._slot_req[slot] is req:
                 self._slot_req[slot] = None
+                self._drop_ckpt(slot)
                 if self.paged:
                     self._release_slot(slot, req)
 
@@ -1697,11 +2183,34 @@ class ContinuousServer:
                            and t == req.eos_id)
                 if hit_eos or len(req.tokens) >= req.max_new:
                     self._finalize(s, req, hit_eos)
+        self._ckpt_sweep()
 
     def step(self) -> bool:
         """Admit + one prefill chunk + one decode step for every live
-        slot. Returns True while any work remains (live slots, pending
-        prefills, or queued requests)."""
+        slot, wrapped in the recovery ladder. Returns True while any
+        work remains (live slots, pending prefills, or queued
+        requests).
+
+        An injected/transient fault in the step body replays it up to
+        ``hpx.serving.step_retries`` times through `sync_replay`;
+        `_recover` runs before each retry (flush → restore slots from
+        checkpoints → restart pendings), so the replay decodes the lost
+        suffix against intact KV state and emits the SAME tokens the
+        fault-free run would (differential contract). If the retry
+        budget exhausts, every in-flight request sheds with a typed
+        error into `failed` and the loop moves on."""
+        self._shed_expired()
+        try:
+            return sync_replay(
+                self._step_retries, self._step_inner,
+                retry_on=(faultinject.InjectedFault, CacheOOM),
+                on_retry=self._recover,
+                backoff_s=self._retry_backoff_s)
+        except (faultinject.InjectedFault, CacheOOM) as e:
+            self._shed_everything(e)
+            return bool(self._queue or self._pending)
+
+    def _step_inner(self) -> bool:
         self._admit()
         self._prefill_tick()
         live = [s for s in range(self.slots)
@@ -1719,6 +2228,11 @@ class ContinuousServer:
         with tracing.span("serving.decode", "serving",
                           live=len(live),
                           rids=[self._slot_req[s].rid for s in live]):
+            # fault site "decode": before the step dispatch and before
+            # any host bookkeeping commits — at this point every
+            # BUFFERED step already completed on device, so recovery's
+            # flush-then-restore loses nothing
+            faultinject.check("decode")
             # dense: dead slots re-write their own last position
             # (harmless: never read — admission overwrites rows
             # 0..plen first). Paged: dead slots' tables are all-trash,
@@ -1762,6 +2276,7 @@ class ContinuousServer:
                     # NOW (admissible next step); token values land at
                     # the flush this triggers
                     self._slot_req[s] = None
+                    self._drop_ckpt(s)
                     if self.paged:
                         self._release_slot(s, req)
                     need_sync = True
@@ -1772,7 +2287,9 @@ class ContinuousServer:
 
     def run(self) -> Dict[int, List[int]]:
         """Drive step() until every submitted request finishes; returns
-        {request_id: tokens} (each exactly generate()'s output)."""
+        {request_id: tokens} (each exactly generate()'s output).
+        Requests shed by deadline/overload/retry-exhaustion are NOT in
+        the result — their typed errors are in `self.failed`."""
         while self.step():
             pass
         out, self._done = self._done, {}

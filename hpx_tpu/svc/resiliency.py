@@ -86,6 +86,53 @@ def async_replay_validate(n: int, validate: Callable[[Any], bool],
     return async_(_replay_loop, n, validate, fn, args, kwargs)
 
 
+def sync_replay(n: int, fn: Callable[..., Any], *args: Any,
+                retry_on: tuple = (Exception,),
+                on_retry: Optional[Callable[[int, BaseException],
+                                            None]] = None,
+                backoff_s: float = 0.0,
+                backoff_factor: float = 2.0,
+                max_backoff_s: float = 1.0,
+                **kwargs: Any) -> Any:
+    """Policy-carrying synchronous replay — `_replay_loop` grown the
+    three knobs a RECOVERING caller (vs a merely retrying one) needs:
+
+    * ``retry_on`` — only these exception types are transient; anything
+      else propagates immediately (a logic bug must not be retried into
+      n copies of itself). AbortReplayException always propagates.
+    * ``on_retry(attempt, exc)`` — runs BEFORE each re-attempt; this is
+      where the serving loop repairs state (restore slots from
+      checkpoints) so the replay hits a consistent world. If repair
+      itself raises, that propagates: retrying on broken state would
+      corrupt, not recover.
+    * ``backoff_s`` — exponential backoff between attempts
+      (``backoff_s * backoff_factor**i``, capped at ``max_backoff_s``),
+      slept via the cooperative `suspend` so an hpx-thread caller
+      yields its worker instead of blocking it (and so this stays off
+      hpxlint HPX004's raw-time.sleep list).
+
+    Synchronous by design: the serving step IS the caller's loop body —
+    wrapping it in a Future (async_replay) would add a pool hop per
+    step for nothing.
+    """
+    from ..exec.execution_base import suspend
+    last_exc: Optional[BaseException] = None
+    for attempt in range(n):
+        if attempt > 0:
+            if backoff_s > 0.0:
+                suspend(min(backoff_s * backoff_factor ** (attempt - 1),
+                            max_backoff_s))
+            if on_retry is not None:
+                on_retry(attempt, last_exc)
+        try:
+            return fn(*args, **kwargs)
+        except AbortReplayException:
+            raise
+        except retry_on as e:
+            last_exc = e
+    raise last_exc
+
+
 # ---------------------------------------------------------------------------
 # replicate
 # ---------------------------------------------------------------------------

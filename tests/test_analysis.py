@@ -491,6 +491,111 @@ def test_hpx010_oracle_sites_are_baselined():
 
 
 # ---------------------------------------------------------------------------
+# HPX011 — naked retry loops / broad-except swallowing in models+dist
+# ---------------------------------------------------------------------------
+
+HPX011_RETRY_BAD = """\
+def fetch(conn):
+    for attempt in range(5):
+        try:
+            return conn.read()
+        except IOError:
+            continue
+"""
+
+HPX011_RETRY_GOOD = """\
+from hpx_tpu.exec.execution_base import suspend
+
+def fetch(conn):
+    for attempt in range(5):
+        try:
+            return conn.read()
+        except IOError:
+            suspend(0.01 * attempt)
+            continue
+"""
+
+HPX011_SWALLOW_BAD = """\
+def close(srv):
+    try:
+        srv.stop()
+    except Exception:
+        pass
+"""
+
+
+def test_hpx011_retry_without_backoff_fires():
+    fs = findings(HPX011_RETRY_BAD, path="hpx_tpu/models/fixture.py")
+    assert rules_of(fs) == ["HPX011"]
+    assert "fetch()" in fs[0].message and "backoff" in fs[0].message
+
+
+def test_hpx011_backoff_between_attempts_is_silent():
+    assert findings(HPX011_RETRY_GOOD,
+                    path="hpx_tpu/models/fixture.py") == []
+
+
+def test_hpx011_sync_replay_route_is_silent():
+    src = ("from hpx_tpu.svc.resiliency import sync_replay\n"
+           "def fetch(conn):\n"
+           "    return sync_replay(5, conn.read, backoff_s=0.01)\n")
+    assert findings(src, path="hpx_tpu/models/fixture.py") == []
+
+
+def test_hpx011_while_retry_fires():
+    src = ("def poke(res):\n"
+           "    while True:\n"
+           "        try:\n"
+           "            return res.acquire_()\n"
+           "        except KeyError:\n"
+           "            continue\n")
+    fs = findings(src, path="hpx_tpu/dist/fixture.py")
+    assert rules_of(fs) == ["HPX011"]
+
+
+def test_hpx011_data_loop_error_isolation_is_silent():
+    # a for over a DATA collection with per-item try is isolation,
+    # not a retry of the same operation (dist.runtime's counter dump)
+    src = ("def dump(patterns):\n"
+           "    for p in patterns:\n"
+           "        try:\n"
+           "            print(p)\n"
+           "        except ValueError:\n"
+           "            continue\n")
+    assert findings(src, path="hpx_tpu/dist/fixture.py") == []
+
+
+def test_hpx011_broad_swallow_fires():
+    fs = findings(HPX011_SWALLOW_BAD, path="hpx_tpu/models/fixture.py")
+    assert rules_of(fs) == ["HPX011"]
+    assert "close()" in fs[0].message
+
+
+def test_hpx011_typed_or_handled_except_is_silent():
+    # a typed except, and a broad one that actually DOES something,
+    # are both fine — only pass-only Exception swallows fire
+    src = ("def close(srv, log):\n"
+           "    try:\n"
+           "        srv.stop()\n"
+           "    except ValueError:\n"
+           "        pass\n"
+           "    try:\n"
+           "        srv.join()\n"
+           "    except Exception as e:\n"
+           "        log.warn(e)\n")
+    assert findings(src, path="hpx_tpu/models/fixture.py",
+                    select=["HPX011"]) == []
+
+
+def test_hpx011_outside_resiliency_layers_is_silent():
+    assert findings(HPX011_RETRY_BAD,
+                    path="hpx_tpu/svc/fixture.py") == []
+    assert findings(HPX011_SWALLOW_BAD,
+                    path="hpx_tpu/algo/fixture.py",
+                    select=["HPX011"]) == []
+
+
+# ---------------------------------------------------------------------------
 # engine: suppressions, syntax errors, baseline
 # ---------------------------------------------------------------------------
 
@@ -587,7 +692,7 @@ def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
                    "HPX005", "HPX006", "HPX007", "HPX008",
-                   "HPX009", "HPX010"]
+                   "HPX009", "HPX010", "HPX011"]
 
 
 # ---------------------------------------------------------------------------
