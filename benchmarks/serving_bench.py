@@ -68,10 +68,25 @@ PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
                            budget exhausts and every request fails
                            into `srv.failed` instead of hanging.
 
+  8. serving_disagg      — the disaggregated wave (--disagg): one
+                           Poisson-arrival mix (Zipf-shared prefixes,
+                           70/30 interactive/batch SLO classes)
+                           through a colocated paged server and a
+                           DisaggRouter (2 prefill + 2 decode
+                           workers). Reports TTFT p50/p95/p99, decode
+                           stall p50/p99 (inter-step gap while slots
+                           are live) and goodput for BOTH topologies.
+                           With --chaos as well, a sub-run kills one
+                           worker of each role mid-flight (seeded
+                           disagg.prefill/disagg.decode schedule) and
+                           GATES on: sha-identical tokens to the
+                           fault-free disagg run, >=1 failover per
+                           role, zero leaked KV blocks.
+
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
                                           [--paged-decode-only]
-                                          [--chaos]
+                                          [--chaos] [--disagg]
                                           [--trace-out PATH]
 """
 
@@ -359,6 +374,148 @@ def main() -> int:
                               for e in srv.failed.values()}),
         }), flush=True)
 
+    # 8. the disaggregated wave: Poisson arrivals over Zipf-shared
+    # prefixes with a 70/30 interactive/batch SLO mix, measured twice —
+    # colocated paged server vs DisaggRouter — with identical request
+    # streams. Percentiles are wall-clock (TTFT = submit->first token;
+    # decode stall = inter-step gap while any request is live), so this
+    # wave is a latency-shape comparison, not a correctness gate —
+    # except under --chaos, where a seeded kill of one worker per role
+    # must leave tokens sha-identical and leak zero KV blocks.
+    def disagg_bench(chaos: bool) -> None:
+        import hashlib
+        from hpx_tpu.models.disagg import DisaggRouter
+        from hpx_tpu.svc import faultinject
+
+        drng = np.random.default_rng(11)
+        npfx = 6
+        prefixes = [drng.integers(1, 1000, 32).tolist()
+                    for _ in range(npfx)]
+        # Zipf over the prefix pool: rank r drawn with weight 1/r
+        zw = np.array([1.0 / (r + 1) for r in range(npfx)])
+        zw /= zw.sum()
+        nreq = 12
+        arrivals = np.cumsum(drng.exponential(0.05, nreq))  # Poisson
+        wave = []
+        for i in range(nreq):
+            pfx = prefixes[int(drng.choice(npfx, p=zw))]
+            tail = drng.integers(1, 1000,
+                                 int(drng.integers(4, 12))).tolist()
+            slo = "interactive" if drng.random() < 0.7 else "batch"
+            wave.append((pfx + tail, int(drng.integers(12, 25)),
+                         slo, float(arrivals[i])))
+        wtotal = sum(m for _, m, _, _ in wave)
+
+        def pctl(xs, q):
+            return round(float(np.percentile(xs, q)) * 1e3, 2) \
+                if xs else None
+
+        def drive(submit, step, ttft_of):
+            """Poisson-paced open loop: submit at arrival offsets,
+            step in between; returns (outputs, secs, stalls)."""
+            t0 = time.perf_counter()
+            pending = list(enumerate(wave))
+            stalls, live, last = [], False, t0
+            out = None
+            while pending or out is None or out:
+                now = time.perf_counter() - t0
+                while pending and pending[0][1][3] <= now:
+                    _, (p, m, slo, _) = pending.pop(0)
+                    submit(p, m, slo)
+                out = step()
+                t = time.perf_counter()
+                if live:
+                    stalls.append(t - last)
+                live, last = bool(out), t
+            return time.perf_counter() - t0, stalls
+
+        def run_colocated():
+            srv = ContinuousServer(params, cfg, slots=4, smax=96,
+                                   paged=True)
+            secs, stalls = drive(
+                lambda p, m, slo: srv.submit(p, max_new=m),
+                srv.step, None)
+            out = dict(srv._done)
+            return out, dict(srv.ttft), secs, stalls
+
+        def run_disagg(fi=None):
+            if fi is not None:
+                faultinject.install(fi)
+            try:
+                r = DisaggRouter(params, cfg, prefill_workers=2,
+                                 decode_workers=2, slots=4, smax=96)
+                secs, stalls = drive(
+                    lambda p, m, slo: r.submit(p, m, slo=slo),
+                    r.step, None)
+                out = dict(r.results)
+                st = r.stats()
+                r.close()
+                leak = r.leaked_blocks()
+            finally:
+                if fi is not None:
+                    faultinject.uninstall()
+            return out, dict(r.ttft), secs, stalls, st, leak
+
+        def sha(out):
+            return hashlib.sha256(json.dumps(
+                [out[r] for r in sorted(out)]).encode()).hexdigest()
+
+        run_colocated()                                # compile
+        run_disagg()                                   # compile
+        co_out, co_ttft, co_secs, co_stalls = run_colocated()
+        dg_out, dg_ttft, dg_secs, dg_stalls, dg_st, dg_leak = \
+            run_disagg()
+        for name, out, ttft, secs, stalls, extra in (
+                ("serving_colocated", co_out, co_ttft, co_secs,
+                 co_stalls, {}),
+                ("serving_disagg", dg_out, dg_ttft, dg_secs,
+                 dg_stalls, {"workers": "2 prefill + 2 decode",
+                             "failovers": dg_st["failovers"],
+                             "kv_blocks_leaked": dg_leak})):
+            goodput = sum(len(t) for t in out.values())
+            ts = sorted(ttft.values())
+            line = {"mix": f"{nreq} reqs, {npfx} Zipf prefixes, "
+                           "70/30 interactive/batch, Poisson 50ms",
+                    "ttft_p50_ms": pctl(ts, 50),
+                    "ttft_p95_ms": pctl(ts, 95),
+                    "ttft_p99_ms": pctl(ts, 99),
+                    "decode_stall_p50_ms": pctl(stalls, 50),
+                    "decode_stall_p99_ms": pctl(stalls, 99)}
+            line.update(extra)
+            emit(name, goodput, secs, **line)
+        if co_out != {r: t for r, t in dg_out.items()}:
+            print(json.dumps({"error": "disagg diverged from "
+                              "colocated"}), flush=True)
+            raise SystemExit(2)
+        if not chaos:
+            return
+
+        # chaos sub-run: one seeded kill per role mid-flight; gated
+        base_sha = sha(dg_out)
+        ch_out, _, ch_secs, _, ch_st, ch_leak = run_disagg(
+            faultinject.FaultInjector(schedule={
+                "disagg.prefill": {9}, "disagg.decode": {30}}))
+        ch_sha = sha(ch_out)
+        emit("serving_disagg_chaos",
+             sum(len(t) for t in ch_out.values()), ch_secs,
+             fault_schedule={"disagg.prefill": [9],
+                             "disagg.decode": [30]},
+             failovers=ch_st["failovers"],
+             degraded=ch_st["degraded"],
+             kv_blocks_leaked=ch_leak,
+             output_sha=ch_sha[:16],
+             output_identical=(ch_sha == base_sha))
+        if (ch_sha != base_sha or ch_leak != 0
+                or not ch_st["failovers"]["prefill"]
+                or not ch_st["failovers"]["decode"]):
+            print(json.dumps({
+                "error": "disagg chaos gate failed",
+                "baseline_sha": base_sha[:16],
+                "chaos_sha": ch_sha[:16],
+                "failovers": ch_st["failovers"],
+                "kv_blocks_leaked": ch_leak}), flush=True)
+            raise SystemExit(2)
+
     def finish() -> int:
         if tracer is not None:
             from hpx_tpu.svc import tracing
@@ -381,6 +538,10 @@ def main() -> int:
 
     if "--paged-decode-only" in sys.argv:
         paged_decode_bench()
+        return finish()
+
+    if "--disagg" in sys.argv:
+        disagg_bench("--chaos" in sys.argv)
         return finish()
 
     if "--chaos" in sys.argv:

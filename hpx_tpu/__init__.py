@@ -106,6 +106,7 @@ from .algo import (  # noqa: F401
 # -- distributed runtime: localities, actions, AGAS (M5) ---------------------
 from .dist import (  # noqa: F401
     plain_action, direct_action, async_action, post_action,
+    resilient_action,
     init, finalize, get_runtime,
     find_here, find_all_localities, find_remote_localities,
     find_root_locality, get_num_localities,

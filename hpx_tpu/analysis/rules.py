@@ -788,3 +788,90 @@ class NakedRetryRule(Rule):
                             "a pass-only Exception handler hides the "
                             "faults the restore/shed ladder must see; "
                             "type it, count it, or re-raise")
+
+
+# ---------------------------------------------------------------------------
+# HPX012 — unbounded remote wait: a blocking get() on a remote action's
+# future with no timeout is a hang waiting for a locality to die. The
+# disaggregated serving work made every cross-locality edge carry a
+# per-attempt timeout + bounded retry (dist.actions.resilient_action);
+# this rule keeps new code from quietly regressing to unbounded waits.
+# ---------------------------------------------------------------------------
+
+_REMOTE_SENDERS = ("async_action", "send_action")
+
+
+@register
+class UnboundedRemoteWaitRule(Rule):
+    """HPX012: ``.get()`` with no timeout on a remote action future in
+    non-test runtime code.
+
+    ``async_action``/``send_action`` parcels cross a process boundary:
+    the peer can die mid-call, and without a failure detector ping in
+    flight the future then NEVER resolves — a caller blocked in a bare
+    ``get()`` hangs forever instead of seeing a typed
+    ``LocalityLost``. Every remote wait must either pass
+    ``get(timeout_s)`` or route the whole call through
+    ``dist.actions.resilient_action`` (per-attempt timeout + bounded
+    backoff retry + idempotent re-delivery), which owns the policy.
+
+    Flagged shapes (same-function dataflow only):
+
+    * ``async_action(...).get()`` / ``send_action(...).get()``
+      chained directly with no argument;
+    * ``f = async_action(...)`` … ``f.get()`` with no argument.
+
+    Deliberate survivors (callers that own deadline handling a level
+    up, or infrastructure that must wait out bootstrap) stay in the
+    baseline with justification; suppress a single site with
+    ``# hpxlint: disable=HPX012 — <why>``.
+    """
+
+    id = "HPX012"
+    name = "unbounded-remote-wait"
+    severity = "warning"
+
+    @staticmethod
+    def _is_remote_send(call: ast.AST) -> bool:
+        if not isinstance(call, ast.Call):
+            return False
+        fn = call.func
+        name = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else "")
+        return name in _REMOTE_SENDERS
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.display_path.startswith("tests/") \
+                or "/tests/" in ctx.display_path:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            # names bound to a remote-send result inside this function
+            remote_names: Set[str] = set()
+            for node in _walk_function(fn):
+                if isinstance(node, ast.Assign) \
+                        and self._is_remote_send(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            remote_names.add(tgt.id)
+            for node in _walk_function(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "get"
+                        and not node.args and not node.keywords):
+                    continue
+                recv = node.func.value
+                chained = self._is_remote_send(recv)
+                via_name = (isinstance(recv, ast.Name)
+                            and recv.id in remote_names)
+                if chained or via_name:
+                    yield self.finding(
+                        ctx, node,
+                        f"unbounded get() on a remote action future "
+                        f"in {fn.name}() — a dead locality leaves "
+                        "this blocked forever; pass get(timeout_s) "
+                        "or route the call through dist.actions."
+                        "resilient_action (timeout + bounded retry + "
+                        "idempotent re-delivery)")

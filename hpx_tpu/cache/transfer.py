@@ -1,0 +1,199 @@
+"""Block-granular KV transfer between localities.
+
+Reference analog: none in HPX proper — this is the disaggregated-serving
+KV shipping protocol (prefill worker → decode worker) the MPMD split in
+`models/disagg.py` rides on, in the spirit of the parcel layer: framed,
+checksummed, idempotent.
+
+Wire unit is the :class:`KVSegment`: a contiguous run of finished
+prefill rows for one request, framed with (rid, seq, start, ntok,
+total) and a sha256 over header+payload. The payload is the RAW
+compute-dtype scratch rows the prefill worker's chunk programs
+produced — the receiver splices them into its own pool through the
+server's `_paged_splice_prog`, which quantizes identically to the
+colocated path, so pool bytes on the decode worker equal what a
+colocated prefill would have written. That identity is what lets
+decode failover replay from shipped blocks byte-exactly.
+
+Delivery discipline (the robustness core):
+
+* **framing** — `start`/`ntok` position each segment absolutely in the
+  sequence; `total` is the full prefill length, so completeness is a
+  local check (covered == total), independent of arrival order.
+* **checksums** — sha256 over header+payload; a corrupt segment raises
+  :class:`TransferCorruptError` (a ``NetworkError``, so the sender's
+  bounded-retry resend loop treats it as transient and re-ships).
+* **idempotent re-delivery** — the receiver dedups on (rid, seq):
+  duplicates (sender retry after a lost ack, injected ``parcel.dup``)
+  are ACKED AND DROPPED, never double-ingested; the ack carries
+  ``dup=True`` so chaos tests can count them.
+
+The receiver holds HOST rows only — no KV blocks are allocated until
+the decode server admits the sequence (`admit_prefilled`), so an
+aborted/abandoned transfer can never leak pool blocks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import NetworkError
+from ..synchronization import Mutex
+
+__all__ = [
+    "KVSegment",
+    "TransferCorruptError",
+    "TransferReceiver",
+    "make_segment",
+]
+
+
+class TransferCorruptError(NetworkError):
+    """Segment checksum mismatch: the payload was damaged in flight.
+    A ``NetworkError`` so resend loops classify it as transient."""
+
+    def __init__(self, rid: str, seq: int, message: str = ""):
+        super().__init__(
+            message or f"KV segment {rid}:{seq} failed checksum",
+            "TransferReceiver.ingest")
+        self.rid = rid
+        self.seq = seq
+
+
+def _digest(rid: str, seq: int, start: int, ntok: int, total: int,
+            payload: np.ndarray) -> str:
+    h = hashlib.sha256()
+    h.update(f"{rid}|{seq}|{start}|{ntok}|{total}|"
+             f"{payload.dtype.str}|{payload.shape}".encode())
+    h.update(np.ascontiguousarray(payload).tobytes())
+    return h.hexdigest()
+
+
+@dataclass(frozen=True)
+class KVSegment:
+    """One framed, checksummed run of prefill KV rows.
+
+    payload shape: [n_layers, 2, ntok, n_kv, head_dim] in the model's
+    COMPUTE dtype (pre-quantization — see module docstring).
+    """
+
+    rid: str          # request id (router-global)
+    seq: int          # segment index within the request, 0-based
+    start: int        # absolute first token row this segment covers
+    ntok: int         # rows in this segment
+    total: int        # full prefill length of the request
+    payload: np.ndarray = field(repr=False)
+    checksum: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.rid}:{self.seq}"
+
+    def verify(self) -> bool:
+        return self.checksum == _digest(self.rid, self.seq, self.start,
+                                        self.ntok, self.total,
+                                        self.payload)
+
+
+def make_segment(rid: str, seq: int, start: int, total: int,
+                 payload: np.ndarray) -> KVSegment:
+    """Frame + checksum one run of rows (payload axis 2 is tokens)."""
+    payload = np.ascontiguousarray(payload)
+    ntok = int(payload.shape[2])
+    return KVSegment(rid=rid, seq=seq, start=start, ntok=ntok,
+                     total=total, payload=payload,
+                     checksum=_digest(rid, seq, start, ntok, total,
+                                      payload))
+
+
+class TransferReceiver:
+    """Decode-worker side: reassemble segments into contiguous prefill
+    rows, exactly once. Thread-safe (ingest arrives on parcel-handler
+    pool threads; assemble runs on the serving loop)."""
+
+    def __init__(self) -> None:
+        self._lock = Mutex()
+        # rid -> {seq: KVSegment}; dropped at assemble/abort
+        self._segs: Dict[str, Dict[int, KVSegment]] = {}
+        self._aborted: set = set()
+        self.dups = 0          # duplicate deliveries acked+dropped
+        self.corrupt = 0       # checksum failures rejected
+
+    def ingest(self, seg: KVSegment) -> Dict[str, object]:
+        """Accept one segment; returns the ack ``{"rid", "seq", "dup"}``.
+
+        Duplicates (same rid+seq already held) are acked with
+        ``dup=True`` and dropped. Corrupt payloads raise
+        :class:`TransferCorruptError` — the sender re-ships."""
+        if not seg.verify():
+            with self._lock:
+                self.corrupt += 1
+            raise TransferCorruptError(seg.rid, seg.seq)
+        with self._lock:
+            if seg.rid in self._aborted:
+                # late segment for an aborted transfer: ack so the
+                # sender stops resending, keep nothing
+                return {"rid": seg.rid, "seq": seg.seq, "dup": True}
+            per = self._segs.setdefault(seg.rid, {})
+            if seg.seq in per:
+                self.dups += 1
+                return {"rid": seg.rid, "seq": seg.seq, "dup": True}
+            per[seg.seq] = seg
+        return {"rid": seg.rid, "seq": seg.seq, "dup": False}
+
+    def covered(self, rid: str) -> int:
+        """Distinct token rows held for `rid`."""
+        with self._lock:
+            per = self._segs.get(rid, {})
+            return sum(s.ntok for s in per.values())
+
+    def complete(self, rid: str) -> bool:
+        """True when held segments cover the full prefill length."""
+        with self._lock:
+            per = self._segs.get(rid)
+            if not per:
+                return False
+            total = next(iter(per.values())).total
+            got = sorted((s.start, s.ntok) for s in per.values())
+        pos = 0
+        for start, ntok in got:
+            if start != pos:
+                return False
+            pos = start + ntok
+        return pos == total
+
+    def assemble(self, rid: str) -> np.ndarray:
+        """Concatenate a complete transfer into one
+        [n_layers, 2, total, n_kv, head_dim] array and release the
+        held segments."""
+        if not self.complete(rid):
+            with self._lock:
+                per = self._segs.get(rid, {})
+                held = sorted(s.seq for s in per.values())
+            raise NetworkError(
+                f"KV transfer {rid} incomplete: segments {held}",
+                "TransferReceiver.assemble")
+        with self._lock:
+            per = self._segs.pop(rid)
+        segs = sorted(per.values(), key=lambda s: s.start)
+        return np.concatenate([s.payload for s in segs], axis=2)
+
+    def abort(self, rid: str) -> None:
+        """Drop everything held for `rid`; later segments for it are
+        acked (dup=True) but not kept."""
+        with self._lock:
+            self._segs.pop(rid, None)
+            self._aborted.add(rid)
+
+    def pending(self) -> List[str]:
+        with self._lock:
+            return sorted(self._segs)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"pending": len(self._segs), "dups": self.dups,
+                    "corrupt": self.corrupt}

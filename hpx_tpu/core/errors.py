@@ -129,6 +129,23 @@ class NetworkError(HpxError):
         super().__init__(Error.network_error, message, function)
 
 
+class LocalityLost(NetworkError):
+    """A peer locality is gone: the failure detector promoted it
+    SUSPECT→DEAD, or a send targeted a locality already marked dead.
+    Pending parcels toward it fail with THIS type (not a generic
+    NetworkError) so callers can distinguish "the worker died —
+    fail over" from "the wire hiccuped — retry". Lives here (not in
+    `svc/faultinject`) so `dist/runtime` can raise the real thing;
+    the injected variant subclasses this, keeping one except clause
+    for both."""
+
+    def __init__(self, locality: int = -1, message: str = "",
+                 function: str = ""):
+        super().__init__(
+            message or f"locality {locality} lost", function)
+        self.locality = locality
+
+
 class DeadlockError(HpxError):
     def __init__(self, message: str = "", function: str = ""):
         super().__init__(Error.deadlock, message, function)

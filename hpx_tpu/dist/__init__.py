@@ -5,6 +5,7 @@ from .actions import (  # noqa: F401
     direct_action,
     plain_action,
     post_action,
+    resilient_action,
 )
 from .components import (  # noqa: F401
     Client,

@@ -98,3 +98,53 @@ def post_action(action: Any, locality: int, *args: Any, **kwargs: Any) -> None:
     faultinject.check("locality", locality=locality)
     get_runtime().send_action(action, locality, args, kwargs,
                               want_result=False)
+
+
+_idem_counter = 0
+_idem_lock = Mutex()
+
+
+def _next_idem(name: str, locality: int) -> str:
+    """Process-unique idempotency key: pid disambiguates localities
+    sharing a host, the counter disambiguates calls."""
+    import os
+    global _idem_counter
+    with _idem_lock:
+        _idem_counter += 1
+        n = _idem_counter
+    return f"{os.getpid()}:{name}:{locality}:{n}"
+
+
+def resilient_action(action: Any, locality: int, *args: Any,
+                     timeout_s: Optional[float] = None,
+                     retries: int = 3,
+                     backoff_s: float = 0.05,
+                     idem_key: Optional[str] = None,
+                     **kwargs: Any) -> Future:
+    """`async_action` with the delivery guarantees remote serving needs:
+    per-ATTEMPT timeout, bounded retry with exponential backoff (routed
+    through `svc.resiliency.async_replay`), and an idempotency key so a
+    retry after a lost ACK is deduplicated by the receiver (the action
+    runs at most once; duplicates re-ACK the cached result).
+
+    Retries fire on transient wire trouble — ``NetworkError`` and the
+    ``FutureError`` a timed-out ``get()`` raises. A locality the
+    failure detector has marked DEAD fast-fails each attempt with
+    ``LocalityLost`` (a NetworkError subclass), so exhaustion surfaces
+    the typed loss to the caller for failover rather than hanging."""
+    from ..core.errors import FutureError, NetworkError
+    from ..svc import faultinject
+    from ..svc.resiliency import async_replay
+    from .runtime import get_runtime
+    name = action.name if isinstance(action, Action) else str(action)
+    key = idem_key or _next_idem(name, locality)
+
+    def attempt() -> Any:
+        faultinject.check("locality", locality=locality)
+        fut = get_runtime().send_action(action, locality, args, kwargs,
+                                        want_result=True, idem=key)
+        return fut.get(timeout_s) if timeout_s is not None else fut.get()
+
+    return async_replay(max(1, retries), attempt,
+                        retry_on=(NetworkError, FutureError),
+                        backoff_s=backoff_s)

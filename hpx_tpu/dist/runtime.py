@@ -23,7 +23,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..core.config import Configuration, runtime_config, set_runtime_config
-from ..core.errors import Error, HpxError, NetworkError
+from ..core.errors import Error, HpxError, LocalityLost, NetworkError
 from ..futures.future import Future, SharedState, make_ready_future
 from .actions import Action, resolve_action
 from .serialization import deserialize, serialize
@@ -32,11 +32,20 @@ from .serialization import deserialize, serialize
 _HELLO = "hello"      # (tag, locality, reachable_host, listen_port)
 _TABLE = "table"      # (tag, {locality: (host, port)})
 _IDENT = "ident"      # (tag, locality)
-_PARCEL = "parcel"    # (tag, action_name, args, kwargs, req_id, src_loc)
+_PARCEL = "parcel"    # (tag, action_name, args, kwargs, req_id, src_loc
+#                        [, idem_key])  — 7th element optional (compat)
 _RESULT = "result"    # (tag, req_id, ok, payload)
 _BATCH = "batch"      # (tag, [msg, ...])  — coalesced parcels
 _CONNECT = "connect"  # (tag, reachable_host, listen_port) — late join
 _WELCOME = "welcome"  # (tag, assigned_locality, table)
+_PING = "ping"        # (tag, src_locality) — heartbeat probe
+_PONG = "pong"        # (tag, src_locality) — heartbeat reply
+
+# failure-detector states (heartbeat loop promotes ALIVE→SUSPECT→DEAD;
+# DEAD is terminal — a locality never resurrects under one runtime)
+ALIVE = "ALIVE"
+SUSPECT = "SUSPECT"
+DEAD = "DEAD"
 
 
 class Runtime:
@@ -53,10 +62,37 @@ class Runtime:
         self._hellos: Dict[int, Tuple[str, int]] = {}
         self._boot_lock = threading.Lock()
         self._pending: Dict[int, SharedState] = {}
+        self._pending_dst: Dict[int, int] = {}   # req_id -> dst locality
         self._pending_lock = threading.Lock()
         self._next_req = 0
         self._wire_lock = threading.Lock()
         self._stopped = False
+
+        # failure detector: heartbeat thread pings every wired peer;
+        # missed pongs promote ALIVE→SUSPECT→DEAD. hpx.dist.heartbeat_
+        # interval=0 (the default) disables the whole machinery.
+        self._hb_interval = cfg.get_float("hpx.dist.heartbeat_interval",
+                                          0.0)
+        self._hb_suspect = cfg.get_float("hpx.dist.heartbeat_suspect",
+                                         2.0)   # intervals w/o pong
+        self._hb_dead = cfg.get_float("hpx.dist.heartbeat_dead", 4.0)
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_pong: Dict[int, float] = {}   # loc -> monotonic time
+        self._hb_send_misses = 0                 # failed ping sends
+        self._peer_state: Dict[int, str] = {}    # loc -> ALIVE/SUSPECT/DEAD
+        self._dead: set = set()
+        self._death_listeners: list = []
+        # injected net.partition is sticky: once the link to a locality
+        # tears, every later message both ways is dropped (the detector
+        # then promotes it DEAD like a real partition would)
+        self._partitioned: set = set()
+        # idempotent parcel delivery: idem_key -> entry dict (done flag,
+        # cached ok/value, waiters to re-ack). Duplicates re-reply the
+        # cached result — acked and dropped, never re-executed.
+        self._idem: Dict[str, dict] = {}
+        self._idem_order: list = []              # FIFO for table bound
+        self._idem_max = cfg.get_int("hpx.dist.idem_table_max", 4096)
         self._inflight = 0            # parcel handlers not yet replied
         self._inflight_cv = threading.Condition()
         self.parcels_sent = 0         # perf-counter feeds
@@ -98,6 +134,8 @@ class Runtime:
             self._connect_join()
         elif self.num_localities > 1:
             self._bootstrap()
+        if self._hb_interval > 0 and self.num_localities > 1:
+            self._start_heartbeat()
 
     # -- bootstrap ----------------------------------------------------------
     def _reachable_host(self, root_host: str, root_port: int) -> str:
@@ -288,6 +326,21 @@ class Runtime:
             self._routes_cv.notify_all()
 
     def _send_to_locality(self, loc: int, msg: Any) -> None:
+        if loc in self._dead:
+            raise LocalityLost(loc, f"locality {loc} is DEAD",
+                               "Runtime._send_to_locality")
+        from ..svc import faultinject
+        if loc in self._partitioned:
+            return                      # link torn: silently dropped
+        if faultinject.fires("net.partition", locality=loc):
+            self._partitioned.add(loc)
+            return
+        if faultinject.fires("parcel.drop", locality=loc):
+            return                      # lost on the wire, no error
+        dup = faultinject.fires("parcel.dup", locality=loc)
+        if faultinject.fires("parcel.delay", locality=loc):
+            from ..exec.execution_base import suspend
+            suspend(self.cfg.get_float("hpx.fault.parcel_delay_s", 0.05))
         pid = self._peer_of_loc.get(loc)
         if pid is None:
             # Bootstrap race: higher-numbered localities dial us at their
@@ -299,7 +352,82 @@ class Runtime:
                         self.cfg.get_float("hpx.route_timeout", 30.0)):
                     raise NetworkError(f"no route to locality {loc}")
                 pid = self._peer_of_loc[loc]
-        self._send_raw(pid, msg)
+        try:
+            self._send_raw(pid, msg)
+            if dup:
+                self._send_raw(pid, msg)   # injected duplicate delivery
+        except OSError as e:
+            # the peer's socket is gone — a crashed worker looks like
+            # this before the heartbeat notices; promote immediately
+            self._mark_dead(loc)
+            raise LocalityLost(
+                loc, f"send to locality {loc} failed: {e}",
+                "Runtime._send_to_locality") from e
+
+    # -- failure detector ---------------------------------------------------
+    def _start_heartbeat(self) -> None:
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="hpx-heartbeat",
+            daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        """OS-thread heartbeat (not a pool task: it must keep beating
+        while the pool is saturated — that is exactly when peers look
+        slow). Event.wait paces it and doubles as the stop signal."""
+        while not self._hb_stop.wait(self._hb_interval):
+            now = time.monotonic()
+            for loc in list(self._peer_of_loc):
+                if loc == self.locality or loc in self._dead:
+                    continue
+                if loc not in self._last_pong:
+                    self._last_pong[loc] = now   # grace from first ping
+                try:
+                    self._send_to_locality(loc, (_PING, self.locality))
+                except (NetworkError, OSError):
+                    # counted, not retried: misses accrue via pong age
+                    self._hb_send_misses += 1
+                age = now - self._last_pong[loc]
+                if age > self._hb_dead * self._hb_interval:
+                    self._mark_dead(loc)
+                elif age > self._hb_suspect * self._hb_interval:
+                    self._peer_state[loc] = SUSPECT
+
+    def locality_state(self, loc: int) -> str:
+        """ALIVE / SUSPECT / DEAD as the failure detector sees it."""
+        if loc in self._dead:
+            return DEAD
+        return self._peer_state.get(loc, ALIVE)
+
+    def add_death_listener(self, fn: Callable[[int], None]) -> None:
+        """`fn(locality)` runs (on the detecting thread) when the
+        failure detector promotes a locality to DEAD."""
+        self._death_listeners.append(fn)
+
+    def _mark_dead(self, loc: int) -> None:
+        """Promote `loc` to DEAD (terminal) and fail every pending
+        parcel toward it with typed LocalityLost — callers must see
+        'the worker died, fail over', not hang to their timeout."""
+        with self._pending_lock:
+            if loc in self._dead:
+                return
+            self._dead.add(loc)
+            self._peer_state[loc] = DEAD
+            stale = [(rid, self._pending.pop(rid))
+                     for rid, dst in list(self._pending_dst.items())
+                     if dst == loc and rid in self._pending]
+            for rid, _st in stale:
+                self._pending_dst.pop(rid, None)
+        for _rid, st in stale:
+            st.set_exception(LocalityLost(
+                loc, f"locality {loc} died with the parcel in flight",
+                "Runtime._mark_dead"))
+        for fn in list(self._death_listeners):
+            try:
+                fn(loc)
+            except Exception:  # noqa: BLE001 — detector must keep going
+                import traceback
+                traceback.print_exc()
 
     def _on_message(self, peer_id: int, data: bytes) -> None:
         """Runs on the IO thread: decode, then dispatch cheaply."""
@@ -332,12 +460,29 @@ class Runtime:
 
     def _dispatch(self, peer_id: int, msg: Any) -> None:
         tag = msg[0]
+        if self._partitioned and tag in (_PARCEL, _RESULT, _PING, _PONG):
+            # injected partitions are bidirectional: inbound data-plane
+            # traffic from a torn link is dropped too
+            src = self._loc_of_peer.get(peer_id)
+            if src in self._partitioned:
+                return
+        if tag == _PING:
+            try:
+                self._send_to_locality(msg[1], (_PONG, self.locality))
+            except (NetworkError, OSError):
+                pass
+            return
+        if tag == _PONG:
+            self._last_pong[msg[1]] = time.monotonic()
+            self._peer_state[msg[1]] = ALIVE
+            return
         if tag == _PARCEL:
             self._handle_parcel(msg)
         elif tag == _RESULT:
             _tag, req_id, ok, payload = msg
             with self._pending_lock:
                 st = self._pending.pop(req_id, None)
+                self._pending_dst.pop(req_id, None)
             if st is not None:
                 if ok:
                     st.set_value(payload)
@@ -432,7 +577,36 @@ class Runtime:
                 traceback.print_exc()
 
     def _handle_parcel(self, msg) -> None:
-        _tag, action_name, args, kwargs, req_id, src_loc = msg
+        # 7-element parcels carry an idempotency key (resilient_action
+        # resends); 6-element parcels are the pre-idempotency wire
+        # format and still accepted
+        _tag, action_name, args, kwargs, req_id, src_loc = msg[:6]
+        idem = msg[6] if len(msg) > 6 else None
+
+        if idem is not None:
+            with self._pending_lock:
+                entry = self._idem.get(idem)
+                if entry is None:
+                    entry = {"done": False, "ok": True, "value": None,
+                             "waiters": []}
+                    self._idem[idem] = entry
+                    self._idem_order.append(idem)
+                    while len(self._idem_order) > self._idem_max:
+                        self._idem.pop(self._idem_order.pop(0), None)
+                elif entry["done"]:
+                    # duplicate of a completed parcel: re-ACK the cached
+                    # result, do NOT re-execute (exactly-once effect)
+                    if req_id is not None:
+                        self._reply(src_loc, req_id, entry["ok"],
+                                    entry["value"])
+                    return
+                else:
+                    # duplicate while the original still runs: park the
+                    # reply address; the finishing run acks both
+                    if req_id is not None:
+                        entry["waiters"].append((src_loc, req_id))
+                    return
+
         with self._inflight_cv:
             self._inflight += 1
 
@@ -441,13 +615,28 @@ class Runtime:
                 self._inflight -= 1
                 self._inflight_cv.notify_all()
 
+        def settle(ok: bool, value) -> None:
+            """Reply to the original + any duplicate waiters, caching
+            the result for later re-deliveries."""
+            waiters = [(src_loc, req_id)] if req_id is not None else []
+            if idem is not None:
+                with self._pending_lock:
+                    entry = self._idem.get(idem)
+                    if entry is not None:
+                        entry["done"] = True
+                        entry["ok"] = ok
+                        entry["value"] = value
+                        waiters += entry.pop("waiters", [])
+                        entry["waiters"] = []
+            for w_loc, w_req in waiters:
+                self._reply(w_loc, w_req, ok, value)
+
         def run() -> None:
             try:
                 fn = resolve_action(action_name)
                 value = fn(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001
-                if req_id is not None:
-                    self._reply(src_loc, req_id, False, e)
+                settle(False, e)
                 done()
                 return
             if isinstance(value, Future):
@@ -456,22 +645,20 @@ class Runtime:
                 # parcels deadlock a T-thread pool
                 def on_ready(f: Future) -> None:
                     try:
-                        if req_id is not None:
-                            if f.has_exception():
-                                try:
-                                    f.get()
-                                except BaseException as e:  # noqa: BLE001
-                                    self._reply(src_loc, req_id, False, e)
-                            else:
-                                self._reply(src_loc, req_id, True, f.get())
+                        if f.has_exception():
+                            try:
+                                f.get()
+                            except BaseException as e:  # noqa: BLE001
+                                settle(False, e)
+                        else:
+                            settle(True, f.get())
                     finally:
                         done()
                 # hpxlint: disable=HPX003 — on_ready() is the sink: it
                 # replies or forwards the exception; then-future unused
                 value.then(on_ready)
                 return
-            if req_id is not None:
-                self._reply(src_loc, req_id, True, value)
+            settle(True, value)
             done()
 
         # scheduled execution on the task pool (HPX: parcel decode
@@ -482,7 +669,8 @@ class Runtime:
 
     # -- public -------------------------------------------------------------
     def send_action(self, action: Any, locality: int, args: tuple,
-                    kwargs: dict, want_result: bool) -> Optional[Future]:
+                    kwargs: dict, want_result: bool,
+                    idem: Optional[str] = None) -> Optional[Future]:
         name = action.name if isinstance(action, Action) else str(action)
         if locality == self.locality:
             # local fast path: no serialization (AGAS cache hit analog)
@@ -495,6 +683,10 @@ class Runtime:
         if locality < 0 or locality >= self.num_localities:
             raise HpxError(Error.bad_parameter,
                            f"no such locality: {locality}")
+        if locality in self._dead:
+            raise LocalityLost(locality,
+                               f"locality {locality} is DEAD",
+                               "Runtime.send_action")
         req_id = None
         fut = None
         if want_result:
@@ -503,13 +695,26 @@ class Runtime:
                 req_id = self._next_req
                 self._next_req += 1
                 self._pending[req_id] = st
+                self._pending_dst[req_id] = locality
             fut = Future(st)
-        msg = (_PARCEL, name, args, kwargs, req_id, self.locality)
-        if self._coalescer is not None:
-            blob = serialize(msg)
-            self._coalescer.put(locality, blob, len(blob))
-        else:
-            self._send_to_locality(locality, msg)
+        msg = ((_PARCEL, name, args, kwargs, req_id, self.locality)
+               if idem is None else
+               (_PARCEL, name, args, kwargs, req_id, self.locality,
+                idem))
+        try:
+            if self._coalescer is not None:
+                blob = serialize(msg)
+                self._coalescer.put(locality, blob, len(blob))
+            else:
+                self._send_to_locality(locality, msg)
+        except BaseException:
+            # the parcel never left: un-register it so finalize/death
+            # sweeps don't double-fail the future the caller never got
+            if req_id is not None:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+                    self._pending_dst.pop(req_id, None)
+            raise
         return fut
 
     def _send_batch(self, loc: int, blobs: list) -> None:
@@ -535,6 +740,9 @@ class Runtime:
         ordering trap — SURVEY.md §7)."""
         if self._stopped:
             return
+        if self._hb_thread is not None:
+            self._hb_stop.set()
+            self._hb_thread.join(timeout=2.0)
         if self._coalescer is not None:
             self._coalescer.flush()
         if self.num_localities > 1:
@@ -553,6 +761,18 @@ class Runtime:
             self._coalescer.close()
         if self._endpoint is not None:
             self._endpoint.close()
+        # fail anything still awaiting a reply with the TYPED error —
+        # a caller blocked on .get() must not hang to its timeout after
+        # the endpoint that could have carried the reply is gone
+        with self._pending_lock:
+            stale = [(rid, st, self._pending_dst.get(rid, -1))
+                     for rid, st in self._pending.items()]
+            self._pending.clear()
+            self._pending_dst.clear()
+        for _rid, st, dst in stale:
+            st.set_exception(LocalityLost(
+                dst, f"runtime finalized with parcel to locality "
+                f"{dst} still pending", "Runtime.finalize"))
 
 
 _runtime: Optional[Runtime] = None

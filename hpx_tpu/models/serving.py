@@ -549,6 +549,12 @@ class _Request:
     t_submit: float = 0.0          # monotonic submit time (TTFT)
     deadline_s: Optional[float] = None   # submit()-time budget
     t_deadline: Optional[float] = None   # absolute monotonic deadline
+    # disaggregated serving (admit_prefilled): prefill happened on a
+    # REMOTE worker; admission splices these shipped KV rows instead
+    # of computing a prefill. Host arrays only — no blocks are held
+    # until the slot admits, so a shed queued transfer leaks nothing.
+    xfer_rows: Any = None          # np [layers, 2, plen, n_kv, hd]
+    xfer_seed: Optional[int] = None   # remote probe's seeded token
 
 
 @dataclasses.dataclass
@@ -1458,6 +1464,57 @@ class ContinuousServer:
             t_deadline=(now + deadline_s) if deadline_s else None))
         return rid
 
+    def admit_prefilled(self, prompt, kv_rows, seed_token: int,
+                        max_new: int, eos_id: Optional[int] = None,
+                        temperature: float = 0.0, key=None,
+                        deadline_s: Optional[float] = None) -> int:
+        """Submit a request whose prefill ALREADY HAPPENED on a remote
+        prefill worker (disaggregated serving, `models/disagg`):
+        `kv_rows` are the worker's raw compute-dtype scratch rows
+        ([n_layers, 2, plen, n_kv, head_dim]) and `seed_token` is the
+        token its probe seeded. Admission allocates blocks and splices
+        the rows through the SAME `_paged_splice_prog` a colocated
+        prefill uses, then decodes normally from pos=plen — emitted
+        tokens match what a colocated submit() would produce. The rows
+        stay a host array until a slot admits, so shedding a queued
+        transfer can never leak pool blocks."""
+        if not self.paged:
+            raise ValueError(
+                "admit_prefilled() requires paged=True (the transfer "
+                "protocol ships block-granular KV)")
+        if self._closed:
+            raise ServerClosedError()
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("admit_prefilled needs a non-empty prompt")
+        if len(prompt) + max_new > self.smax:
+            raise ValueError(
+                f"plen {len(prompt)} + max_new {max_new} exceeds "
+                f"smax {self.smax}")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if temperature > 0.0 and key is None:
+            raise ValueError("temperature > 0 needs a PRNG key")
+        if key is not None:
+            key = _normalize_key(key)
+        rows = np.asarray(kv_rows)
+        nkv, hd = self.cfg.kv_heads, self.cfg.head_dim
+        want = (self.cfg.n_layers, 2, len(prompt), nkv, hd)
+        if tuple(rows.shape) != want:
+            raise ValueError(
+                f"kv_rows shape {tuple(rows.shape)} != expected {want}")
+        if deadline_s is None:
+            deadline_s = self._default_deadline_s or None
+        rid = self._next_rid
+        self._next_rid += 1
+        now = time.monotonic()
+        self._queue.append(_Request(
+            rid, prompt, max_new, eos_id, temperature, key,
+            t_submit=now, deadline_s=deadline_s,
+            t_deadline=(now + deadline_s) if deadline_s else None,
+            xfer_rows=rows, xfer_seed=int(seed_token)))
+        return rid
+
     def shutdown(self) -> None:
         """Close the intake: every later submit() raises
         ServerClosedError. Queued and in-flight requests are NOT
@@ -1635,6 +1692,9 @@ class ContinuousServer:
                     with tracing.span("serving.admit", "serving",
                                       rid=req.rid, slot=slot,
                                       plen=plen):
+                        if req.xfer_rows is not None:
+                            self._admit_transferred(req, slot)
+                            continue
                         p = self._start_prefill(req, slot)
                         if p.remaining <= self.prefill_chunk:
                             with tracing.span("serving.prefill",
@@ -1653,6 +1713,62 @@ class ContinuousServer:
                     if not self._defer_admit(req, e):
                         return   # deferred: give retirements a step
                                  # to free blocks before re-admitting
+
+    def _admit_transferred(self, req: "_Request", slot: int) -> None:
+        """Admit a remotely-prefilled request: allocate its blocks,
+        splice the shipped rows through the colocated splice program
+        (identical quantization/padding semantics), seed the remote
+        probe's token, go live at pos=plen. Mirrors `_finish_prefill`
+        minus the compute — every downstream invariant (checkpoint
+        capture, retire, COW discipline) sees a normal live slot."""
+        plen = len(req.prompt)
+        pt = PageTable(self.block_size)
+        try:
+            while pt.capacity < plen:
+                pt.append_block(self._alloc_block())
+        except CacheOOM:
+            for bid in pt.blocks:
+                self._alloc.decref(bid)
+            raise
+        pt.tokens = plen
+        self._admit_defers.pop(req.rid, None)
+        trow = jnp.asarray(pt.as_row(self._maxb, self._trash))
+        nkv, hd = self.cfg.kv_heads, self.cfg.head_dim
+        rows = req.xfer_rows
+        scratch = []
+        for li in range(self.cfg.n_layers):
+            k = jnp.zeros((1, self.smax, nkv, hd), self.cfg.dtype)
+            k = k.at[0, :plen].set(
+                jnp.asarray(rows[li, 0], self.cfg.dtype))
+            v = jnp.zeros((1, self.smax, nkv, hd), self.cfg.dtype)
+            v = v.at[0, :plen].set(
+                jnp.asarray(rows[li, 1], self.cfg.dtype))
+            scratch.append((k, v))
+        self._pools, self._scales = self._paged_splice_prog()(
+            self._pools, self._scales, scratch, trow)
+        self._tables[slot] = pt
+        req.xfer_rows = None           # host copy no longer needed
+        tok0 = int(req.xfer_seed)
+        req.tokens.append(tok0)
+        req.sent = 1
+        self._slot_req[slot] = req
+        self._pos[slot] = plen
+        self._cur[slot] = tok0
+        if self._cur_dev is not None:
+            self._cur_dev = self._cur_dev.at[slot].set(tok0)
+        self._temp[slot] = req.temperature
+        self._key[slot] = (req.key if req.key is not None
+                           else jax.random.PRNGKey(0))
+        self._temp_dev = None          # rebuilt with keys next step
+        if self._spec:
+            self._slot_k[slot] = self._spec_k
+            self._slot_acc[slot] = 1.0
+            if self._draft_params is not None:
+                self._draft_prefill(slot, req.prompt)
+        self.ttft[req.rid] = time.monotonic() - req.t_submit
+        self._prefill_saved += plen    # prefill compute happened remotely
+        self._capture(slot)
+        self._maybe_retire(slot)
 
     def _defer_admit(self, req: "_Request", exc: CacheOOM) -> bool:
         """Admission OOM ladder, entered after evict→retry failed:

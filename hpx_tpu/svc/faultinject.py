@@ -22,11 +22,21 @@ the Nth check of a site faults:
 
 Faults are typed by site: ``alloc`` raises :class:`InjectedOOM` (a
 ``CacheOOM`` subclass — it walks the allocator's evict→retry→shed
-ladder), ``locality`` raises :class:`LocalityLost` (a ``NetworkError``
-— `async_replay_distributed` retargets on it), everything else raises
+ladder), ``locality`` and the ``disagg.*`` worker sites raise
+:class:`LocalityLost` (a ``NetworkError`` —
+`async_replay_distributed` retargets on it), everything else raises
 plain :class:`InjectedFault`. All carry ``.site`` and ``.nth`` so
 recovery policy can classify (e.g. serving disables speculation after
 repeated ``verify`` faults).
+
+The parcel sites (``parcel.drop``/``parcel.dup``/``parcel.delay``/
+``net.partition``) are BEHAVIORAL: their fault is an action (lose,
+duplicate or delay a wire message; tear a link) rather than an
+exception, so their dispatch points call :func:`fires` — the same
+deterministic decision (schedule nth membership, or a per-site seeded
+stream draw) returned as a bool instead of raised. ``Runtime.
+_send_to_locality`` / ``_handle_parcel`` consult them; idempotency
+keys on the parcel layer make drop+resend and dup exactly-once.
 
 Config (``hpx.fault.*``)::
 
@@ -41,9 +51,10 @@ Config (``hpx.fault.*``)::
 from __future__ import annotations
 
 import random
-from typing import Dict, Iterable, Mapping, Optional, Set
+from typing import Dict, Iterable, Mapping, Optional, Set, Tuple
 
-from ..core.errors import CacheOOM, Error, HpxError, NetworkError
+from ..core.errors import (CacheOOM, Error, HpxError,
+                           LocalityLost as _RealLocalityLost)
 from ..synchronization import Mutex
 
 __all__ = [
@@ -54,14 +65,21 @@ __all__ = [
     "SITES",
     "active",
     "check",
+    "fires",
     "install",
     "install_from_config",
     "uninstall",
 ]
 
 # the known dispatch sites, for docs/validation (unknown site names are
-# still allowed — subsystems may grow new sites without touching this)
-SITES = ("decode", "prefill", "verify", "alloc", "locality")
+# still allowed — subsystems may grow new sites without touching this).
+# "disagg.prefill"/"disagg.decode" are the per-ROLE worker-call sites
+# (each counts its own stream, so a schedule can kill exactly one
+# worker of each role); the parcel.* / net.partition sites are
+# behavioral (fires(), not check()).
+SITES = ("decode", "prefill", "verify", "alloc", "locality",
+         "disagg.prefill", "disagg.decode",
+         "parcel.drop", "parcel.dup", "parcel.delay", "net.partition")
 
 
 class InjectedFault(HpxError):
@@ -90,24 +108,26 @@ class InjectedOOM(CacheOOM, InjectedFault):
         self.nth = nth
 
 
-class LocalityLost(NetworkError, InjectedFault):
+class LocalityLost(_RealLocalityLost, InjectedFault):
     """Simulated locality loss on the action send path — what a died
     decode/prefill worker looks like to `dist/actions` callers;
-    `async_replay_distributed` retargets the next locality on it."""
+    `async_replay_distributed` retargets the next locality on it.
+    Subclasses the REAL `core.errors.LocalityLost` the failure
+    detector raises, so one except clause handles both worlds."""
 
     def __init__(self, site: str, nth: int, locality: int = -1):
-        NetworkError.__init__(
-            self, f"injected locality loss toward locality "
+        _RealLocalityLost.__init__(
+            self, locality,
+            f"injected locality loss toward locality "
             f"{locality} (check #{nth})", "FaultInjector.check")
         self.site = site
         self.nth = nth
-        self.locality = locality
 
 
 def _raise_for(site: str, nth: int, **ctx) -> None:
     if site == "alloc":
         raise InjectedOOM(site, nth)
-    if site == "locality":
+    if site == "locality" or site.startswith("disagg."):
         raise LocalityLost(site, nth, int(ctx.get("locality", -1)))
     raise InjectedFault(site, nth)
 
@@ -142,30 +162,45 @@ class FaultInjector:
     def _armed(self, site: str) -> bool:
         return self.sites is None or site in self.sites
 
+    def _decide(self, site: str) -> Tuple[bool, int]:
+        """One counted dispatch through `site` → (fires, nth). Called
+        under self._lock."""
+        nth = self._checks.get(site, 0) + 1
+        self._checks[site] = nth
+        if not self._armed(site):
+            return False, nth
+        total = sum(self._injected.values())
+        if self.max_faults and total >= self.max_faults:
+            return False, nth
+        fire = nth in self.schedule.get(site, ())
+        if not fire and self.rate > 0.0:
+            rng = self._rngs.get(site)
+            if rng is None:
+                # independent per-site streams: one site's check
+                # count never perturbs another site's draws
+                rng = random.Random(f"{self.seed}:{site}")
+                self._rngs[site] = rng
+            fire = rng.random() < self.rate
+        if fire:
+            self._injected[site] = self._injected.get(site, 0) + 1
+        return fire, nth
+
     def check(self, site: str, **ctx) -> None:
         """Count one dispatch through `site`; raise its typed fault if
         the schedule/rate says this one dies."""
         with self._lock:
-            nth = self._checks.get(site, 0) + 1
-            self._checks[site] = nth
-            if not self._armed(site):
-                return
-            total = sum(self._injected.values())
-            if self.max_faults and total >= self.max_faults:
-                return
-            fire = nth in self.schedule.get(site, ())
-            if not fire and self.rate > 0.0:
-                rng = self._rngs.get(site)
-                if rng is None:
-                    # independent per-site streams: one site's check
-                    # count never perturbs another site's draws
-                    rng = random.Random(f"{self.seed}:{site}")
-                    self._rngs[site] = rng
-                fire = rng.random() < self.rate
-            if not fire:
-                return
-            self._injected[site] = self._injected.get(site, 0) + 1
-        _raise_for(site, nth, **ctx)
+            fire, nth = self._decide(site)
+        if fire:
+            _raise_for(site, nth, **ctx)
+
+    def fires(self, site: str, **ctx) -> bool:
+        """`check` for BEHAVIORAL sites: same deterministic decision
+        (same counters, same streams), returned instead of raised —
+        the dispatch point acts the fault out (drop/duplicate/delay a
+        parcel, tear a link) rather than unwinding."""
+        with self._lock:
+            fire, _nth = self._decide(site)
+        return fire
 
     # -- observability ----------------------------------------------------
 
@@ -211,6 +246,15 @@ def check(site: str, **ctx) -> None:
     fi = _active
     if fi is not None:
         fi.check(site, **ctx)
+
+
+def fires(site: str, **ctx) -> bool:
+    """Behavioral-site hook: False unless an injector is installed and
+    schedules this dispatch."""
+    fi = _active
+    if fi is not None:
+        return fi.fires(site, **ctx)
+    return False
 
 
 def install_from_config() -> Optional[FaultInjector]:

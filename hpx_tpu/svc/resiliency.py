@@ -74,9 +74,26 @@ def _replay_loop(n: int, validate: Optional[Callable[[Any], bool]],
 
 
 def async_replay(n: int, fn: Callable[..., Any], *args: Any,
+                 retry_on: Optional[tuple] = None,
+                 on_retry: Optional[Callable[[int, BaseException],
+                                             None]] = None,
+                 backoff_s: float = 0.0,
+                 backoff_factor: float = 2.0,
+                 max_backoff_s: float = 1.0,
                  **kwargs: Any) -> Future:
-    """Run fn; on exception re-run, up to n attempts total."""
-    return async_(_replay_loop, n, None, fn, args, kwargs)
+    """Run fn; on exception re-run, up to n attempts total.
+
+    Grown the `sync_replay` policy knobs (typed ``retry_on`` filter,
+    ``on_retry`` repair hook, exponential ``backoff_s``) so the
+    distributed send path (`dist.actions.resilient_action`) can route
+    its bounded retry through the one replay implementation. With no
+    policy kwargs this is the classic reference-shaped replay."""
+    if retry_on is None and on_retry is None and backoff_s == 0.0:
+        return async_(_replay_loop, n, None, fn, args, kwargs)
+    return async_(sync_replay, n, fn, *args,
+                  retry_on=retry_on or (Exception,), on_retry=on_retry,
+                  backoff_s=backoff_s, backoff_factor=backoff_factor,
+                  max_backoff_s=max_backoff_s, **kwargs)
 
 
 def async_replay_validate(n: int, validate: Callable[[Any], bool],

@@ -1,0 +1,142 @@
+"""Disaggregated serving smoke over REAL localities (5 processes).
+
+Topology: locality 0 runs the DisaggRouter; 1-2 register PrefillWorkers,
+3-4 register DecodeWorkers (hpx.disagg.invoke reaches them by worker
+id). Mid-flight the router hard-kills one worker of EACH role with the
+``hpx.disagg.die`` action (os._exit — no goodbye), so the failure
+detector must notice the honest way: heartbeat pong age or a failed
+socket send promoting the locality to DEAD and failing pending parcels
+with typed LocalityLost. The router fails over to the surviving worker
+of each role, and the final tokens must equal single-process
+``tfm.generate`` references exactly.
+
+Run under hpx_tpu.run with 5 localities (the tier-1 slow test does).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from hpx_tpu.core.config import runtime_config
+
+# fast failure detection + a finalize barrier that cannot hang on the
+# two corpses (finalize swallows the barrier timeout)
+runtime_config().set("hpx.dist.heartbeat_interval", "0.2")
+runtime_config().set("hpx.barrier_timeout", "8")
+# one decode step per router tick: the kill below must land while its
+# victim still has decode work outstanding
+runtime_config().set("hpx.serving.disagg.pump_steps", "1")
+
+import hpx_tpu as hpx
+from hpx_tpu.dist import agas
+from hpx_tpu.dist.actions import post_action
+from hpx_tpu.testing import HPX_TEST, HPX_TEST_EQ, report_errors
+
+BS = 8          # one KV block grid for prefill framing + decode pools
+SMAX = 64
+
+
+def _model():
+    import jax
+    from hpx_tpu.models import transformer as tfm
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                head_dim=8, n_layers=2, d_ff=64)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    return params, cfg
+
+
+def _requests():
+    import numpy as np
+    rng = np.random.default_rng(3)
+    return [([int(t) for t in rng.integers(1, 64,
+                                           int(rng.integers(4, 18)))],
+             12 + i) for i in range(5)]
+
+
+def main() -> int:
+    hpx.init()
+    here = hpx.find_here()
+    HPX_TEST_EQ(hpx.get_num_localities(), 5)
+    params, cfg = _model()
+
+    if here in (1, 2):
+        from hpx_tpu.models.disagg import PrefillWorker, register_worker
+        register_worker("pw", PrefillWorker(params, cfg, smax=SMAX,
+                                            block_size=BS))
+    elif here in (3, 4):
+        from hpx_tpu.models.disagg import DecodeWorker, register_worker
+        register_worker("dw", DecodeWorker(params, cfg, slots=2,
+                                           smax=SMAX, block_size=BS))
+    agas.register_name(f"disagg/up/{here}", 1).get(timeout=60.0)
+
+    if here == 0:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from hpx_tpu.models import transformer as tfm
+        from hpx_tpu.models.disagg import DisaggRouter, RemoteHandle
+
+        for loc in range(1, 5):
+            agas.resolve_name(f"disagg/up/{loc}",
+                              wait=True).get(timeout=60.0)
+
+        reqs = _requests()
+        refs = []
+        for prompt, mn in reqs:
+            out = tfm.generate(params, cfg,
+                               jnp.asarray([prompt], jnp.int32),
+                               max_new=mn)
+            refs.append([int(t) for t in np.asarray(out)[0]])
+
+        router = DisaggRouter(
+            params, cfg, slots=2, smax=SMAX,
+            server_kwargs={"block_size": BS},
+            prefill_handles=[
+                RemoteHandle("prefill", loc, "pw", timeout_s=20.0,
+                             retries=2) for loc in (1, 2)],
+            decode_handles=[
+                RemoteHandle("decode", loc, "dw", timeout_s=20.0,
+                             retries=2) for loc in (3, 4)])
+        for prompt, mn in reqs:
+            router.submit(prompt, mn)
+
+        # one router tick starts prefills on 1 and 2 — then locality 1
+        # dies mid-prefill, for real
+        router.step()
+        post_action("hpx.disagg.die", 1)
+        # step until some request is actively DECODING on locality 3,
+        # then kill it: with 12+ tokens left and one decode step per
+        # tick, the death lands with work outstanding and the next
+        # pump must fail over to locality 4
+        h3 = router._decode[0]
+        while not any(r.state == "decode" and r.decode_h is h3
+                      for r in router._reqs.values()):
+            if not router.step():
+                break
+        post_action("hpx.disagg.die", 3)
+        out = router.run()
+
+        st = router.stats()
+        HPX_TEST(st["failovers"]["prefill"] >= 1,
+                 f"no prefill failover: {st}")
+        HPX_TEST(st["failovers"]["decode"] >= 1,
+                 f"no decode failover: {st}")
+        HPX_TEST(not st["degraded"],
+                 f"degraded despite survivors: {st}")
+        for rid, want in enumerate(refs):
+            HPX_TEST_EQ(out.get(rid), want)
+        router.close()
+        HPX_TEST_EQ(router.leaked_blocks(), 0)
+        agas.register_name("disagg/done", 1).get(timeout=60.0)
+    else:
+        # workers serve until the router reports done (the two killed
+        # localities never reach this wait — or this line)
+        agas.resolve_name("disagg/done", wait=True).get(timeout=240.0)
+
+    hpx.finalize()
+    return report_errors()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

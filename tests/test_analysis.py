@@ -688,11 +688,63 @@ def test_finding_format():
     assert f.format() == "a/b.py:3:0: HPX006 [error] m"
 
 
+# ---------------------------------------------------------------------------
+# HPX012 — unbounded get() on a remote action future
+# ---------------------------------------------------------------------------
+
+HPX012_BAD_CHAINED = """\
+from hpx_tpu.dist.actions import async_action
+
+def fetch(loc):
+    return async_action("act", loc, 1).get()
+"""
+
+HPX012_BAD_VIA_NAME = """\
+from hpx_tpu.dist.actions import async_action
+
+def fetch(loc):
+    f = async_action("act", loc, 1)
+    prep()
+    return f.get()
+"""
+
+HPX012_GOOD = """\
+from hpx_tpu.dist.actions import async_action, resilient_action
+
+def fetch(loc):
+    a = async_action("act", loc, 1).get(5.0)       # bounded
+    b = resilient_action("act", loc, 1,
+                         timeout_s=5.0).get()      # policy owns it
+    f = make_future()
+    return a, b, f.get()                           # not a remote send
+"""
+
+
+def test_hpx012_flags_chained_unbounded_get():
+    fs = findings(HPX012_BAD_CHAINED, path="hpx_tpu/svc/fixture.py")
+    assert rules_of(fs) == ["HPX012"]
+    assert "resilient_action" in fs[0].message
+
+
+def test_hpx012_flags_named_future_get():
+    fs = findings(HPX012_BAD_VIA_NAME, path="hpx_tpu/svc/fixture.py")
+    assert rules_of(fs) == ["HPX012"]
+
+
+def test_hpx012_clean_shapes():
+    assert findings(HPX012_GOOD, path="hpx_tpu/svc/fixture.py") == []
+
+
+def test_hpx012_skips_tests():
+    assert findings(HPX012_BAD_CHAINED,
+                    path="tests/test_fixture.py") == []
+
+
 def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
                    "HPX005", "HPX006", "HPX007", "HPX008",
-                   "HPX009", "HPX010", "HPX011"]
+                   "HPX009", "HPX010", "HPX011", "HPX012"]
 
 
 # ---------------------------------------------------------------------------
