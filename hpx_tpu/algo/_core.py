@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
-from ..exec.params import AutoChunkSize
+from ..exec.params import default_chunker
 from ..exec.policies import ExecutionPolicy, seq as seq_policy
 from ..exec.tpu import TpuExecutor
 from ..futures.future import Future, make_ready_future
@@ -75,7 +75,7 @@ def finish(policy: ExecutionPolicy, value_fn: Callable[[], Any]) -> Any:
 def chunk_bounds(count: int, policy: ExecutionPolicy,
                  num_workers: int) -> List[Tuple[int, int]]:
     """[(begin, end)) chunks per the policy's chunking parameter."""
-    chunking = policy.chunking or AutoChunkSize()
+    chunking = policy.chunking or default_chunker()
     if policy.cores:
         num_workers = min(num_workers, policy.cores)
     sizes = chunking.chunks(count, max(1, num_workers))

@@ -4,8 +4,9 @@ The dynamic VERIFY_LOCKS analog (`hpx_tpu.synchronization`) only fires
 on the paths a test happens to execute; this package is its static
 complement.  A small stdlib-`ast` framework (rule registry, per-rule
 severity, file/line findings, inline ``# hpxlint: disable=RULE``
-suppressions, committed baseline) runs a rule pack targeting the
-runtime's real hazard classes:
+suppressions, committed baseline) runs two tiers of rules:
+
+Per-file tier (rules.py) — each rule sees one parsed file:
 
 * HPX001 lock-held-wait      — future/latch/CV waits lexically inside a
   ``with Mutex():`` region (the classic AMT deadlock, SURVEY.md §5.2).
@@ -25,33 +26,57 @@ runtime's real hazard classes:
   body (a fresh jitted callable per iteration defeats the trace cache).
 * HPX006 bare-except         — ``except:`` swallows future exceptions
   (and KeyboardInterrupt/SystemExit) on the completion path.
+* HPX007–HPX012              — see the README lint table.
 
-Run it: ``python -m hpx_tpu.analysis [paths...]`` (defaults to
-``hpx_tpu/``; run from the repo root so baseline paths line up).
+Whole-program tier (project.py) — every file is parsed once into a
+shared :class:`~.project.ProjectIndex` (symbol table, class-level lock
+identities, intra-package call graph) and cross-module rules run over
+it:
+
+* HPX013 lock-order-inversion — Mutex/Spinlock pairs acquired in both
+  orders on different call paths, with both witness chains.
+* HPX014 config-key-schema   — every ``cfg.get*("hpx....")`` read must
+  be declared in ``core/config_schema.py``; flags undeclared reads,
+  dead keys, and getter/type mismatches.
+* HPX015 refcount-balance    — incref/pin without a matching
+  decref/unpin on every exit path (static twin of
+  ``BlockAllocator.leaked_blocks()``), in ``cache/`` and ``models/``.
+
+Run it: ``python -m hpx_tpu.analysis [paths...]`` or the installed
+``hpxlint`` script (defaults to ``hpx_tpu/``; run from the repo root so
+baseline paths line up).
 """
 
 from .engine import (
     Finding,
     LintResult,
+    ProjectRule,
     Rule,
     all_rules,
     apply_baseline,
     lint_paths,
     lint_source,
+    lint_sources,
     load_baseline,
     register,
+    stale_entries,
+    update_baseline_file,
     write_baseline,
 )
 
 __all__ = [
     "Finding",
     "LintResult",
+    "ProjectRule",
     "Rule",
     "all_rules",
     "apply_baseline",
     "lint_paths",
     "lint_source",
+    "lint_sources",
     "load_baseline",
     "register",
+    "stale_entries",
+    "update_baseline_file",
     "write_baseline",
 ]

@@ -1,8 +1,9 @@
-"""hpxlint CLI: ``python -m hpx_tpu.analysis [paths...]``.
+"""hpxlint CLI: ``python -m hpx_tpu.analysis [paths...]`` (also
+installed as the ``hpxlint`` console script).
 
 Exit codes: 0 clean (all findings suppressed or baselined), 1 new
-findings, 2 usage error.  Run from the repo root so the committed
-baseline's relative paths match.
+findings OR stale baseline entries, 2 usage error.  Run from the repo
+root so the committed baseline's relative paths match.
 """
 
 from __future__ import annotations
@@ -18,6 +19,8 @@ from .engine import (
     apply_baseline,
     lint_paths,
     load_baseline,
+    stale_entries,
+    update_baseline_file,
     write_baseline,
 )
 
@@ -30,6 +33,14 @@ def _list_rules() -> str:
         lines.append(f"{rule.id}  {rule.name:<20} [{rule.severity}]  "
                      f"{head}")
     return "\n".join(lines)
+
+
+def _github_line(f) -> str:
+    """GitHub Actions workflow-command annotation — renders the
+    finding inline on the PR diff in CI logs."""
+    level = "error" if f.severity == "error" else "warning"
+    return (f"::{level} file={f.path},line={f.line},col={f.col},"
+            f"title={f.rule}::{f.message}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -46,11 +57,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="report every finding, ignoring the baseline")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept all current findings into --baseline "
-                         "and exit 0")
+                         "and exit 0 (fresh justifications)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline from current findings, "
+                         "keeping justification strings of surviving "
+                         "entries and pruning stale ones")
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids/names to run "
                          "(default: all)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -72,21 +88,42 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{args.baseline}")
         return 0
 
+    if args.update_baseline:
+        kept, pruned = update_baseline_file(result.findings,
+                                            args.baseline)
+        print(f"hpxlint: rewrote {args.baseline}: {kept} entrie(s) "
+              f"kept, {pruned} stale entrie(s) pruned")
+        return 0
+
     budget = {} if args.no_baseline else load_baseline(args.baseline)
     new, baselined = apply_baseline(result.findings, budget)
+    stale = stale_entries(result.findings, budget)
 
     if args.format == "json":
         print(json.dumps({
             "findings": [vars(f) for f in new],
             "baselined": baselined, "suppressed": result.suppressed,
+            "stale_baseline_entries": [
+                {"path": p, "rule": r, "message": m, "count": c}
+                for (p, r, m), c in sorted(stale.items())],
             "checked_files": result.checked_files}, indent=1))
+    elif args.format == "github":
+        for f in new:
+            print(_github_line(f))
+        for (p, r, m), c in sorted(stale.items()):
+            print(f"::warning file={p},title=stale-baseline::baseline "
+                  f"entry no longer matches any finding ({r}: {m}); "
+                  "run hpxlint --update-baseline")
     else:
         for f in new:
             print(f.format())
+        for (p, r, m), c in sorted(stale.items()):
+            print(f"{p}: stale baseline entry ({r}, count {c}): {m}")
         print(f"hpxlint: {result.checked_files} file(s), "
               f"{len(new)} new finding(s), {baselined} baselined, "
-              f"{result.suppressed} suppressed")
-    return 1 if new else 0
+              f"{result.suppressed} suppressed, "
+              f"{len(stale)} stale baseline entrie(s)")
+    return 1 if (new or stale) else 0
 
 
 if __name__ == "__main__":

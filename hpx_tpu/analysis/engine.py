@@ -74,6 +74,7 @@ class Rule:
     id: str = "HPX000"
     name: str = ""
     severity: str = "error"
+    scope: str = "file"
 
     def check(self, ctx: "FileContext") -> Iterable[Finding]:
         raise NotImplementedError
@@ -87,10 +88,36 @@ class Rule:
                        message=message)
 
 
+class ProjectRule(Rule):
+    """Whole-program rule: runs once per lint over the shared
+    :class:`~.project.ProjectIndex` (every file parsed exactly once,
+    symbol/lock/call information pre-resolved) instead of once per
+    file.  Subclasses implement check_project(); check() never runs.
+    """
+
+    scope: str = "project"
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, index) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding_at(self, display_path: str, node: ast.AST,
+                   message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity,
+                       path=display_path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message)
+
+
 def all_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
     """Instances of every registered rule (or the selected subset, by
     id or name), in id order."""
     from . import rules as _rules  # noqa: F401  (registers on import)
+
+    from . import project as _project  # noqa: F401  (registers on import)
 
     chosen = []
     for rid in sorted(_REGISTRY):
@@ -108,17 +135,38 @@ def all_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
 # Per-file context: parsed tree, import aliases, suppressions
 # ---------------------------------------------------------------------------
 
+# Total ast.parse calls since import — the perf-guard test asserts a
+# full two-tier run over N files bumps this by exactly N (the project
+# tier shares the per-file tier's parsed trees, never re-parses).
+_PARSE_COUNT = 0
+
+
+def parse_count() -> int:
+    return _PARSE_COUNT
+
+
 class FileContext:
     """Everything a rule needs about one file, computed once."""
 
     def __init__(self, source: str, display_path: str) -> None:
+        global _PARSE_COUNT
         self.source = source
         # posix-style path as shown in findings and matched by the
         # baseline; callers pass paths relative to the scan root (repo
         # root in CI) so records are machine-independent
         self.display_path = display_path.replace(os.sep, "/")
         self.tree = ast.parse(source)
+        _PARSE_COUNT += 1
         self._aliases = _import_aliases(self.tree)
+        self._header_lines = _statement_header_lines(self.tree)
+
+    def suppression_lines(self, line: int) -> set:
+        """All lines where an inline directive may suppress a finding
+        reported at `line`: the line itself plus the first line of any
+        multi-line statement whose header span covers it (so a
+        ``# hpxlint: disable=`` on a ``with``/``def`` header works for
+        findings on the header's continuation lines)."""
+        return {line} | self._header_lines.get(line, set())
 
     def resolve_call(self, func: ast.AST) -> str:
         """Canonical dotted name of a call target, import-aliases
@@ -142,6 +190,28 @@ class FileContext:
 
     def in_subpath(self, *fragments: str) -> bool:
         return any(f in self.display_path for f in fragments)
+
+
+def _statement_header_lines(tree: ast.Module) -> Dict[int, set]:
+    """line -> {first line of each multi-line statement whose HEADER
+    span covers it}.  For compound statements (with/def/for/...) the
+    header span runs up to the first body statement; for simple
+    statements it is the whole statement.  Suppressions on the header
+    line then reach findings anchored to continuation lines, without
+    letting a ``with``-line directive blanket the whole block body."""
+    out: Dict[int, set] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+            end = body[0].lineno - 1
+        if end <= node.lineno:
+            continue
+        for ln in range(node.lineno + 1, end + 1):
+            out.setdefault(ln, set()).add(node.lineno)
+    return out
 
 
 def _import_aliases(tree: ast.Module) -> Dict[str, str]:
@@ -219,14 +289,21 @@ class Suppressions:
                                if ln > lineno), lineno + 1)
             self.by_line.setdefault(target, set()).update(names)
 
-    def suppresses(self, finding: Finding) -> bool:
+    def suppresses(self, finding: Finding,
+                   lines: Optional[Iterable[int]] = None) -> bool:
+        """`lines` widens the match beyond the reported line — callers
+        pass ctx.suppression_lines(finding.line) so a directive on a
+        multi-line statement's header also suppresses."""
         rule_cls = _REGISTRY.get(finding.rule)
         labels = {finding.rule, "all"}
         if rule_cls is not None:
             labels.add(rule_cls.name)
         if labels & self.whole_file:
             return True
-        return bool(labels & self.by_line.get(finding.line, set()))
+        for ln in (lines if lines is not None else (finding.line,)):
+            if labels & self.by_line.get(ln, set()):
+                return True
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -240,29 +317,69 @@ class LintResult:
     checked_files: int = 0
 
 
+def lint_sources(sources: Dict[str, str],
+                 rules: Optional[Sequence[Rule]] = None) -> LintResult:
+    """The core two-tier runner over in-memory sources
+    ({display_path: source}).
+
+    Tier 1 runs every file-scope rule per file; tier 2 builds one
+    :class:`~.project.ProjectIndex` from the SAME parsed trees (no
+    re-parse) and runs the project-scope rules across them.  Inline
+    suppressions apply to both tiers, matched in the file a finding
+    is reported in.
+    """
+    rules = list(rules) if rules is not None else all_rules()
+    file_rules = [r for r in rules if r.scope == "file"]
+    project_rules = [r for r in rules if r.scope == "project"]
+
+    kept: List[Finding] = []
+    n_sup = 0
+    contexts: Dict[str, FileContext] = {}
+    sups: Dict[str, Suppressions] = {}
+    n_files = 0
+    for display_path, source in sources.items():
+        n_files += 1
+        display = display_path.replace(os.sep, "/")
+        try:
+            ctx = FileContext(source, display_path)
+        except SyntaxError as e:
+            kept.append(Finding(
+                rule="HPX000", severity="error", path=display,
+                line=e.lineno or 1, col=(e.offset or 0) or 1,
+                message=f"syntax error: {e.msg}"))
+            continue
+        sup = Suppressions(source)
+        contexts[display] = ctx
+        sups[display] = sup
+        for rule in file_rules:
+            for f in rule.check(ctx):
+                if sup.suppresses(f, ctx.suppression_lines(f.line)):
+                    n_sup += 1
+                else:
+                    kept.append(f)
+
+    if project_rules and contexts:
+        from .project import ProjectIndex
+        index = ProjectIndex(list(contexts.values()))
+        for rule in project_rules:
+            for f in rule.check_project(index):
+                ctx = contexts.get(f.path)
+                sup = sups.get(f.path)
+                if sup is not None and sup.suppresses(
+                        f, ctx.suppression_lines(f.line) if ctx else None):
+                    n_sup += 1
+                else:
+                    kept.append(f)
+
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings=kept, suppressed=n_sup,
+                      checked_files=n_files)
+
+
 def lint_source(source: str, display_path: str,
                 rules: Optional[Sequence[Rule]] = None) -> LintResult:
     """Lint one in-memory source blob (the unit the fixture tests use)."""
-    rules = list(rules) if rules is not None else all_rules()
-    try:
-        ctx = FileContext(source, display_path)
-    except SyntaxError as e:
-        return LintResult(findings=[Finding(
-            rule="HPX000", severity="error",
-            path=display_path.replace(os.sep, "/"),
-            line=e.lineno or 1, col=(e.offset or 0) or 1,
-            message=f"syntax error: {e.msg}")], checked_files=1)
-    sup = Suppressions(source)
-    kept: List[Finding] = []
-    n_sup = 0
-    for rule in rules:
-        for f in rule.check(ctx):
-            if sup.suppresses(f):
-                n_sup += 1
-            else:
-                kept.append(f)
-    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return LintResult(findings=kept, suppressed=n_sup, checked_files=1)
+    return lint_sources({display_path: source}, rules)
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
@@ -282,10 +399,9 @@ def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
 
 def lint_paths(paths: Sequence[str],
                rules: Optional[Sequence[Rule]] = None) -> LintResult:
-    rules = list(rules) if rules is not None else all_rules()
-    total = LintResult(findings=[])
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))  # parent of hpx_tpu/
+    sources: Dict[str, str] = {}
     for path in iter_py_files(paths):
         with open(path, encoding="utf-8") as f:
             source = f.read()
@@ -298,12 +414,8 @@ def lint_paths(paths: Sequence[str],
             rel = os.path.relpath(path)
             # keep display paths rooted at the scan target, never "../.."
             display = path if rel.startswith("..") else rel
-        res = lint_source(source, display, rules)
-        total.findings.extend(res.findings)
-        total.suppressed += res.suppressed
-        total.checked_files += 1
-    total.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return total
+        sources[display] = source
+    return lint_sources(sources, rules)
 
 
 # ---------------------------------------------------------------------------
@@ -367,3 +479,61 @@ def write_baseline(findings: Sequence[Finding], path: str,
                    "only (matching ignores it).",
                    "entries": entries}, f, indent=1)
         f.write("\n")
+
+
+def stale_entries(findings: Sequence[Finding],
+                  budget: Dict[Tuple[str, str, str], int],
+                  ) -> Dict[Tuple[str, str, str], int]:
+    """Baseline budget no current finding consumes: {key: leftover}.
+    A non-empty result means the code got cleaner than the baseline
+    records — the gate fails until the baseline is rewritten
+    (``--update-baseline``), so the baseline only burns down."""
+    remaining = dict(budget)
+    for f in findings:
+        k = f.baseline_key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+    return {k: v for k, v in remaining.items() if v > 0}
+
+
+def update_baseline_file(findings: Sequence[Finding], path: str,
+                         default_justification: str = "accepted "
+                         "pre-existing finding (hpxlint --update-baseline)",
+                         ) -> Tuple[int, int]:
+    """Rewrite the baseline from the CURRENT findings, keeping the
+    committed justification string of every entry that survives and
+    pruning entries nothing matches anymore.  Returns
+    (entries_written, entries_pruned)."""
+    old_just: Dict[Tuple[str, str, str], str] = {}
+    old_keys: set = set()
+    try:
+        with open(path, encoding="utf-8") as f:
+            rec = json.load(f)
+        for e in rec.get("entries", []):
+            k = (e["path"], e["rule"], e["message"])
+            old_keys.add(k)
+            j = e.get("justification")
+            if j:
+                old_just.setdefault(k, j)
+    except OSError:
+        pass
+    counts: Dict[Tuple[str, str, str], int] = {}
+    lines: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        k = f.baseline_key()
+        counts[k] = counts.get(k, 0) + 1
+        lines.setdefault(k, f.line)
+    entries = [{"path": p, "rule": r, "message": m, "count": c,
+                "near_line": lines[(p, r, m)],
+                "justification": old_just.get(
+                    (p, r, m), default_justification)}
+               for (p, r, m), c in sorted(counts.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"comment": "hpxlint baseline — pre-existing findings "
+                   "accepted with justification; new findings beyond "
+                   "these counts fail the gate. near_line is advisory "
+                   "only (matching ignores it).",
+                   "entries": entries}, f, indent=1)
+        f.write("\n")
+    pruned = len(old_keys - set(counts))
+    return len(entries), pruned

@@ -1,0 +1,826 @@
+"""hpxlint whole-program tier: symbol index, call graph, cross-module rules.
+
+The per-file tier (rules.py) reasons about one ``FileContext`` at a
+time; this tier builds one :class:`ProjectIndex` over the SAME parsed
+trees (the engine hands the contexts over — no file is parsed twice)
+and resolves what a single file cannot see:
+
+* module-level name resolution (import aliases, including relative
+  imports, mapped back onto the modules in the linted set),
+* lock identity across instances (``self._lock`` in class ``C`` of
+  module ``m`` is the one lock ``m.C._lock`` for ordering purposes),
+* intra-package call edges (``self.m()``, ``self.attr.m()`` via
+  attribute-type inference, ``mod.f()`` via aliases).
+
+Three rules run on the index:
+
+* HPX013 — lock-order inversion across the call graph,
+* HPX014 — every ``cfg.get*("hpx....")`` read checked against the
+  ``core/config_schema.py`` registry (undeclared reads, dead keys,
+  getter/type mismatches),
+* HPX015 — incref/pin vs decref/unpin balance on every exit path
+  (the static twin of ``BlockAllocator.leaked_blocks()``).
+
+Pure stdlib, like the rest of the linter.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import FileContext, Finding, ProjectRule, register
+
+_LOCK_TYPES = {"Mutex", "Spinlock", "SharedMutex"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _module_name(display_path: str) -> str:
+    p = display_path
+    if p.startswith("./"):
+        p = p[2:]
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def _relative_aliases(tree: ast.Module, module: str,
+                      is_package: bool) -> Dict[str, str]:
+    """Import-alias map with relative imports resolved against
+    `module` (FileContext's own alias map only handles absolute
+    imports — cross-module resolution needs ``from . import x`` too)."""
+    aliases: Dict[str, str] = {}
+    parts = module.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                # package containing this module, then up level-1 more
+                keep = len(parts) - (0 if is_package else 1) \
+                    - (node.level - 1)
+                if keep < 0:
+                    continue
+                pkg = ".".join(parts[:keep])
+                base = f"{pkg}.{node.module}" if node.module else pkg
+            if not base:
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    aliases[a.asname or a.name] = f"{base}.{a.name}"
+    return aliases
+
+
+class FunctionInfo:
+    """One function/method: lock acquisitions and outgoing calls, each
+    annotated with the locks held at that point (class-level lock
+    identity, lexical `with` nesting)."""
+
+    __slots__ = ("qname", "module", "cls", "node", "path",
+                 "acquires", "calls", "reads")
+
+    def __init__(self, qname: str, module: str, cls: Optional[str],
+                 node: ast.AST, path: str) -> None:
+        self.qname = qname
+        self.module = module
+        self.cls = cls
+        self.node = node
+        self.path = path
+        # (lock_id, node, held_tuple_at_acquire)
+        self.acquires: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        # (descriptor, node, held_tuple) — resolved to qnames later
+        self.calls: List[Tuple[tuple, ast.AST, Tuple[str, ...]]] = []
+        # (getter, key, node) config reads
+        self.reads: List[Tuple[str, str, ast.AST]] = []
+
+
+_GETTERS = {"get": None, "get_int": "int",
+            "get_bool": "bool", "get_float": "float"}
+
+
+class ProjectIndex:
+    """Symbol index + call graph over every successfully-parsed file
+    in one lint invocation. Built once, shared by all project rules."""
+
+    def __init__(self, contexts: Sequence[FileContext]) -> None:
+        self.contexts = {c.display_path: c for c in contexts}
+        self.module_of_path: Dict[str, str] = {}
+        self.path_of_module: Dict[str, str] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[Tuple[str, str], ast.ClassDef] = {}
+        self.locks: Set[str] = set()
+        # (module, cls) -> {attr -> (type_module, type_class)}
+        self.attr_types: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
+        self.aliases: Dict[str, Dict[str, str]] = {}
+        # config reads across the whole set: (getter, key, node, path)
+        self.config_reads: List[Tuple[str, str, ast.AST, str]] = []
+
+        for ctx in contexts:
+            mod = _module_name(ctx.display_path)
+            self.module_of_path[ctx.display_path] = mod
+            self.path_of_module[mod] = ctx.display_path
+            is_pkg = ctx.display_path.endswith("__init__.py")
+            self.aliases[mod] = _relative_aliases(ctx.tree, mod, is_pkg)
+            self._collect_symbols(ctx, mod)
+        for ctx in contexts:
+            self._collect_functions(ctx, self.module_of_path[ctx.display_path])
+
+    # -- pass 1: classes, lock identities, attribute types ------------------
+
+    def _collect_symbols(self, ctx: FileContext, mod: str) -> None:
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self.classes[(mod, stmt.name)] = stmt
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                for name in self._lock_targets(stmt, want_self=False):
+                    self.locks.add(f"{mod}.{name}")
+        for (m, cname), cdef in list(self.classes.items()):
+            if m != mod:
+                continue
+            for stmt in cdef.body:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    for name in self._lock_targets(stmt, want_self=False):
+                        self.locks.add(f"{mod}.{cname}.{name}")
+            for meth in cdef.body:
+                if not isinstance(meth, _FUNC_NODES):
+                    continue
+                for node in ast.walk(meth):
+                    if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                        for name in self._lock_targets(node,
+                                                       want_self=True):
+                            self.locks.add(f"{mod}.{cname}.{name}")
+
+    def _lock_targets(self, stmt: ast.AST,
+                      want_self: bool) -> Iterable[str]:
+        value = getattr(stmt, "value", None)
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, (ast.Name, ast.Attribute))):
+            return
+        callee = (value.func.id if isinstance(value.func, ast.Name)
+                  else value.func.attr)
+        if callee not in _LOCK_TYPES:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for t in targets:
+            if want_self:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    yield t.attr
+            elif isinstance(t, ast.Name):
+                yield t.id
+
+    # -- pass 2: per-function acquire/call/read collection ------------------
+
+    def _collect_functions(self, ctx: FileContext, mod: str) -> None:
+        self._infer_attr_types(ctx, mod)
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, _FUNC_NODES):
+                self._scan_function(ctx, mod, None, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                for meth in stmt.body:
+                    if isinstance(meth, _FUNC_NODES):
+                        self._scan_function(ctx, mod, stmt.name, meth)
+
+    def _infer_attr_types(self, ctx: FileContext, mod: str) -> None:
+        """self.X = Cls(...) / self.X = annotated_param / self.X: Cls
+        where Cls is a class in the linted set."""
+        amap = self.aliases[mod]
+
+        def resolve_cls(name_expr: ast.AST) -> Optional[Tuple[str, str]]:
+            if isinstance(name_expr, ast.Name):
+                dotted = amap.get(name_expr.id, f"{mod}.{name_expr.id}")
+            elif isinstance(name_expr, ast.Attribute) \
+                    and isinstance(name_expr.value, ast.Name):
+                head = amap.get(name_expr.value.id, name_expr.value.id)
+                dotted = f"{head}.{name_expr.attr}"
+            else:
+                return None
+            m, _, c = dotted.rpartition(".")
+            return (m, c) if (m, c) in self.classes else None
+
+        for stmt in ctx.tree.body:
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            types = self.attr_types.setdefault((mod, stmt.name), {})
+            for meth in stmt.body:
+                if not isinstance(meth, _FUNC_NODES):
+                    continue
+                ann_of_param: Dict[str, Tuple[str, str]] = {}
+                for arg in (meth.args.posonlyargs + meth.args.args
+                            + meth.args.kwonlyargs):
+                    if arg.annotation is not None:
+                        hit = resolve_cls(arg.annotation)
+                        if hit:
+                            ann_of_param[arg.arg] = hit
+                for node in ast.walk(meth):
+                    target = None
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1:
+                        target = node.targets[0]
+                    elif isinstance(node, ast.AnnAssign):
+                        target = node.target
+                    if not (isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"):
+                        continue
+                    hit = None
+                    value = getattr(node, "value", None)
+                    if isinstance(node, ast.AnnAssign) \
+                            and node.annotation is not None:
+                        hit = resolve_cls(node.annotation)
+                    if hit is None and isinstance(value, ast.Call):
+                        hit = resolve_cls(value.func)
+                    if hit is None and isinstance(value, ast.Name):
+                        hit = ann_of_param.get(value.id)
+                    if hit:
+                        types.setdefault(target.attr, hit)
+
+    def _lock_id(self, expr: ast.AST, mod: str,
+                 cls: Optional[str]) -> str:
+        """'' or the project-wide identity of a `with` lock expr."""
+        if isinstance(expr, ast.Call) and isinstance(expr.func,
+                                                     ast.Attribute) \
+                and expr.func.attr == "shared":
+            return self._lock_id(expr.func.value, mod, cls)
+        if isinstance(expr, ast.Name):
+            lid = f"{mod}.{expr.id}"
+            return lid if lid in self.locks else ""
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self" and cls:
+                lid = f"{mod}.{cls}.{expr.attr}"
+                return lid if lid in self.locks else ""
+            if isinstance(base, ast.Name):
+                head = self.aliases[mod].get(base.id)
+                if head:
+                    lid = f"{head}.{expr.attr}"
+                    return lid if lid in self.locks else ""
+        return ""
+
+    def _scan_function(self, ctx: FileContext, mod: str,
+                       cls: Optional[str], fn: ast.AST) -> None:
+        qname = f"{mod}:{cls}.{fn.name}" if cls else f"{mod}:{fn.name}"
+        info = FunctionInfo(qname, mod, cls, fn, ctx.display_path)
+        self.functions[qname] = info
+
+        def visit(stmts: Sequence[ast.stmt],
+                  held: Tuple[str, ...]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+                    continue  # nested scope: not this function's body
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    self._scan_exprs(
+                        info, [i.context_expr for i in stmt.items],
+                        mod, held)
+                    new_held = held
+                    for item in stmt.items:
+                        lid = self._lock_id(item.context_expr, mod, cls)
+                        if lid:
+                            info.acquires.append(
+                                (lid, item.context_expr, new_held))
+                            new_held = new_held + (lid,)
+                    visit(stmt.body, new_held)
+                    continue
+                # header expressions first (test/iter/targets), then
+                # nested statement lists under the SAME held set
+                header: List[ast.AST] = []
+                for field in ("test", "iter", "target", "value",
+                              "targets", "exc", "cause", "msg",
+                              "subject"):
+                    v = getattr(stmt, field, None)
+                    if isinstance(v, ast.AST):
+                        header.append(v)
+                    elif isinstance(v, list):
+                        header.extend(x for x in v
+                                      if isinstance(x, ast.AST))
+                self._scan_exprs(info, header, mod, held)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list) and sub \
+                            and isinstance(sub[0], ast.stmt):
+                        visit(sub, held)
+                for h in getattr(stmt, "handlers", []):
+                    visit(h.body, held)
+                for c in getattr(stmt, "cases", []):
+                    visit(c.body, held)
+
+        visit(fn.body, ())
+        for g, key, node in info.reads:
+            self.config_reads.append((g, key, node, ctx.display_path))
+
+    def _scan_exprs(self, info: FunctionInfo, exprs: Sequence[ast.AST],
+                    mod: str, held: Tuple[str, ...]) -> None:
+        """Collect calls + config reads from expression trees (never
+        descends into nested statement bodies — exprs carry none)."""
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Lambda):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr in _GETTERS and node.args \
+                            and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str) \
+                            and node.args[0].value.startswith("hpx."):
+                        info.reads.append(
+                            (func.attr, node.args[0].value, node))
+                    base = func.value
+                    if isinstance(base, ast.Name):
+                        if base.id == "self":
+                            info.calls.append(
+                                (("self", func.attr), node, held))
+                        else:
+                            info.calls.append(
+                                (("dotted", base.id, func.attr),
+                                 node, held))
+                    elif (isinstance(base, ast.Attribute)
+                          and isinstance(base.value, ast.Name)
+                          and base.value.id == "self"):
+                        info.calls.append(
+                            (("selfattr", base.attr, func.attr),
+                             node, held))
+                elif isinstance(func, ast.Name):
+                    info.calls.append((("name", func.id), node, held))
+
+    # -- call resolution ----------------------------------------------------
+
+    def resolve_call(self, info: FunctionInfo,
+                     desc: tuple) -> List[str]:
+        """Candidate qnames in the linted set for one call descriptor."""
+        mod, cls = info.module, info.cls
+        kind = desc[0]
+        out: List[str] = []
+        if kind == "name":
+            name = desc[1]
+            if f"{mod}:{name}" in self.functions:
+                out.append(f"{mod}:{name}")
+            else:
+                dotted = self.aliases[mod].get(name)
+                if dotted:
+                    m, _, f = dotted.rpartition(".")
+                    if f"{m}:{f}" in self.functions:
+                        out.append(f"{m}:{f}")
+        elif kind == "self" and cls:
+            if f"{mod}:{cls}.{desc[1]}" in self.functions:
+                out.append(f"{mod}:{cls}.{desc[1]}")
+        elif kind == "selfattr" and cls:
+            hit = self.attr_types.get((mod, cls), {}).get(desc[1])
+            if hit and f"{hit[0]}:{hit[1]}.{desc[2]}" in self.functions:
+                out.append(f"{hit[0]}:{hit[1]}.{desc[2]}")
+        elif kind == "dotted":
+            head = self.aliases[mod].get(desc[1])
+            if head and f"{head}:{desc[2]}" in self.functions:
+                out.append(f"{head}:{desc[2]}")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# HPX013 — lock-order inversion
+# ---------------------------------------------------------------------------
+
+@register
+class LockOrderInversion(ProjectRule):
+    """HPX013: two Mutex/Spinlock locks are acquired in both orders on
+    different call paths — a textbook ABBA deadlock across threads.
+    Fix: pick one global order (document it next to the lock fields)
+    and restructure the later-acquired side to drop its lock first, or
+    move the cross-calling work outside the critical section."""
+
+    id = "HPX013"
+    name = "lock-order-inversion"
+    severity = "error"
+
+    def check_project(self, index: ProjectIndex) -> Iterable[Finding]:
+        # transitive locks-acquired per function, with witness chains
+        via: Dict[str, Dict[str, Tuple[str, ...]]] = {
+            q: {} for q in index.functions}
+        resolved: Dict[str, List[Tuple[List[str], ast.AST,
+                                       Tuple[str, ...]]]] = {}
+        for q in sorted(index.functions):
+            info = index.functions[q]
+            for lid, _node, _held in info.acquires:
+                via[q].setdefault(lid, (q,))
+            resolved[q] = [(index.resolve_call(info, d), n, h)
+                           for d, n, h in info.calls]
+        changed = True
+        while changed:
+            changed = False
+            for q in sorted(index.functions):
+                for callees, _node, _held in resolved[q]:
+                    for callee in callees:
+                        for lid, chain in via[callee].items():
+                            if lid not in via[q]:
+                                via[q][lid] = (q,) + chain
+                                changed = True
+
+        # edges held -> acquired, first witness wins (deterministic)
+        edges: Dict[Tuple[str, str],
+                    Tuple[Tuple[str, ...], ast.AST, str]] = {}
+        for q in sorted(index.functions):
+            info = index.functions[q]
+            for lid, node, held in info.acquires:
+                for b in held:
+                    if b != lid and (b, lid) not in edges:
+                        edges[(b, lid)] = ((q,), node, info.path)
+            for callees, node, held in resolved[q]:
+                for callee in callees:
+                    for lid, chain in via[callee].items():
+                        for b in held:
+                            if b != lid and (b, lid) not in edges:
+                                edges[(b, lid)] = (
+                                    (q,) + chain, node, info.path)
+
+        # reachability with path reconstruction over the edge set
+        succ: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            succ.setdefault(a, []).append(b)
+        for a in succ:
+            succ[a].sort()
+
+        def witness(src: str, dst: str) -> Optional[Tuple[str, ...]]:
+            seen = {src}
+            queue: List[Tuple[str, Tuple[str, ...]]] = [(src, ())]
+            while queue:
+                cur, chain = queue.pop(0)
+                for nxt in succ.get(cur, ()):
+                    step = edges[(cur, nxt)][0]
+                    merged = chain + tuple(
+                        f for f in step if not (chain and f == chain[-1]))
+                    if nxt == dst:
+                        return merged
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        queue.append((nxt, merged))
+            return None
+
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b) in sorted(edges):
+            pair = (min(a, b), max(a, b))
+            if pair in reported:
+                continue
+            back = witness(b, a)
+            if back is None:
+                continue
+            fwd = witness(a, b)
+            if fwd is None:
+                continue
+            reported.add(pair)
+            x, y = pair
+            wx = fwd if (a, b) == (x, y) else back
+            wy = back if (a, b) == (x, y) else fwd
+            _chain0, node, path = edges[(a, b)]
+            yield self.finding_at(
+                path, node,
+                f"lock-order inversion between {x} and {y}: "
+                f"{x} -> {y} via {' -> '.join(wx)}; "
+                f"{y} -> {x} via {' -> '.join(wy)}")
+
+
+# ---------------------------------------------------------------------------
+# HPX014 — config-key schema
+# ---------------------------------------------------------------------------
+
+def _schema_from_index(index: ProjectIndex
+                       ) -> Optional[Tuple[Dict[str, dict], str]]:
+    """Parse declare() calls out of a config_schema module in the
+    linted set: {key: {type, reserved, node}} plus its display path."""
+    for path, ctx in index.contexts.items():
+        if not (path.endswith("core/config_schema.py")
+                or _module_name(path).split(".")[-1] == "config_schema"):
+            continue
+        entries: Dict[str, dict] = {}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "declare"):
+                continue
+            args = node.args
+            if not (args and isinstance(args[0], ast.Constant)
+                    and isinstance(args[0].value, str)):
+                continue
+            ktype = ""
+            if len(args) > 1 and isinstance(args[1], ast.Constant):
+                ktype = str(args[1].value)
+            reserved = False
+            if len(args) > 4 and isinstance(args[4], ast.Constant):
+                reserved = bool(args[4].value)
+            for kw in node.keywords:
+                if kw.arg == "reserved" \
+                        and isinstance(kw.value, ast.Constant):
+                    reserved = bool(kw.value.value)
+                elif kw.arg == "type" \
+                        and isinstance(kw.value, ast.Constant):
+                    ktype = str(kw.value.value)
+            entries[args[0].value] = {
+                "type": ktype, "reserved": reserved, "node": node}
+        return entries, path
+    return None
+
+
+def _schema_fallback() -> Dict[str, dict]:
+    """Outside a whole-tree lint (single-file fixtures), fall back to
+    the real installed registry — pure stdlib, never imports jax."""
+    try:
+        from ..core import config_schema
+    except Exception:  # pragma: no cover — analysis must stay usable
+        return {}
+    return {k: {"type": e.type, "reserved": e.reserved, "node": None}
+            for k, e in config_schema.all_keys().items()}
+
+
+@register
+class ConfigKeySchema(ProjectRule):
+    """HPX014: stringly-typed config drift — a ``cfg.get*("hpx....")``
+    read of a key missing from core/config_schema.py (typo'd knobs
+    silently answer their default), a declared key nothing reads, or a
+    getter whose type contradicts the declaration. Fix: declare the
+    key (type, default, doc) in config_schema.py before reading it;
+    delete or mark ``reserved=True`` keys kept only for HPX parity;
+    align the getter with the declared type."""
+
+    id = "HPX014"
+    name = "config-key-schema"
+    severity = "error"
+
+    def check_project(self, index: ProjectIndex) -> Iterable[Finding]:
+        local = _schema_from_index(index)
+        if local is not None:
+            schema, schema_path = local
+        else:
+            schema, schema_path = _schema_fallback(), None
+        if not schema:
+            return
+        read_keys: Set[str] = set()
+        for getter, key, node, path in index.config_reads:
+            read_keys.add(key)
+            entry = schema.get(key)
+            if entry is None:
+                yield self.finding_at(
+                    path, node,
+                    f"config key '{key}' read via {getter}() is not "
+                    "declared in core/config_schema.py")
+                continue
+            want = _GETTERS[getter]
+            if want is not None and entry["type"] != want:
+                yield self.finding_at(
+                    path, node,
+                    f"config key '{key}' is declared '{entry['type']}' "
+                    f"but read via {getter}()")
+        if schema_path is not None:
+            # dead-key check only makes sense when the whole tree (and
+            # the registry itself) is in the linted set
+            for key in sorted(schema):
+                entry = schema[key]
+                if entry["reserved"] or key in read_keys:
+                    continue
+                yield self.finding_at(
+                    schema_path, entry["node"],
+                    f"config key '{key}' is declared but never read "
+                    "(wire a reader or mark it reserved=True)")
+
+
+# ---------------------------------------------------------------------------
+# HPX015 — refcount balance
+# ---------------------------------------------------------------------------
+
+_ACQ_OPS = {"incref": "decref", "pin": "unpin"}
+_REL_OPS = {"decref": "incref", "unpin": "pin"}
+_HPX015_SUBPATHS = ("hpx_tpu/cache/", "hpx_tpu/models/")
+_MAX_STATES = 64
+
+
+def _refcount_key(call: ast.Call, loop_iters: Dict[str, str]) -> str:
+    """Stable identity of the refcounted operand. Inside a loop whose
+    target is the operand, the ITERABLE names the population
+    (``for bid in pins: incref(bid)`` pairs with a later loop over the
+    same list, not with every other ``bid``)."""
+    if not call.args:
+        return "<none>"
+    arg = call.args[0]
+    if isinstance(arg, ast.Name) and arg.id in loop_iters:
+        return loop_iters[arg.id]
+    try:
+        return ast.unparse(arg)
+    except Exception:  # pragma: no cover
+        return "<expr>"
+
+
+class _FlowState:
+    """Immutable per-path refcount deltas: {(op_family, key): delta}."""
+
+    __slots__ = ("deltas",)
+
+    def __init__(self, deltas: Tuple[Tuple[Tuple[str, str], int], ...]
+                 = ()) -> None:
+        self.deltas = deltas
+
+    def bump(self, family: str, key: str, amount: int) -> "_FlowState":
+        d = dict(self.deltas)
+        k = (family, key)
+        d[k] = d.get(k, 0) + amount
+        if d[k] == 0:
+            del d[k]
+        return _FlowState(tuple(sorted(d.items())))
+
+    def positives(self) -> List[Tuple[str, str, int]]:
+        return [(fam, key, n) for (fam, key), n in self.deltas if n > 0]
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, _FlowState) \
+            and self.deltas == other.deltas
+
+    def __hash__(self) -> int:
+        return hash(self.deltas)
+
+
+class _RefcountWalker:
+    """Path-sensitive walk of one function body. Loops run 0-or-1
+    times (a pinning loop pairs with its releasing loop, not with
+    itself N times); If branches fork; Try handlers start from every
+    intermediate body state; Return/Raise snapshot exit states."""
+
+    def __init__(self) -> None:
+        self.exits: Set[_FlowState] = set()
+        self.acquire_nodes: Dict[Tuple[str, str], ast.AST] = {}
+        self.release_families: Set[Tuple[str, str]] = set()
+        self.bailed = False
+
+    def _ops_in(self, expr: ast.AST,
+                loop_iters: Dict[str, str]
+                ) -> List[Tuple[str, str, ast.Call]]:
+        out = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in _ACQ_OPS or attr in _REL_OPS:
+                    out.append((attr, _refcount_key(node, loop_iters),
+                                node))
+        return out
+
+    def _apply_exprs(self, states: Set[_FlowState],
+                     exprs: Sequence[ast.AST],
+                     loop_iters: Dict[str, str]) -> Set[_FlowState]:
+        for expr in exprs:
+            for attr, key, node in self._ops_in(expr, loop_iters):
+                if attr in _ACQ_OPS:
+                    fam = attr
+                    self.acquire_nodes.setdefault((fam, key), node)
+                    states = {s.bump(fam, key, +1) for s in states}
+                else:
+                    fam = _REL_OPS[attr]
+                    self.release_families.add((fam, key))
+                    states = {s.bump(fam, key, -1) for s in states}
+        return states
+
+    def walk(self, stmts: Sequence[ast.stmt],
+             states: Set[_FlowState],
+             loop_iters: Dict[str, str]) -> Set[_FlowState]:
+        for stmt in stmts:
+            if self.bailed:
+                return states
+            if len(states) > _MAX_STATES:
+                self.bailed = True
+                return states
+            states = self._step(stmt, states, loop_iters)
+            if not states:
+                return states  # all paths exited
+        return states
+
+    def _step(self, stmt: ast.stmt, states: Set[_FlowState],
+              loop_iters: Dict[str, str]) -> Set[_FlowState]:
+        if isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+            return states
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                states = self._apply_exprs(states, [stmt.value],
+                                           loop_iters)
+            self.exits |= states
+            return set()
+        if isinstance(stmt, ast.Raise):
+            exprs = [e for e in (stmt.exc, stmt.cause) if e is not None]
+            states = self._apply_exprs(states, exprs, loop_iters)
+            self.exits |= states
+            return set()
+        if isinstance(stmt, ast.If):
+            states = self._apply_exprs(states, [stmt.test], loop_iters)
+            taken = self.walk(stmt.body, set(states), loop_iters)
+            other = self.walk(stmt.orelse, set(states), loop_iters) \
+                if stmt.orelse else set(states)
+            return taken | other
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            states = self._apply_exprs(states, [stmt.iter], loop_iters)
+            inner = dict(loop_iters)
+            if isinstance(stmt.target, ast.Name):
+                try:
+                    inner[stmt.target.id] = ast.unparse(stmt.iter)
+                except Exception:  # pragma: no cover
+                    pass
+            once = self.walk(stmt.body, set(states), inner)
+            after = states | once  # 0 or 1 iterations
+            if stmt.orelse:
+                after = self.walk(stmt.orelse, after, loop_iters)
+            return after
+        if isinstance(stmt, ast.While):
+            states = self._apply_exprs(states, [stmt.test], loop_iters)
+            once = self.walk(stmt.body, set(states), loop_iters)
+            after = states | once
+            if stmt.orelse:
+                after = self.walk(stmt.orelse, after, loop_iters)
+            return after
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            states = self._apply_exprs(
+                states, [i.context_expr for i in stmt.items], loop_iters)
+            return self.walk(stmt.body, states, loop_iters)
+        if isinstance(stmt, ast.Try):
+            pre_exits = set(self.exits)
+            entry = set(states)
+            mid: Set[_FlowState] = set(entry)
+            cur = entry
+            for s in stmt.body:
+                cur = self._step(s, cur, loop_iters)
+                mid |= cur
+                if self.bailed or not cur:
+                    break
+            after = self.walk(stmt.orelse, cur, loop_iters) \
+                if (cur and stmt.orelse) else cur
+            for handler in stmt.handlers:
+                after |= self.walk(handler.body, set(mid), loop_iters)
+            if stmt.finalbody:
+                # a return/raise inside the try runs the finally BEFORE
+                # leaving the function, so exits recorded during the
+                # body/handler walks are rerouted through the finally's
+                # deltas instead of escaping with their pre-finally
+                # state (`incref; try: return x; finally: decref` is
+                # balanced)
+                escaped = self.exits - pre_exits
+                self.exits = pre_exits
+                after = self.walk(stmt.finalbody, after, loop_iters)
+                if escaped:
+                    self.exits |= self.walk(stmt.finalbody, escaped,
+                                            loop_iters)
+            return after
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return states
+        # simple statement: scan every expression it carries
+        exprs = [n for n in ast.iter_child_nodes(stmt)
+                 if isinstance(n, ast.expr)]
+        more = []
+        for n in ast.iter_child_nodes(stmt):
+            if isinstance(n, list):  # pragma: no cover — ast never does
+                more.extend(n)
+        return self._apply_exprs(states, exprs + more, loop_iters)
+
+
+@register
+class RefcountBalance(ProjectRule):
+    """HPX015: a block reference taken via incref()/pin() escapes on
+    some exit path without the matching decref()/unpin() — the static
+    twin of BlockAllocator.leaked_blocks(). Functions that only
+    acquire (ownership transfer to a tree/table, released elsewhere)
+    are exempt; the rule fires when the SAME function does release the
+    population on other paths but misses one. Fix: release in a
+    finally/except mirror of the acquire, or hand the reference to an
+    owner that retires it."""
+
+    id = "HPX015"
+    name = "refcount-balance"
+    severity = "error"
+
+    def check_project(self, index: ProjectIndex) -> Iterable[Finding]:
+        for qname in sorted(index.functions):
+            info = index.functions[qname]
+            if not any(s in info.path for s in _HPX015_SUBPATHS):
+                continue
+            fn = info.node
+            if not isinstance(fn, _FUNC_NODES):
+                continue
+            walker = _RefcountWalker()
+            final = walker.walk(fn.body, {_FlowState()}, {})
+            if walker.bailed:
+                continue
+            walker.exits |= final
+            flagged: Set[Tuple[str, str]] = set()
+            for state in walker.exits:
+                for fam, key, _n in state.positives():
+                    if (fam, key) not in walker.release_families:
+                        continue  # pure ownership transfer
+                    if (fam, key) in flagged:
+                        continue
+                    flagged.add((fam, key))
+                    yield self.finding_at(
+                        info.path, walker.acquire_nodes[(fam, key)],
+                        f"{fam}({key}) in {qname.split(':', 1)[1]} is "
+                        f"not matched by {_ACQ_OPS[fam]}() on every "
+                        "exit path")

@@ -24,75 +24,14 @@ import os
 import threading
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
 
+from . import config_schema
 from .errors import BadParameter
 
-# Compiled-in defaults (HPX: generated defaults in runtime_configuration.cpp)
-DEFAULTS: Dict[str, str] = {
-    "hpx.os_threads": "auto",            # host worker threads
-    "hpx.localities": "1",
-    "hpx.locality": "0",
-    "hpx.queuing": "local-priority-fifo",  # scheduler choice
-    "hpx.scheduler.native": "1",          # use C++ scheduler when available
-    "hpx.stacks.small_size": "0",         # no stackful coroutines on host
-    "hpx.parcel.enable": "1",
-    "hpx.parcel.port": "7910",
-    # generous: fresh interpreters on a loaded one-core host take tens
-    # of seconds to boot; ini/env/CLI can lower it to fail fast
-    "hpx.startup_timeout": "120",
-    "hpx.parcel.address": "127.0.0.1",
-    "hpx.parcel.bootstrap": "tcp",
-    "hpx.parcel.max_message_size": str(1 << 30),
-    "hpx.agas.service_mode": "bootstrap",  # locality 0 hosts the registry
-    "hpx.agas.max_pending_refcnt_requests": "4096",
-    "hpx.logging.level": "warning",
-    "hpx.logging.destination": "stderr",
-    "hpx.diagnostics.dump_config": "0",
-    "hpx.tpu.platform": "auto",           # auto | tpu | cpu
-    "hpx.tpu.default_dtype": "float32",
-    "hpx.tpu.donate_buffers": "1",
-    "hpx.tpu.watcher_threads": "2",       # future-completion watcher pool
-    "hpx.tpu.eager_futures": "1",         # device futures ready at dispatch
-    "hpx.counters.enable": "1",
-    # KV tokens per paged block (auto: HPX_PAGED_BLOCK env, then the
-    # table banked by `benchmarks/flash_tune.py --paged`, then 16)
-    "hpx.cache.block_size": "auto",
-    "hpx.cache.num_blocks": "auto",       # pool size (auto: 2x worst case)
-    "hpx.cache.radix_budget_blocks": "auto",  # prefix-tree HBM budget
-    "hpx.cache.prefix_reuse": "1",        # radix prefix matching on admit
-    "hpx.cache.kv_dtype": "bf16",         # paged pool storage: bf16 | int8
-    "hpx.serving.paged_kernel": "auto",   # auto | gather | fused
-    "hpx.serving.prefill_chunk": "128",   # prompt tokens per prefill chunk
-    "hpx.serving.prefill_buckets": "auto",  # chunk-width ladder (csv|auto)
-    "hpx.serving.async_dispatch": "1",    # decode without per-step sync
-    "hpx.serving.max_async_steps": "32",  # buffered steps before a sync
-    "hpx.serving.spec.enable": "0",       # speculative decode in serving
-    "hpx.serving.spec.k": "4",            # draft tokens per slot per step
-    "hpx.serving.spec.draft": "prompt",   # draft source: prompt | model
-    "hpx.serving.spec.ngram": "3",        # max n-gram for prompt lookup
-    "hpx.serving.spec.min_accept": "0.3", # adaptive-k backoff threshold
-    "hpx.serving.spec.adapt": "1",        # per-slot adaptive k on/off
-    "hpx.serving.spec.max_verify_faults": "2",  # verify faults before
-                                          # speculation self-disables
-    "hpx.serving.ckpt_every": "16",       # tokens between slot checkpoints
-    "hpx.serving.step_retries": "4",      # step attempts before shedding
-    "hpx.serving.retry_backoff_s": "0.005",  # base step-retry backoff
-    "hpx.serving.admit_retries": "8",     # admit OOM deferrals before shed
-    "hpx.serving.default_deadline_s": "0",  # per-request deadline (0=none)
-    "hpx.fault.enable": "0",              # svc/faultinject master switch
-    "hpx.fault.seed": "0",                # rate-mode RNG seed
-    "hpx.fault.rate": "0.0",              # per-check fault probability
-    "hpx.fault.sites": "",                # csv armed sites ("" = all)
-    "hpx.fault.max": "0",                 # total fault cap (0 = unlimited)
-    "hpx.fault.schedule": "",             # csv "site:nth" exact schedule
-    "hpx.trace.enabled": "0",             # svc/tracing off by default
-    "hpx.trace.buffer_events": "65536",   # ring capacity (drop-oldest)
-    "hpx.trace.counter_interval": "0.05", # s between counter samples
-    "hpx.trace.counters": "/serving*,/cache*,/threads*",
-    "hpx.checkpoint.dir": "./checkpoints",
-    "hpx.resiliency.replay_default_n": "3",
-    "hpx.exec.default_chunk": "auto",
-    "hpx.exec.min_chunk_size": "1",
-}
+# Compiled-in defaults (HPX: generated defaults in runtime_configuration.cpp).
+# Sourced from the central key registry — every key, its type, default and
+# doc string live in config_schema.py; hpxlint HPX014 keeps the registry
+# and the tree's cfg.get*() read sites in sync.
+DEFAULTS: Dict[str, str] = config_schema.defaults()
 
 
 def _parse_ini_text(text: str) -> Dict[str, str]:
@@ -180,17 +119,25 @@ def _cli_overlay(argv: Iterable[str]) -> Tuple[Dict[str, str], List[str]]:
 
 
 class Configuration:
-    """The resolved, layered configuration object (thread-safe)."""
+    """The resolved, layered configuration object (thread-safe).
+
+    ``strict=True`` turns the config_schema registry into a runtime
+    contract: reading or setting an undeclared ``hpx.``-prefixed key
+    raises BadParameter instead of silently answering the default —
+    the runtime twin of hpxlint HPX014's static check. Keys outside
+    the ``hpx.`` namespace are never policed (application-private)."""
 
     def __init__(self,
                  argv: Optional[Iterable[str]] = None,
                  overrides: Optional[Mapping[str, Any]] = None,
                  environ: Optional[Mapping[str, str]] = None,
-                 ini_files: Optional[Iterable[str]] = None):
+                 ini_files: Optional[Iterable[str]] = None,
+                 strict: bool = False):
         env = os.environ if environ is None else environ
         if argv is not None:
             argv = list(argv)     # may be a generator; we scan it twice
         self._lock = threading.Lock()
+        self._strict = bool(strict)
         self._data: Dict[str, str] = dict(DEFAULTS)
 
         # batch scheduler layer (above compiled defaults, below ini/env/
@@ -233,8 +180,16 @@ class Configuration:
             for k, v in overrides.items():
                 self._data[str(k)] = str(v)
 
+    def _check_declared(self, key: str) -> None:
+        if (self._strict and key.startswith("hpx.")
+                and not config_schema.is_declared(key)):
+            raise BadParameter(
+                f"undeclared config key {key!r} (strict mode): declare it "
+                "in hpx_tpu/core/config_schema.py first", "config")
+
     # -- queries ------------------------------------------------------------
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        self._check_declared(key)
         with self._lock:
             return self._data.get(key, default)
 
@@ -260,6 +215,7 @@ class Configuration:
             raise BadParameter(f"{key}={v!r} is not a float", "config") from e
 
     def set(self, key: str, value: Any) -> None:
+        self._check_declared(str(key))
         with self._lock:
             self._data[str(key)] = str(value)
 

@@ -1,0 +1,266 @@
+"""Central declaration of every ``hpx.*`` configuration key.
+
+Reference analog: HPX's generated ini default groups in
+runtime_configuration.cpp — every knob the runtime understands is
+declared in one place with its type and default, so a typo'd key is a
+startup error instead of a silently-ignored setting.
+
+Each key the tree reads through ``Configuration.get*`` must be declared
+here with its value type, compiled-in default (``None`` when the read
+site carries its own inline default), and a one-line doc string.
+``hpxlint`` rule HPX014 cross-checks this registry against every
+``cfg.get*("hpx....")`` call in the tree: undeclared reads, declared
+keys nothing reads, and getter/type mismatches all fail the lint gate.
+``Configuration(strict=True)`` enforces the same contract at runtime.
+
+Keys marked ``reserved=True`` exist for HPX interface parity (accepted
+on the command line / ini so reference invocations keep working) but
+have no reader yet; HPX014 skips them in its dead-key check.
+
+Adding a config knob: declare it here FIRST (key, type, default, doc),
+then read it via ``runtime_config().get_<type>(...)`` — in that order,
+or HPX014 flags the read as undeclared and tier-1 fails.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+_VALID_TYPES = ("str", "int", "bool", "float")
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigKey:
+    """One declared configuration knob."""
+
+    key: str
+    type: str                 # "str" | "int" | "bool" | "float"
+    default: Optional[str]    # None = no compiled-in default
+    doc: str
+    reserved: bool = False    # HPX-parity: declared but not read (yet)
+
+
+_SCHEMA: Dict[str, ConfigKey] = {}
+
+
+def declare(key: str, type: str, default: Optional[str], doc: str,
+            reserved: bool = False) -> ConfigKey:
+    """Register one knob; duplicate keys and unknown types are errors."""
+    if type not in _VALID_TYPES:
+        raise ValueError(f"config key {key!r}: bad type {type!r} "
+                         f"(expected one of {_VALID_TYPES})")
+    if key in _SCHEMA:
+        raise ValueError(f"config key {key!r} declared twice")
+    entry = ConfigKey(key, type, default, doc, reserved)
+    _SCHEMA[key] = entry
+    return entry
+
+
+def is_declared(key: str) -> bool:
+    return key in _SCHEMA
+
+
+def lookup(key: str) -> Optional[ConfigKey]:
+    return _SCHEMA.get(key)
+
+
+def all_keys() -> Dict[str, ConfigKey]:
+    """Copy of the full registry (key -> ConfigKey)."""
+    return dict(_SCHEMA)
+
+
+def defaults() -> Dict[str, str]:
+    """The compiled-in defaults map consumed by ``config.DEFAULTS`` —
+    exactly the declared keys that carry a non-None default."""
+    return {k: e.default for k, e in _SCHEMA.items()
+            if e.default is not None}
+
+
+# ---------------------------------------------------------------------------
+# Declarations. Order matches the historical config.DEFAULTS layout so
+# the defaults() dict is drop-in identical; default-less keys (read
+# sites carry their own inline defaults) follow, grouped by section.
+# ---------------------------------------------------------------------------
+
+# -- core / scheduling ------------------------------------------------------
+declare("hpx.os_threads", "str", "auto", "host worker threads (auto = cores, floor 4)")
+declare("hpx.localities", "int", "1", "number of localities in the launch")
+declare("hpx.locality", "int", "0", "this process's locality id")
+declare("hpx.queuing", "str", "local-priority-fifo",
+        "scheduler choice", reserved=True)
+declare("hpx.scheduler.native", "bool", "1",
+        "use the C++ scheduler when available")
+declare("hpx.stacks.small_size", "int", "0",
+        "stackful-coroutine stack size (no stackful coroutines on host)",
+        reserved=True)
+
+# -- parcel layer -----------------------------------------------------------
+declare("hpx.parcel.enable", "bool", "1",
+        "parcel transport master switch", reserved=True)
+declare("hpx.parcel.port", "int", "7910", "TCP port for the parcelport")
+declare("hpx.startup_timeout", "float", "120",
+        "seconds to wait for all localities at startup")
+declare("hpx.parcel.address", "str", "127.0.0.1", "parcelport bind address")
+declare("hpx.parcel.bootstrap", "str", "tcp",
+        "bootstrap parcelport kind", reserved=True)
+declare("hpx.parcel.max_message_size", "int", str(1 << 30),
+        "largest admissible parcel in bytes", reserved=True)
+declare("hpx.parcel.secret", "str", None,
+        "shared HMAC secret for parcel authentication ('' = off)")
+declare("hpx.parcel.allow_insecure", "bool", None,
+        "permit unauthenticated parcels when no secret is set")
+declare("hpx.parcel.bind_any", "bool", None,
+        "bind the listening socket to 0.0.0.0 instead of the address")
+declare("hpx.parcel.compression", "str", None,
+        "wire compression codec ('' = off)")
+declare("hpx.parcel.compression_min_bytes", "int", None,
+        "compress only parcels at least this large")
+declare("hpx.parcel.coalescing", "bool", None,
+        "batch small parcels into one wire message")
+declare("hpx.parcel.coalescing_count", "int", None,
+        "max parcels folded into one coalesced message")
+declare("hpx.parcel.coalescing_bytes", "int", None,
+        "max coalesced payload bytes before an eager flush")
+declare("hpx.parcel.coalescing_interval", "float", None,
+        "seconds a parcel may wait in the coalescing buffer")
+declare("hpx.parcel.endpoint", "str", None,
+        "--hpx:hpx CLI sugar target (endpoint of locality 0)",
+        reserved=True)
+
+# -- AGAS / distributed control ---------------------------------------------
+declare("hpx.agas.service_mode", "str", "bootstrap",
+        "locality 0 hosts the registry", reserved=True)
+declare("hpx.agas.max_pending_refcnt_requests", "int", "4096",
+        "AGAS refcount request queue bound", reserved=True)
+declare("hpx.agas.endpoint", "str", None,
+        "--hpx:agas CLI sugar target (AGAS endpoint)", reserved=True)
+declare("hpx.connect", "bool", None,
+        "late-join this process to a running cluster")
+declare("hpx.route_timeout", "float", None,
+        "seconds an AGAS-routed parcel may wait for resolution")
+declare("hpx.barrier_timeout", "float", None,
+        "seconds a distributed barrier waits before failing")
+declare("hpx.shutdown_timeout", "float", None,
+        "seconds finalize waits for remote localities")
+declare("hpx.ignore_batch_env", "bool", None,
+        "--hpx:ignore-batch-env CLI sugar (consumed at config init)",
+        reserved=True)
+declare("hpx.dist.heartbeat_interval", "float", None,
+        "seconds between liveness heartbeats (0 = off)")
+declare("hpx.dist.heartbeat_suspect", "float", None,
+        "missed-heartbeat seconds before a locality is suspect")
+declare("hpx.dist.heartbeat_dead", "float", None,
+        "missed-heartbeat seconds before a locality is declared dead")
+declare("hpx.dist.idem_table_max", "int", None,
+        "bounded idempotency table size for resilient actions")
+
+# -- logging / diagnostics --------------------------------------------------
+declare("hpx.logging.level", "str", "warning", "minimum logged severity")
+declare("hpx.logging.destination", "str", "stderr", "log sink")
+declare("hpx.diagnostics.dump_config", "bool", "0",
+        "print the resolved configuration to stderr at runtime init")
+
+# -- TPU backend ------------------------------------------------------------
+declare("hpx.tpu.platform", "str", "auto", "auto | tpu | cpu", reserved=True)
+declare("hpx.tpu.default_dtype", "str", "float32",
+        "default device array dtype", reserved=True)
+declare("hpx.tpu.donate_buffers", "bool", "1",
+        "donate input buffers to XLA where safe", reserved=True)
+declare("hpx.tpu.watcher_threads", "int", "2",
+        "future-completion watcher pool width")
+declare("hpx.tpu.eager_futures", "bool", "1",
+        "device futures ready at dispatch")
+
+# -- performance counters ---------------------------------------------------
+declare("hpx.counters.enable", "bool", "1",
+        "performance-counter registry master switch", reserved=True)
+declare("hpx.counters.print", "str", None,
+        "csv counter name patterns printed at finalize "
+        "(--hpx:print-counter)")
+declare("hpx.counters.print_interval", "float", None,
+        "seconds between periodic counter prints (0 = finalize only)")
+
+# -- KV cache ---------------------------------------------------------------
+declare("hpx.cache.block_size", "str", "auto",
+        "KV tokens per paged block (auto: HPX_PAGED_BLOCK env, then the "
+        "table banked by benchmarks/flash_tune.py --paged, then 16)")
+declare("hpx.cache.num_blocks", "str", "auto",
+        "pool size (auto: 2x worst case)")
+declare("hpx.cache.radix_budget_blocks", "str", "auto",
+        "prefix-tree HBM budget")
+declare("hpx.cache.prefix_reuse", "bool", "1",
+        "radix prefix matching on admit")
+declare("hpx.cache.kv_dtype", "str", "bf16",
+        "paged pool storage: bf16 | int8")
+
+# -- serving ----------------------------------------------------------------
+declare("hpx.serving.paged_kernel", "str", "auto", "auto | gather | fused")
+declare("hpx.serving.prefill_chunk", "int", "128",
+        "prompt tokens per prefill chunk")
+declare("hpx.serving.prefill_buckets", "str", "auto",
+        "chunk-width ladder (csv|auto)")
+declare("hpx.serving.async_dispatch", "bool", "1",
+        "decode without per-step sync")
+declare("hpx.serving.max_async_steps", "int", "32",
+        "buffered steps before a sync")
+declare("hpx.serving.spec.enable", "bool", "0",
+        "speculative decode in serving")
+declare("hpx.serving.spec.k", "int", "4", "draft tokens per slot per step")
+declare("hpx.serving.spec.draft", "str", "prompt",
+        "draft source: prompt | model")
+declare("hpx.serving.spec.ngram", "int", "3",
+        "max n-gram for prompt lookup")
+declare("hpx.serving.spec.min_accept", "float", "0.3",
+        "adaptive-k backoff threshold")
+declare("hpx.serving.spec.adapt", "bool", "1",
+        "per-slot adaptive k on/off")
+declare("hpx.serving.spec.max_verify_faults", "int", "2",
+        "verify faults before speculation self-disables")
+declare("hpx.serving.ckpt_every", "int", "16",
+        "tokens between slot checkpoints")
+declare("hpx.serving.step_retries", "int", "4",
+        "step attempts before shedding")
+declare("hpx.serving.retry_backoff_s", "float", "0.005",
+        "base step-retry backoff")
+declare("hpx.serving.admit_retries", "int", "8",
+        "admit OOM deferrals before shed")
+declare("hpx.serving.default_deadline_s", "float", "0",
+        "per-request deadline (0=none)")
+declare("hpx.serving.disagg.max_queue", "int", None,
+        "disaggregated router: bound on queued prefill jobs")
+declare("hpx.serving.disagg.pump_steps", "int", None,
+        "decode steps per disagg pump iteration")
+declare("hpx.serving.disagg.prefill_jobs", "int", None,
+        "concurrent prefill jobs per prefill worker")
+declare("hpx.serving.disagg.xfer_retries", "int", None,
+        "KV transfer attempts before failing over")
+
+# -- fault injection --------------------------------------------------------
+declare("hpx.fault.enable", "bool", "0", "svc/faultinject master switch")
+declare("hpx.fault.seed", "int", "0", "rate-mode RNG seed")
+declare("hpx.fault.rate", "float", "0.0", "per-check fault probability")
+declare("hpx.fault.sites", "str", "", "csv armed sites ('' = all)")
+declare("hpx.fault.max", "int", "0", "total fault cap (0 = unlimited)")
+declare("hpx.fault.schedule", "str", "", "csv 'site:nth' exact schedule")
+declare("hpx.fault.parcel_delay_s", "float", None,
+        "injected parcel delivery delay for chaos runs")
+
+# -- tracing ----------------------------------------------------------------
+declare("hpx.trace.enabled", "bool", "0", "svc/tracing off by default")
+declare("hpx.trace.buffer_events", "int", "65536",
+        "ring capacity (drop-oldest)")
+declare("hpx.trace.counter_interval", "float", "0.05",
+        "s between counter samples")
+declare("hpx.trace.counters", "str", "/serving*,/cache*,/threads*",
+        "csv counter patterns sampled into the trace")
+
+# -- checkpoint / resiliency / exec -----------------------------------------
+declare("hpx.checkpoint.dir", "str", "./checkpoints",
+        "base directory for checkpoint_path() relative names")
+declare("hpx.resiliency.replay_default_n", "int", "3",
+        "replay attempts when callers pass n=None")
+declare("hpx.exec.default_chunk", "str", "auto",
+        "default chunker: auto | static[:N] | dynamic[:N] | guided | N")
+declare("hpx.exec.min_chunk_size", "int", "1",
+        "floor on per-chunk iterations for auto/guided chunking")

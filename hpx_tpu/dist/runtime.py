@@ -826,6 +826,11 @@ def init(argv: Optional[list] = None,
         if _runtime is not None:
             return _runtime
         cfg = Configuration(argv=argv, overrides=overrides)
+        if cfg.get_bool("hpx.diagnostics.dump_config"):
+            # --hpx:dump-config: print the fully-resolved configuration
+            # (HPX prints its merged ini at startup under the same flag)
+            import sys
+            print(cfg.dump(), file=sys.stderr)
         set_runtime_config(cfg)
         _runtime = Runtime(cfg)
         _start_counter_printing(cfg)

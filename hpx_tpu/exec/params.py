@@ -41,14 +41,17 @@ class StaticChunkSize(ChunkSize):
 class AutoChunkSize(ChunkSize):
     """HPX auto_chunk_size measures ~1% of iterations to pick a grain
     hitting a target chunk time. Host analog: aim for ~4 chunks/worker
-    (amortizes Python dispatch overhead while load-balancing)."""
+    (amortizes Python dispatch overhead while load-balancing);
+    ``min_size`` floors the grain (hpx.exec.min_chunk_size)."""
 
     chunks_per_worker: int = 4
+    min_size: int = 1
 
     def chunks(self, count: int, num_workers: int) -> list:
         if count <= 0:
             return []
-        target = max(1, count // max(1, num_workers * self.chunks_per_worker))
+        target = max(self.min_size, 1,
+                     count // max(1, num_workers * self.chunks_per_worker))
         return [min(target, count - i) for i in range(0, count, target)]
 
 
@@ -85,6 +88,33 @@ class NumCores:
     """Restrict a policy to n workers (hpx::execution::experimental::num_cores)."""
 
     cores: int = 0
+
+
+def default_chunker() -> ChunkSize:
+    """The chunker used when a policy carries no explicit ChunkSize —
+    the hpx.exec.default_chunk / hpx.exec.min_chunk_size knobs:
+
+      auto (default) | static[:N] | dynamic[:N] | guided | N (= static:N)
+    """
+    from ..core.config import runtime_config
+    cfg = runtime_config()
+    spec = (cfg.get("hpx.exec.default_chunk") or "auto").strip().lower()
+    min_size = max(1, cfg.get_int("hpx.exec.min_chunk_size", 1))
+    kind, _, arg = spec.partition(":")
+    if kind == "auto" or kind == "":
+        return AutoChunkSize(min_size=min_size)
+    if kind == "static":
+        return StaticChunkSize(int(arg) if arg else 0)
+    if kind == "dynamic":
+        return DynamicChunkSize(int(arg) if arg else max(1, min_size))
+    if kind == "guided":
+        return GuidedChunkSize(min_size=min_size)
+    if kind.isdigit():
+        return StaticChunkSize(int(kind))
+    from ..core.errors import BadParameter
+    raise BadParameter(
+        f"hpx.exec.default_chunk={spec!r}: expected "
+        "auto | static[:N] | dynamic[:N] | guided | N", "config")
 
 
 static_chunk_size = StaticChunkSize

@@ -168,6 +168,24 @@ def _save_to_file(path: Union[str, os.PathLike], build) -> Future:
             _publish, path, fut.get()))
 
 
+def checkpoint_dir() -> str:
+    """Base directory for named checkpoints — the hpx.checkpoint.dir
+    knob (created on first use)."""
+    from ..core.config import runtime_config
+    d = runtime_config().get("hpx.checkpoint.dir") or "./checkpoints"
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def checkpoint_path(name: str) -> str:
+    """Resolve a bare checkpoint name against hpx.checkpoint.dir;
+    absolute paths and explicit relative paths pass through unchanged,
+    so existing full-path callers keep their layout."""
+    if os.path.isabs(name) or os.sep in name:
+        return name
+    return os.path.join(checkpoint_dir(), name)
+
+
 def save_checkpoint_to_file(path: Union[str, os.PathLike],
                             *args: Any) -> Future:
     def build() -> Checkpoint:

@@ -54,6 +54,17 @@ class ReplicateVotingError(HpxError):
 # replay
 # ---------------------------------------------------------------------------
 
+def default_replay_n() -> int:
+    """Attempt count used when a replay API is called with ``n=None`` —
+    the hpx.resiliency.replay_default_n knob."""
+    from ..core.config import runtime_config
+    return runtime_config().get_int("hpx.resiliency.replay_default_n", 3)
+
+
+def _resolve_n(n: Optional[int]) -> int:
+    return default_replay_n() if n is None else n
+
+
 def _replay_loop(n: int, validate: Optional[Callable[[Any], bool]],
                  fn: Callable[..., Any], args: tuple, kwargs: dict) -> Any:
     last_exc: Optional[BaseException] = None
@@ -73,7 +84,7 @@ def _replay_loop(n: int, validate: Optional[Callable[[Any], bool]],
     raise ReplayValidationError(n)
 
 
-def async_replay(n: int, fn: Callable[..., Any], *args: Any,
+def async_replay(n: Optional[int], fn: Callable[..., Any], *args: Any,
                  retry_on: Optional[tuple] = None,
                  on_retry: Optional[Callable[[int, BaseException],
                                              None]] = None,
@@ -81,13 +92,15 @@ def async_replay(n: int, fn: Callable[..., Any], *args: Any,
                  backoff_factor: float = 2.0,
                  max_backoff_s: float = 1.0,
                  **kwargs: Any) -> Future:
-    """Run fn; on exception re-run, up to n attempts total.
+    """Run fn; on exception re-run, up to n attempts total
+    (``n=None`` reads the hpx.resiliency.replay_default_n knob).
 
     Grown the `sync_replay` policy knobs (typed ``retry_on`` filter,
     ``on_retry`` repair hook, exponential ``backoff_s``) so the
     distributed send path (`dist.actions.resilient_action`) can route
     its bounded retry through the one replay implementation. With no
     policy kwargs this is the classic reference-shaped replay."""
+    n = _resolve_n(n)
     if retry_on is None and on_retry is None and backoff_s == 0.0:
         return async_(_replay_loop, n, None, fn, args, kwargs)
     return async_(sync_replay, n, fn, *args,
@@ -96,14 +109,14 @@ def async_replay(n: int, fn: Callable[..., Any], *args: Any,
                   max_backoff_s=max_backoff_s, **kwargs)
 
 
-def async_replay_validate(n: int, validate: Callable[[Any], bool],
+def async_replay_validate(n: Optional[int], validate: Callable[[Any], bool],
                           fn: Callable[..., Any], *args: Any,
                           **kwargs: Any) -> Future:
     """Re-run until validate(result) is truthy, up to n attempts."""
-    return async_(_replay_loop, n, validate, fn, args, kwargs)
+    return async_(_replay_loop, _resolve_n(n), validate, fn, args, kwargs)
 
 
-def sync_replay(n: int, fn: Callable[..., Any], *args: Any,
+def sync_replay(n: Optional[int], fn: Callable[..., Any], *args: Any,
                 retry_on: tuple = (Exception,),
                 on_retry: Optional[Callable[[int, BaseException],
                                             None]] = None,
@@ -133,6 +146,7 @@ def sync_replay(n: int, fn: Callable[..., Any], *args: Any,
     step for nothing.
     """
     from ..exec.execution_base import suspend
+    n = _resolve_n(n)
     last_exc: Optional[BaseException] = None
     for attempt in range(n):
         if attempt > 0:

@@ -21,9 +21,10 @@ from hpx_tpu.analysis import (
     apply_baseline,
     lint_paths,
     lint_source,
+    lint_sources,
 )
 from hpx_tpu.analysis.cli import main as cli_main
-from hpx_tpu.analysis.engine import Suppressions, load_baseline
+from hpx_tpu.analysis.engine import Suppressions, load_baseline, parse_count
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -744,7 +745,356 @@ def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
                    "HPX005", "HPX006", "HPX007", "HPX008",
-                   "HPX009", "HPX010", "HPX011", "HPX012"]
+                   "HPX009", "HPX010", "HPX011", "HPX012",
+                   "HPX013", "HPX014", "HPX015"]
+
+
+def test_rule_registry_completeness(capsys):
+    """Every rule must document itself consistently in all four places
+    a reader finds it: the class docstring, the README lint table,
+    --list-rules output, and the project/file tier split."""
+    readme = open(os.path.join(REPO, "README.md"), encoding="utf-8").read()
+    assert cli_main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rule in all_rules():
+        doc = (type(rule).__doc__ or "")
+        assert doc.strip().startswith(f"{rule.id}: "), rule.id
+        assert f"| {rule.id} | {rule.name} |" in readme, \
+            f"{rule.id} missing from the README lint table"
+        assert rule.id in listed
+    project_ids = {r.id for r in all_rules() if r.scope == "project"}
+    assert project_ids == {"HPX013", "HPX014", "HPX015"}
+
+
+# ---------------------------------------------------------------------------
+# HPX013 — cross-module lock-order inversion (whole-program tier)
+# ---------------------------------------------------------------------------
+
+HPX013_A = """\
+from hpx_tpu.synchronization import Mutex
+from hpx_tpu.svc import b
+
+_a = Mutex()
+
+def outer():
+    with _a:
+        b.grab()
+
+def touch():
+    with _a:
+        pass
+"""
+
+HPX013_B_CYCLE = """\
+from hpx_tpu.synchronization import Mutex
+from hpx_tpu.svc import a
+
+_b = Mutex()
+
+def grab():
+    with _b:
+        pass
+
+def reverse():
+    with _b:
+        a.touch()
+"""
+
+HPX013_B_ORDERED = """\
+from hpx_tpu.synchronization import Mutex
+
+_b = Mutex()
+
+def grab():
+    with _b:
+        pass
+"""
+
+
+def test_hpx013_two_file_cycle_fires_with_both_witnesses():
+    res = lint_sources({"hpx_tpu/svc/a.py": HPX013_A,
+                        "hpx_tpu/svc/b.py": HPX013_B_CYCLE},
+                       rules=all_rules(["HPX013"]))
+    assert rules_of(res.findings) == ["HPX013"]
+    msg = res.findings[0].message
+    # both witness call chains, each naming the functions on the path
+    assert "hpx_tpu.svc.a:outer -> hpx_tpu.svc.b:grab" in msg
+    assert "hpx_tpu.svc.b:reverse -> hpx_tpu.svc.a:touch" in msg
+
+
+def test_hpx013_consistent_order_is_silent():
+    res = lint_sources({"hpx_tpu/svc/a.py": HPX013_A,
+                        "hpx_tpu/svc/b.py": HPX013_B_ORDERED},
+                       rules=all_rules(["HPX013"]))
+    assert res.findings == []
+
+
+def test_hpx013_single_file_nested_inversion_fires():
+    src = """\
+from hpx_tpu.synchronization import Mutex
+
+_x = Mutex()
+_y = Mutex()
+
+def forward():
+    with _x:
+        with _y:
+            pass
+
+def backward():
+    with _y:
+        with _x:
+            pass
+"""
+    res = lint_sources({"hpx_tpu/svc/m.py": src},
+                       rules=all_rules(["HPX013"]))
+    assert rules_of(res.findings) == ["HPX013"]
+
+
+# ---------------------------------------------------------------------------
+# HPX014 — config keys must be declared in core/config_schema.py
+# ---------------------------------------------------------------------------
+
+HPX014_SCHEMA = """\
+def declare(key, type, default=None, doc="", reserved=False):
+    pass
+
+declare("hpx.fix.workers", "int", "4", "worker count")
+declare("hpx.fix.trace", "bool", "0", "tracing toggle")
+declare("hpx.fix.dead", "str", "x", "never read anywhere")
+declare("hpx.fix.parity", "str", None, "HPX parity", reserved=True)
+"""
+
+HPX014_READER = """\
+def setup(cfg):
+    n = cfg.get_int("hpx.fix.workers")
+    t = cfg.get_int("hpx.fix.trace")
+    z = cfg.get("hpx.fix.typo_key")
+    return n, t, z
+"""
+
+
+def _hpx014(sources):
+    res = lint_sources(sources, rules=all_rules(["HPX014"]))
+    return res.findings
+
+
+def test_hpx014_undeclared_read_type_mismatch_and_dead_key():
+    fs = _hpx014({"hpx_tpu/core/config_schema.py": HPX014_SCHEMA,
+                  "hpx_tpu/svc/reader.py": HPX014_READER})
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 3
+    assert any("'hpx.fix.typo_key' read via get() is not declared"
+               in m for m in msgs)
+    assert any("'hpx.fix.trace' is declared 'bool' but read via "
+               "get_int()" in m for m in msgs)
+    assert any("'hpx.fix.dead' is declared but never read" in m
+               for m in msgs)
+
+
+def test_hpx014_declared_and_reserved_keys_are_silent():
+    clean = """\
+def setup(cfg):
+    n = cfg.get_int("hpx.fix.workers")
+    t = cfg.get_bool("hpx.fix.trace")
+    d = cfg.get("hpx.fix.dead")
+    return n, t, d
+"""
+    assert _hpx014({"hpx_tpu/core/config_schema.py": HPX014_SCHEMA,
+                    "hpx_tpu/svc/reader.py": clean}) == []
+
+
+def test_hpx014_real_tree_schema_is_exhaustive():
+    # the shipped registry declares every key the tree reads, exactly:
+    # no undeclared reads, no dead keys (modulo reserved= parity keys)
+    res = lint_paths([os.path.join(REPO, "hpx_tpu")],
+                     rules=all_rules(["HPX014"]))
+    assert res.findings == [], "\n".join(f.format() for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# HPX015 — incref/pin balance on every exit path (cache/ + models/)
+# ---------------------------------------------------------------------------
+
+def _hpx015(source):
+    res = lint_sources({"hpx_tpu/cache/fixture.py": source},
+                       rules=all_rules(["HPX015"]))
+    return res.findings
+
+
+def test_hpx015_early_return_leak_fires():
+    fs = _hpx015("""\
+class Pool:
+    def take(self, alloc, bid):
+        alloc.incref(bid)
+        if bid < 0:
+            return None
+        v = self.read(bid)
+        alloc.decref(bid)
+        return v
+""")
+    assert rules_of(fs) == ["HPX015"]
+    assert "incref(bid) in Pool.take" in fs[0].message
+
+
+def test_hpx015_try_finally_balance_is_silent():
+    assert _hpx015("""\
+class Pool:
+    def take(self, alloc, bid):
+        alloc.incref(bid)
+        try:
+            return self.read(bid)
+        finally:
+            alloc.decref(bid)
+""") == []
+
+
+def test_hpx015_leak_inside_try_still_fires():
+    # the finally here does NOT release; the early return leaks
+    fs = _hpx015("""\
+class Pool:
+    def take(self, alloc, bid):
+        alloc.incref(bid)
+        try:
+            if bid < 0:
+                return None
+            v = self.read(bid)
+        finally:
+            self.log(bid)
+        alloc.decref(bid)
+        return v
+""")
+    assert rules_of(fs) == ["HPX015"]
+
+
+def test_hpx015_pure_ownership_transfer_is_silent():
+    # acquire-only functions hand the references to an owner that
+    # retires them elsewhere (the _capture_slot / _restore_slot shape)
+    assert _hpx015("""\
+class Pool:
+    def capture(self, alloc, pins):
+        for bid in pins:
+            alloc.incref(bid)
+        return list(pins)
+""") == []
+
+
+def test_hpx015_loop_acquire_release_pairs_by_iterable():
+    # pinning loop + releasing loop over DIFFERENT iterables: the keys
+    # ("new.pins" vs "old.pins") keep the transfer exemption intact
+    assert _hpx015("""\
+class Pool:
+    def swap(self, alloc, new, old):
+        for bid in new.pins:
+            alloc.incref(bid)
+        for bid in old.pins:
+            alloc.decref(bid)
+""") == []
+
+
+def test_hpx015_outside_scoped_layers_is_silent():
+    res = lint_sources({"hpx_tpu/svc/fixture.py": """\
+class Pool:
+    def take(self, alloc, bid):
+        alloc.incref(bid)
+        return bid
+"""}, rules=all_rules(["HPX015"]))
+    assert res.findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression on a multi-line statement's header line
+# ---------------------------------------------------------------------------
+
+def test_suppress_on_header_reaches_continuation_lines():
+    src = """\
+import numpy as np
+
+def f(x):
+    y = compute(  # hpxlint: disable=HPX002 — pinned fixture
+        np.asarray(x))
+    return y
+"""
+    res = lint_source(src, "hpx_tpu/exec/fixture.py",
+                      rules=all_rules(["HPX002"]))
+    assert res.findings == [] and res.suppressed == 1
+    # same code without the directive fires on the continuation line
+    bare = src.replace("  # hpxlint: disable=HPX002 — pinned fixture", "")
+    res2 = lint_source(bare, "hpx_tpu/exec/fixture.py",
+                       rules=all_rules(["HPX002"]))
+    assert [(f.line, f.rule) for f in res2.findings] == [(5, "HPX002")]
+
+
+def test_suppress_on_with_header_does_not_blanket_body():
+    # directive on the `with` header suppresses findings on the
+    # header's continuation lines only — the block body still fires
+    header_only = """\
+import threading
+
+def setup():
+    with wrap(  # hpxlint: disable=HPX004 — bootstrap substrate
+            threading.Lock()):
+        pass
+"""
+    res = lint_source(header_only, "hpx_tpu/svc/fixture.py",
+                      rules=all_rules(["HPX004"]))
+    assert res.findings == [] and res.suppressed == 1
+    body = """\
+import threading
+
+def setup():
+    with wrap(  # hpxlint: disable=HPX004 — bootstrap substrate
+            make()):
+        lock = threading.Lock()
+"""
+    res2 = lint_source(body, "hpx_tpu/svc/fixture.py",
+                       rules=all_rules(["HPX004"]))
+    assert [(f.line, f.rule) for f in res2.findings] == [(6, "HPX004")]
+
+
+# ---------------------------------------------------------------------------
+# --update-baseline / stale-entry gate / --format=github
+# ---------------------------------------------------------------------------
+
+def test_update_baseline_keeps_justifications_prunes_stale(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(HPX006_BAD)
+    bl = str(tmp_path / "baseline.json")
+    assert cli_main([str(bad), "--baseline", bl, "--write-baseline"]) == 0
+    rec = json.loads(open(bl).read())
+    rec["entries"][0]["justification"] = "hand-written why"
+    rec["entries"].append({"path": "gone.py", "rule": "HPX006",
+                           "message": "m", "count": 1,
+                           "justification": "stale"})
+    with open(bl, "w") as f:
+        json.dump(rec, f)
+    # the gate fails while a stale entry lingers...
+    assert cli_main([str(bad), "--baseline", bl]) == 1
+    # ...--update-baseline prunes it and keeps the edited justification
+    assert cli_main([str(bad), "--baseline", bl, "--update-baseline"]) == 0
+    rec2 = json.loads(open(bl).read())
+    assert [e["justification"] for e in rec2["entries"]] \
+        == ["hand-written why"]
+    assert cli_main([str(bad), "--baseline", bl]) == 0
+
+
+def test_format_github_annotations(tmp_path, capsys):
+    bad = tmp_path / "mod.py"
+    bad.write_text(HPX006_BAD)
+    assert cli_main([str(bad), "--no-baseline", "--format=github"]) == 1
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=")
+    assert "title=HPX006::" in out
+
+
+def test_format_json(tmp_path, capsys):
+    bad = tmp_path / "mod.py"
+    bad.write_text(HPX006_BAD)
+    assert cli_main([str(bad), "--no-baseline", "--format=json"]) == 1
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["checked_files"] == 1
+    assert [f["rule"] for f in rec["findings"]] == ["HPX006"]
+    assert rec["stale_baseline_entries"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -757,6 +1107,20 @@ def test_cli_gate_on_real_tree():
     assert all(f.path.startswith("hpx_tpu") for f in res.findings)
     new, _ = apply_baseline(res.findings, load_baseline())
     assert new == [], "\n".join(f.format() for f in new)
+
+
+def test_full_run_parses_once_and_stays_fast():
+    # the project tier shares the per-file tier's parsed trees: a full
+    # two-tier run over N files costs exactly N ast.parse calls, and
+    # the whole pass (all 15 rules, cross-module index included) must
+    # stay inside the tier-1 perf budget
+    import time
+    before = parse_count()
+    t0 = time.monotonic()
+    res = lint_paths([os.path.join(REPO, "hpx_tpu")], rules=all_rules())
+    elapsed = time.monotonic() - t0
+    assert parse_count() - before == res.checked_files
+    assert elapsed < 10.0, f"full hpxlint run took {elapsed:.1f}s"
 
 
 def test_cli_exit_codes(tmp_path):
