@@ -195,6 +195,58 @@ def bench_sort(ndev: int, devices) -> None:
           ms=round(per * 1e3, 3))            # program (local reference)
 
 
+def bench_paged_serving(ndev: int, devices) -> None:
+    """Sharded paged serving: greedy continuous-batching decode over a
+    (dp, tp) mesh — KV block pool sharded over tp on kv heads, slots
+    and device block tables over dp. Weak in neither sense: the mix is
+    FIXED, so the curve shows how decode latency absorbs devices (tp
+    splits the attention/MLP math, dp splits the slots). The 1-device
+    row runs the plain single-device paged server (a DIFFERENT
+    program — the reference, like sort's jnp.sort row)."""
+    import math
+
+    import jax
+    import numpy as np
+
+    from hpx_tpu.models import transformer as tfm
+    from hpx_tpu.models.serving import ContinuousServer
+    from hpx_tpu.parallel import make_mesh
+
+    cfg = tfm.TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                head_dim=16, n_layers=2, d_ff=128)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    reqs = [(rng.integers(1, 200, 16).tolist(), 24) for _ in range(6)]
+    total = sum(m for _, m in reqs)
+
+    if ndev == 1:
+        mesh, dp, tp = None, 1, 1
+    else:
+        dp = 2 ** (int(math.log2(ndev)) // 2)
+        tp = ndev // dp
+        if cfg.n_heads % tp:            # tp must divide kv heads
+            tp = math.gcd(tp, cfg.n_heads)
+            dp = ndev // tp
+        mesh = make_mesh((dp, tp), ("dp", "tp"), devices[:ndev])
+    slots = max(4, dp)                  # dp | slots
+
+    def run():
+        srv = ContinuousServer(params, cfg, slots=slots, smax=64,
+                               paged=True, mesh=mesh)
+        for p, m in reqs:
+            srv.submit(p, max_new=m)
+        t0 = time.perf_counter()
+        srv.run()
+        return time.perf_counter() - t0
+
+    run()                               # compile
+    per = run()
+    _emit(metric="paged_serving", n_devices=ndev, mesh=f"{dp}x{tp}",
+          slots=slots, tokens=total,
+          tokens_per_s=round(total / per, 1),
+          ms_per_token=round(per * 1e3 / total, 3))
+
+
 def sweep(max_devices: int) -> None:
     import jax
     devs = jax.devices()
@@ -217,6 +269,7 @@ def sweep(max_devices: int) -> None:
         bench_jacobi(k, devs)
         bench_fft(k, devs)
         bench_sort(k, devs)
+        bench_paged_serving(k, devs)
 
 
 if __name__ == "__main__":

@@ -83,9 +83,25 @@ PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
                            fault-free disagg run, >=1 failover per
                            role, zero leaked KV blocks.
 
+  9. paged_mesh          — the sharded serving wave (--mesh): the
+                           SAME greedy mix through the single-device
+                           paged server and ContinuousServer(
+                           paged=True, mesh=(dp, tp)) — KV block pool
+                           sharded over tp on kv heads, slots and
+                           device block tables over dp. Reports warm
+                           tokens/s and decode-stall p50/p99 for BOTH
+                           topologies plus the sha256 of every
+                           request's output — the hashes MUST match:
+                           sharding moves the same program onto more
+                           chips, so a misplaced psum shows up here
+                           as a sha mismatch, not a vibe. Needs >=4
+                           devices (CPU smoke: XLA_FLAGS=
+                           --xla_force_host_platform_device_count=8);
+                           emits a skipped line otherwise.
+
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
-                                          [--paged-decode-only]
+                                          [--paged-decode-only] [--mesh]
                                           [--chaos] [--disagg]
                                           [--trace-out PATH]
 """
@@ -286,6 +302,70 @@ def main() -> int:
                                   "diverged", "mode": kern}),
                       flush=True)
                 raise SystemExit(2)
+
+    # 9. the sharded serving wave: the same greedy mix through the
+    # single-device paged server and the (dp, tp)-mesh paged server
+    # (pool over tp kv heads, slots + device block tables over dp).
+    # Identity is CHECKED: sharding is a placement change, not an
+    # algorithm change, so tokens must be byte-identical.
+    def mesh_paged_bench():
+        import hashlib
+        ndev = len(jax.devices())
+        if ndev < 4:
+            print(json.dumps({
+                "engine": "paged_mesh", "skipped": True,
+                "reason": f"needs >=4 devices, have {ndev} (CPU smoke:"
+                          " XLA_FLAGS=--xla_force_host_platform"
+                          "_device_count=8)"}), flush=True)
+            return
+        tp = 4 if (ndev >= 8 and cfg.n_heads % 4 == 0) else 2
+        dp = 2
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:dp * tp]).reshape(dp, tp),
+            ("dp", "tp"))
+        wreqs = [(rng.integers(1, 1000, 24).tolist(), 48)
+                 for _ in range(8)]
+        wtotal = sum(m for _, m in wreqs)
+
+        def run_once(m):
+            srv = ContinuousServer(params, cfg, slots=4, smax=128,
+                                   paged=True, mesh=m)
+            for p, mx in wreqs:
+                srv.submit(p, max_new=mx)
+            t0 = time.perf_counter()
+            stalls = []
+            alive = True
+            while alive:
+                s0 = time.perf_counter()
+                alive = srv.step()
+                stalls.append(time.perf_counter() - s0)
+            secs = time.perf_counter() - t0
+            out, srv._done = srv._done, {}
+            sha = hashlib.sha256(json.dumps(
+                [out[r] for r in sorted(out)]).encode()).hexdigest()
+            return secs, stalls, sha
+
+        waves = [("paged_single_device", None),
+                 (f"paged_mesh_dp{dp}_tp{tp}", mesh)]
+        results = {}
+        for name, m in waves:
+            run_once(m)                                # compile
+            results[name] = run_once(m)
+        base_sha = results["paged_single_device"][2]
+        for name, (secs, stalls, sha) in results.items():
+            emit(name, wtotal, secs,
+                 mix="8 reqs plen24 new48 over 4 slots, greedy",
+                 decode_stall_p50_ms=round(
+                     1e3 * float(np.percentile(stalls, 50)), 2),
+                 decode_stall_p99_ms=round(
+                     1e3 * float(np.percentile(stalls, 99)), 2),
+                 output_sha=sha[:16],
+                 output_identical=(sha == base_sha))
+        if any(sha != base_sha for _, _, sha in results.values()):
+            print(json.dumps({"error": "sharded paged output "
+                              "diverged from single-device"}),
+                  flush=True)
+            raise SystemExit(2)
 
     # 7. the chaos wave: fault-free vs seeded-fault-schedule runs of
     # one mixed paged+spec mix. The schedule is chosen so every fault
@@ -538,6 +618,10 @@ def main() -> int:
 
     if "--paged-decode-only" in sys.argv:
         paged_decode_bench()
+        return finish()
+
+    if "--mesh" in sys.argv:
+        mesh_paged_bench()
         return finish()
 
     if "--disagg" in sys.argv:

@@ -609,7 +609,13 @@ class SpecHostSyncRule(Rule):
 # modules on the paged-decode data path where a full-pool gather is a
 # silent HBM-bandwidth regression (HPX010's scope); the gather oracle
 # itself (ops/paged_attention.py) fires too and stays in the baseline.
-_PAGED_HOT_SUBPATHS = ("hpx_tpu/models/serving", "hpx_tpu/ops/",
+# models/transformer is fenced since the (dp, tp) mesh work: shard_map
+# bodies see per-shard pool slices there, and a pool gather inside one
+# would ALSO be a cross-shard-correctness bug waiting to happen the
+# moment the block axis stops being dp-replicated — keep every
+# array-of-blocks read in the oracle module.
+_PAGED_HOT_SUBPATHS = ("hpx_tpu/models/serving",
+                       "hpx_tpu/models/transformer", "hpx_tpu/ops/",
                        "hpx_tpu/cache/")
 
 
@@ -629,6 +635,12 @@ class FullPoolGatherRule(Rule):
     that must stay in XLA form belong in the designated oracle module
     (``ops/paged_attention.py``) — its sites are baselined with
     justification; anything new this rule flags is a regression.
+    The fence covers mesh/shard_map code too (models/serving,
+    models/transformer): inside a shard_map body the pool is a
+    PER-SHARD slice whose block axis is dp-replicated — a gather there
+    is the same bandwidth regression, plus a latent cross-shard bug if
+    the replication invariant ever changes, so block tables stay
+    per-shard int32 and gathers stay in the oracle.
     Detection is name-based (singular ``*pool*`` arrays are device
     block pools; plural ``pools`` is the host-side per-layer list) —
     a false positive takes an inline

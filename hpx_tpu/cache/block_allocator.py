@@ -19,7 +19,7 @@ fresh exclusive block (and the caller copies the device rows).
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..core.errors import CacheOOM
 from ..svc import faultinject
@@ -177,6 +177,24 @@ class BlockAllocator:
             self.total_allocs += 1
             self.total_cow_copies += 1
             return new, True
+
+    def pool_pspec(self, tp_axis: Optional[str] = None) -> tuple:
+        """PartitionSpec entries (as a plain tuple — this module stays
+        jax-free) for the `[num_blocks, block_size, n_kv, head_dim]`
+        pools this allocator's ids index on a (dp, tp) mesh: kv-heads
+        shard over `tp_axis`, the BLOCK AXIS never shards. Replicating
+        blocks over dp is the sharded-serving invariant that keeps
+        every block id resolvable on every data-parallel shard, so a
+        per-shard table gather never crosses shards (the HPX010
+        fence); tp slices only the head dim, which block ids never
+        address."""
+        return (None, None, tp_axis, None)
+
+    def scale_pspec(self, tp_axis: Optional[str] = None) -> tuple:
+        """PartitionSpec entries for the `[num_blocks, n_kv]` int8
+        scale sidecars — same placement rule as `pool_pspec` (blocks
+        replicated, kv-heads over tp)."""
+        return (None, tp_axis)
 
     def pool_bytes(self, n_kv: int, head_dim: int,
                    layers: int = 1) -> int:

@@ -22,7 +22,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["PageTable", "materialize", "occupancy"]
+__all__ = ["PageTable", "device_table", "materialize", "occupancy"]
 
 _UIDS = itertools.count()
 
@@ -119,3 +119,38 @@ def materialize(tables: Sequence[Optional[PageTable]], max_blocks: int,
         if pt is not None:
             out[i, :len(pt.blocks)] = pt.blocks
     return out
+
+
+def device_table(tables: Sequence[Optional[PageTable]],
+                 max_blocks: int, pad: int, mesh=None,
+                 dp_axis: str = "dp", residency: str = "sharded"):
+    """Materialize and PLACE the `[slots, max_blocks]` table for the
+    jitted programs. Single-device (``mesh=None``): a plain device
+    array. On a mesh the block ids stay GLOBAL (pools replicate their
+    block axis over dp, so any id resolves on any shard) and only the
+    slot axis placement is a choice, `hpx.serving.mesh.
+    table_residency`:
+
+    * ``"sharded"`` — rows shard over `dp_axis`: each dp shard holds
+      exactly its slots' rows, matching the shard_map block spec with
+      zero resharding on entry (the default).
+    * ``"replicated"`` — every device holds the full table; shard_map
+      entry slices it. Costs slots/dp × more table bytes per device
+      (noise at real sizes) but makes the host upload a single
+      broadcast — an escape hatch for debugging placement issues.
+
+    jax is imported lazily: this module stays importable (and its host
+    bookkeeping testable) without jax installed."""
+    arr = materialize(tables, max_blocks, pad)
+    import jax
+    import jax.numpy as jnp
+    if mesh is None:
+        return jnp.asarray(arr)
+    from jax.sharding import NamedSharding, PartitionSpec
+    if residency not in ("sharded", "replicated"):
+        raise ValueError(
+            "hpx.serving.mesh.table_residency must be 'sharded' or "
+            f"'replicated', got {residency!r}")
+    spec = (PartitionSpec(dp_axis, None) if residency == "sharded"
+            else PartitionSpec())
+    return jax.device_put(arr, NamedSharding(mesh, spec))

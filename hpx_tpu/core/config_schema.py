@@ -235,6 +235,10 @@ declare("hpx.serving.disagg.prefill_jobs", "int", None,
         "concurrent prefill jobs per prefill worker")
 declare("hpx.serving.disagg.xfer_retries", "int", None,
         "KV transfer attempts before failing over")
+declare("hpx.serving.mesh.paged", "bool", "1",
+        "sharded paged serving (0 restores the single-device refusal)")
+declare("hpx.serving.mesh.table_residency", "str", "sharded",
+        "device block-table placement on mesh: sharded | replicated")
 
 # -- fault injection --------------------------------------------------------
 declare("hpx.fault.enable", "bool", "0", "svc/faultinject master switch")

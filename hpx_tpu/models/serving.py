@@ -287,7 +287,8 @@ def _decode_rows(params, caches, tok, pos, cfg):
 
 
 def _paged_block_rows(x, lp, pools, scales, table, pos,
-                      cfg: TransformerConfig, fused: bool = False):
+                      cfg: TransformerConfig, fused: bool = False,
+                      tp_axis=None):
     """_block_decode_rows with the K/V rows living in a shared BLOCK
     POOL instead of per-slot dense buffers. x: [B, 1, D]; pools:
     (k_pool, v_pool) each [num_blocks, block_size, Nkv, H]; scales:
@@ -297,7 +298,13 @@ def _paged_block_rows(x, lp, pools, scales, table, pos,
     dense path; only the cache write (scatter through the table) and
     read (gather in logical order — same row values at the same
     logical indices, or the fused Pallas table walk) differ, which is
-    what keeps paged == dense token-exact."""
+    what keeps paged == dense token-exact.
+
+    Under shard_map on a (dp, tp) mesh, `tp_axis` names the
+    tensor-parallel axis: every shard sees its LOCAL kv-head slice of
+    the pools (block axis replicated over dp) and the partial attention
+    / ffn outputs close with explicit psums — the same two reduction
+    points `_block_decode` uses."""
     kp, vp = pools
     b = x.shape[0]
     h = _ln(x, lp["ln1"])
@@ -316,6 +323,8 @@ def _paged_block_rows(x, lp, pools, scales, table, pos,
             k_scale=ks, v_scale=vs, fused=fused)
         scales = (ks, vs)
     o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
     x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
@@ -327,11 +336,13 @@ def _paged_block_rows(x, lp, pools, scales, table, pos,
         out, _aux = moe_ffn(h.reshape(b, d), lp["moe"], mcfg)
         return x + out.reshape(b, 1, d), (kp, vp), scales
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
+    if tp_axis is not None:
+        h = jax.lax.psum(h, tp_axis)
     return x + h, (kp, vp), scales
 
 
 def _paged_decode_rows(params, pools, scales, tok, table, pos, cfg,
-                       fused: bool = False):
+                       fused: bool = False, tp_axis=None):
     """One token per slot through every block over paged pools;
     returns (pools, scales, f32 logits [B, V]) — the _decode_rows
     analog. `scales` is the per-layer list of (k_scale, v_scale)
@@ -341,7 +352,7 @@ def _paged_decode_rows(params, pools, scales, tok, table, pos, cfg,
     for i, (lp, pl) in enumerate(zip(params["layers"], pools)):
         sc = None if scales is None else scales[i]
         x, pl, sc = _paged_block_rows(x, lp, pl, sc, table, pos, cfg,
-                                      fused)
+                                      fused, tp_axis)
         new_pools.append(pl)
         new_scales.append(sc)
     x = _ln(x, params["ln_f"])
@@ -416,12 +427,14 @@ def _decode_window_rows(params, caches, toks, pos0, cfg):
 
 
 def _paged_window_rows(x, lp, pools, scales, table, pos0,
-                       cfg: TransformerConfig, fused: bool = False):
+                       cfg: TransformerConfig, fused: bool = False,
+                       tp_axis=None):
     """`_window_rows` over paged pools: the scatter/gather and the
     per-query horizon live in `ops.paged_attention.
     paged_window_attention`; projections/rope/ffn are byte-identical
     to the dense window, which keeps paged == dense token-exact under
-    speculation too."""
+    speculation too. `tp_axis` closes the per-shard partial sums under
+    shard_map exactly as in `_paged_block_rows`."""
     kp, vp = pools
     b, w = x.shape[0], x.shape[1]
     h = _ln(x, lp["ln1"])
@@ -440,6 +453,8 @@ def _paged_window_rows(x, lp, pools, scales, table, pos0,
             k_scale=ks, v_scale=vs, fused=fused)
         scales = (ks, vs)
     o = jnp.einsum("bsnh,nhd->bsd", att, _dq(lp["wo"], att))
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
     x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
@@ -451,11 +466,13 @@ def _paged_window_rows(x, lp, pools, scales, table, pos0,
         out, _aux = moe_ffn(h.reshape(b * w, d), lp["moe"], mcfg)
         return x + out.reshape(b, w, d), (kp, vp), scales
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
+    if tp_axis is not None:
+        h = jax.lax.psum(h, tp_axis)
     return x + h, (kp, vp), scales
 
 
 def _paged_decode_window_rows(params, pools, scales, toks, table, pos0,
-                              cfg, fused: bool = False):
+                              cfg, fused: bool = False, tp_axis=None):
     """W tokens per slot over paged pools; returns (pools, scales, f32
     logits [B, W, V]) — the `_decode_window_rows` analog."""
     x = params["emb"][toks]
@@ -463,7 +480,7 @@ def _paged_decode_window_rows(params, pools, scales, toks, table, pos0,
     for i, (lp, pl) in enumerate(zip(params["layers"], pools)):
         sc = None if scales is None else scales[i]
         x, pl, sc = _paged_window_rows(x, lp, pl, sc, table, pos0, cfg,
-                                       fused)
+                                       fused, tp_axis)
         new_pools.append(pl)
         new_scales.append(sc)
     x = _ln(x, params["ln_f"])
@@ -645,24 +662,31 @@ class ContinuousServer:
         self.mesh = mesh
         self.paged = bool(paged)
         nkv, hd = cfg.kv_heads, cfg.head_dim
+        from ..core.config import runtime_config
+        rc = runtime_config()
         cache_sh = None
-        if self.paged and mesh is not None:
+        if self.paged and mesh is not None and \
+                not rc.get_bool("hpx.serving.mesh.paged", True):
+            # operational escape hatch back to the pre-sharded refusal
             raise ValueError(
-                "paged=True serving is single-device for now: shard "
-                "the dense path (mesh=...) or run one paged server "
-                "per replica")
+                "sharded paged serving is disabled "
+                "(hpx.serving.mesh.paged=0): shard the dense path "
+                "(mesh=...) or run one paged server per replica")
         if mesh is not None:
             # GSPMD sharded serving: slots over dp, heads over tp. The
-            # step/prefill/splice programs are UNCHANGED — placement
-            # alone makes XLA partition them (einsum contractions over
-            # the tp-sharded head dim close with compiler-inserted
-            # all-reduces; no shard_map needed because nothing here
-            # depends on per-device identity).
+            # dense step/prefill/splice programs are UNCHANGED —
+            # placement alone makes XLA partition them (einsum
+            # contractions over the tp-sharded head dim close with
+            # compiler-inserted all-reduces). The PAGED decode/verify
+            # steps instead run under shard_map (block tables are
+            # per-dp-shard; the pool gather must stay shard-local),
+            # with explicit psums over tp — see _paged_step_prog.
             from jax.sharding import NamedSharding, PartitionSpec as P
             from .transformer import (_decode_mesh_check,
                                       _decode_pspecs, _place)
-            # the shared decode-mesh contract (axes, dense-only, head
-            # divisibility); slots play the batch role
+            # the shared decode-mesh contract (axes, dense models
+            # only — MoE is the one remaining exclusion — and
+            # head/slot divisibility); slots play the batch role
             try:
                 _decode_mesh_check(cfg, mesh, slots)
             except ValueError as e:
@@ -673,8 +697,6 @@ class ContinuousServer:
         self.params = params
         self._cache_sh = cache_sh
 
-        from ..core.config import runtime_config
-        rc = runtime_config()
         if prefill_chunk is None:
             prefill_chunk = rc.get_int("hpx.serving.prefill_chunk",
                                        _PREFILL_CHUNK)
@@ -926,16 +948,47 @@ class ContinuousServer:
         self._radix = RadixCache(self._alloc, radix_budget_blocks)
         nkv, hd = cfg.kv_heads, cfg.head_dim
 
+        # sharded paged serving: pools/scales shard their kv-head axis
+        # over tp and REPLICATE the block axis over dp (the allocator's
+        # pool_pspec rule) — one global allocator/radix/table space,
+        # every block id resolvable on every dp shard, so per-shard
+        # table gathers never cross shards. Tables shard their slot
+        # rows over dp (knob-controlled; see cache.page_table.
+        # device_table).
+        self._pool_sh = self._scale_sh = None
+        self._table_residency = "sharded"
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._pool_sh = NamedSharding(
+                self.mesh, P(*self._alloc.pool_pspec("tp")))
+            self._scale_sh = NamedSharding(
+                self.mesh, P(*self._alloc.scale_pspec("tp")))
+            self._table_residency = rc.get(
+                "hpx.serving.mesh.table_residency", "sharded")
+            if self._table_residency not in ("sharded", "replicated"):
+                raise ValueError(
+                    "hpx.serving.mesh.table_residency must be "
+                    "'sharded' or 'replicated', got "
+                    f"{self._table_residency!r}")
+
         def pzeros():
-            if self._kv_dtype == "int8":
-                return jnp.zeros((num_blocks, bs, nkv, hd), jnp.int8)
-            return jnp.zeros((num_blocks, bs, nkv, hd), cfg.dtype)
+            # allocate directly in the sharded layout (same OOM logic
+            # as the dense zeros(): never materialize the full pool on
+            # one device first)
+            dt = jnp.int8 if self._kv_dtype == "int8" else cfg.dtype
+            if self._pool_sh is not None:
+                return jnp.zeros((num_blocks, bs, nkv, hd), dt,
+                                 device=self._pool_sh)
+            return jnp.zeros((num_blocks, bs, nkv, hd), dt)
         self._pools = [(pzeros(), pzeros())
                        for _ in range(cfg.n_layers)]
         if self._kv_dtype == "int8":
             def sones():
                 # scale 1.0 is quantize_blocks' zero-block convention:
                 # fresh pools dequantize to exact zeros
+                if self._scale_sh is not None:
+                    return jnp.ones((num_blocks, nkv), jnp.float32,
+                                    device=self._scale_sh)
                 return jnp.ones((num_blocks, nkv), jnp.float32)
             self._scales = [(sones(), sones())
                             for _ in range(cfg.n_layers)]
@@ -1050,25 +1103,57 @@ class ContinuousServer:
         cfg, slots, smax = self.cfg, self.slots, self.smax
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_step", cfg, slots, smax, nb, bs, self._kv_dtype,
-              self._paged_kernel, _tree_key(self.params))
+              self._paged_kernel, self.mesh, _tree_key(self.params))
 
         def build():
             fused = self._paged_fused
+            tp_axis = None if self.mesh is None else "tp"
 
             def step(params, pools, scales, tok, pos, tables, temp,
                      keys):
                 pools, scales, logits = _paged_decode_rows(
                     params, pools, scales, tok, tables, pos, cfg,
-                    fused)
+                    fused, tp_axis)
                 nxt = jax.vmap(_pick_row)(logits, keys, temp, pos)
                 return pools, scales, nxt
-            return self._jit_step(step)
+            if self.mesh is None:
+                return self._jit_step(step)
+            # sharded paged decode runs under shard_map, NOT bare
+            # GSPMD: each dp shard steps ITS slots against its LOCAL
+            # pool replica (block tables are per-shard int32 into a
+            # dp-replicated block axis — the gather can never cross
+            # shards), tp shards the kv-head axis with explicit psums
+            # in _paged_block_rows. Per-slot sampling (keys fold per
+            # slot, row 0) is shard-local, so emitted tokens match the
+            # single-device server exactly.
+            from jax.sharding import PartitionSpec as P
+            from ..utils.jaxcompat import shard_map
+            pspecs, pool_sp, scale_sp = self._paged_shard_specs()
+            return self._jit_step(shard_map(
+                step, mesh=self.mesh,
+                in_specs=(pspecs, pool_sp, scale_sp, P("dp"),
+                          P("dp"), P("dp", None), P("dp"),
+                          P("dp", None)),
+                out_specs=(pool_sp, scale_sp, P("dp"))))
         return self._program(ck, build)
 
     def _jit_step(self, step):
         # scales donate too: for bf16 pools the arg is None (an empty
         # pytree), which donation treats as a no-op
         return jax.jit(step, donate_argnums=(1, 2))
+
+    def _paged_shard_specs(self):
+        """Spec trees for the shard_map-wrapped paged programs:
+        (param pspecs, pool spec, scale spec). Pools replicate the
+        block axis over dp and shard kv-heads over tp (the allocator's
+        pool_pspec rule); the scale spec degrades to P() for bf16
+        pools, where the scales argument is an empty pytree."""
+        from jax.sharding import PartitionSpec as P
+        from .transformer import _decode_pspecs
+        pool_sp = P(*self._alloc.pool_pspec("tp"))
+        scale_sp = (P(*self._alloc.scale_pspec("tp"))
+                    if self._scales is not None else P())
+        return _decode_pspecs(self.params, self.cfg), pool_sp, scale_sp
 
     def _paged_gather_prog(self):
         """Materialize one request's (possibly prefix-matched) blocks
@@ -1079,7 +1164,7 @@ class ContinuousServer:
         cfg = self.cfg
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_gather", cfg, self.smax, nb, bs, self._kv_dtype,
-              _tree_key(self.params))
+              self.mesh, _tree_key(self.params))
 
         def build():
             dt = cfg.dtype
@@ -1112,9 +1197,11 @@ class ContinuousServer:
         nb, bs = self._alloc.num_blocks, self.block_size
         maxb = self._maxb
         ck = ("pg_splice", cfg, self.smax, nb, bs, self._kv_dtype,
-              _tree_key(self.params))
+              self.mesh, _tree_key(self.params))
 
         def build():
+            pool_sh, scale_sh = self._pool_sh, self._scale_sh
+
             def splice(pools, scales, one, wrow):
                 outp, outs = [], []
                 for i, ((kp, vp), (kc, vc)) in enumerate(
@@ -1133,6 +1220,19 @@ class ContinuousServer:
                                                       vseg)
                         outp.append((kp, vp))
                         outs.append((ks, vs))
+                if pool_sh is not None:
+                    # pin the sharded-pool layout: the scatter stays a
+                    # per-device local write (block axis replicated
+                    # over dp, kv-heads over tp) and donation reuses
+                    # the input buffers in place — whole-block splice
+                    # writes are therefore IDENTICAL on every dp
+                    # replica, the coherence property radix prefix
+                    # sharing on the mesh rests on
+                    outp = jax.lax.with_sharding_constraint(
+                        outp, pool_sh)
+                    if outs:
+                        outs = jax.lax.with_sharding_constraint(
+                            outs, scale_sh)
                 return outp, (None if scales is None else outs)
             return jax.jit(splice, donate_argnums=(0, 1))
         return self._program(ck, build)
@@ -1144,9 +1244,11 @@ class ContinuousServer:
         must dequantize identically to its source)."""
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_copy", self.cfg, self.smax, nb, bs, self._kv_dtype,
-              _tree_key(self.params))
+              self.mesh, _tree_key(self.params))
 
         def build():
+            pool_sh, scale_sh = self._pool_sh, self._scale_sh
+
             def copy(pools, scales, src, dst):
                 pools = [(kp.at[dst].set(kp[src]),
                           vp.at[dst].set(vp[src]))
@@ -1155,6 +1257,15 @@ class ContinuousServer:
                     scales = [(ks.at[dst].set(ks[src]),
                                vs.at[dst].set(vs[src]))
                               for ks, vs in scales]
+                if pool_sh is not None:
+                    # per-replica local copy: src's rows on each dp
+                    # replica land in that replica's dst — exactly the
+                    # COW semantics each owning shard needs
+                    pools = jax.lax.with_sharding_constraint(
+                        pools, pool_sh)
+                    if scales is not None:
+                        scales = jax.lax.with_sharding_constraint(
+                            scales, scale_sh)
                 return pools, scales
             return jax.jit(copy, donate_argnums=(0, 1))
         return self._program(ck, build)
@@ -1189,20 +1300,37 @@ class ContinuousServer:
         cfg, slots, smax = self.cfg, self.slots, self.smax
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_verify", cfg, slots, smax, width, nb, bs,
-              self._kv_dtype, self._paged_kernel,
+              self._kv_dtype, self._paged_kernel, self.mesh,
               _tree_key(self.params))
 
         def build():
             fused = self._paged_fused
+            tp_axis = None if self.mesh is None else "tp"
 
             def verify(params, pools, scales, toks, pos0, tables,
                        kvec, temp, keys):
                 pools, scales, logits = _paged_decode_window_rows(
                     params, pools, scales, toks, tables, pos0, cfg,
-                    fused)
+                    fused, tp_axis)
                 return pools, scales, _verify_tail(
                     logits, toks, kvec, temp, keys, pos0, width)
-            return jax.jit(verify, donate_argnums=(1, 2))
+            if self.mesh is None:
+                return jax.jit(verify, donate_argnums=(1, 2))
+            # same shard_map layout as _paged_step_prog, stretched to
+            # the verify window: toks/packed targets carry a width
+            # column axis, everything else is the step's specs. The
+            # _verify_tail pick is per-slot (shard-local) so spec
+            # acceptance matches the single-device server exactly.
+            from jax.sharding import PartitionSpec as P
+            from ..utils.jaxcompat import shard_map
+            pspecs, pool_sp, scale_sp = self._paged_shard_specs()
+            return jax.jit(shard_map(
+                verify, mesh=self.mesh,
+                in_specs=(pspecs, pool_sp, scale_sp, P("dp", None),
+                          P("dp"), P("dp", None), P("dp"), P("dp"),
+                          P("dp", None)),
+                out_specs=(pool_sp, scale_sp, P("dp", None))),
+                donate_argnums=(1, 2))
         return self._program(ck, build)
 
     def _draft_step_prog(self):
@@ -1210,7 +1338,7 @@ class ContinuousServer:
         draft ALWAYS proposes greedily — draft quality moves only the
         acceptance rate, never the emitted tokens."""
         dcfg, slots, smax = self._draft_cfg, self.slots, self.smax
-        ck = ("cb_draft", dcfg, slots, smax,
+        ck = ("cb_draft", dcfg, slots, smax, self.mesh,
               _tree_key(self._draft_params))
 
         def build():
@@ -1228,7 +1356,7 @@ class ContinuousServer:
         forward, write them back. Same ladder widths as the target's
         chunks — O(buckets) draft programs."""
         dcfg, smax = self._draft_cfg, self.smax
-        ck = ("cb_dchunk", dcfg, width, smax, self.slots,
+        ck = ("cb_dchunk", dcfg, width, smax, self.slots, self.mesh,
               _tree_key(self._draft_params))
 
         def build():
@@ -1315,12 +1443,16 @@ class ContinuousServer:
         """The [slots, maxb] int32 device map for one decode step,
         rebuilt ONLY when some table mutated (PageTable.version) or a
         slot's table was swapped — steady-state decode re-uploads
-        nothing."""
+        nothing. On a mesh the rows land per `hpx.serving.mesh.
+        table_residency` (slot rows over dp by default) via
+        cache.page_table.device_table; ids stay GLOBAL either way."""
         sig = tuple((pt.uid, pt.version) if pt is not None else None
                     for pt in self._tables)
         if sig != self._tables_sig or self._tables_arr is None:
-            self._tables_arr = jnp.asarray(materialize(
-                self._tables, self._maxb, self._trash))
+            from ..cache.page_table import device_table
+            self._tables_arr = device_table(
+                self._tables, self._maxb, self._trash, mesh=self.mesh,
+                residency=self._table_residency)
             self._tables_sig = sig
         return self._tables_arr
 
@@ -1352,6 +1484,16 @@ class ContinuousServer:
         st["prefill_tokens_saved"] = self._prefill_saved
         st["prefill_tokens_computed"] = self._prefill_computed
         st.update(self.hbm_read_stats())
+        if self.mesh is not None:
+            # per-dp-shard slot accounting: slots map to dp shards by
+            # index range (the P("dp") slot-axis sharding), so shard
+            # d's decode reads exactly these slots' mapped blocks —
+            # the skew between shards is the load-balance signal
+            dp = self.mesh.shape["dp"]
+            per = self.slots // dp
+            for d in range(dp):
+                st[f"occupancy_dp{d}"] = occupancy(
+                    self._tables[d * per:(d + 1) * per])
         return st
 
     def _kv_acct_dtype(self) -> str:

@@ -1076,9 +1076,11 @@ def _pick_row(logits_row, key, temperature, pos):
 
 
 def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):
-    """Shared decode-mesh contract for generate()/speculative_generate:
-    ("dp","tp") axes, dense model, heads/batch divisible. Returns
-    (dp, tp)."""
+    """Shared decode-mesh contract for generate()/
+    speculative_generate, and for ContinuousServer — dense AND paged
+    (slots play the batch role there): ("dp","tp") axes, heads/batch
+    divisible. The one remaining exclusion is MoE, whose drop-free
+    routing still decodes single-device. Returns (dp, tp)."""
     if cfg.n_experts > 0:
         raise NotImplementedError(
             "sharded decode supports dense models; MoE decodes "

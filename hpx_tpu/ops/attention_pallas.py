@@ -933,7 +933,16 @@ def fused_paged_attention(q: jax.Array, k_pool: jax.Array,
 
     Falls back to interpret mode off-TPU (CPU tier-1 stays green).
     Real-TPU int8 pools want block_size >= 32 (the int8 sublane tile);
-    interpret mode takes any block size."""
+    interpret mode takes any block size.
+
+    Runs unchanged inside shard_map on the serving (dp, tp) mesh
+    (via utils/jaxcompat): n_q/n_kv here are then the PER-SHARD head
+    counts (tp slices the kv-head axis, so the GQA group n_q // n_kv
+    is unchanged), the block axis is dp-replicated so the
+    scalar-prefetched table's global block ids index the local pool
+    directly, and int8 scales arrive pre-sliced per (block, local
+    head) — no kernel-visible difference from the single-device
+    call."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, w, nq, hd = q.shape

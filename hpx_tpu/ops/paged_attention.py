@@ -39,6 +39,16 @@ construction, so the recomputed scale is bit-identical), and bounded
 by one rounding step when it did. The gather side dequantizes with
 the same elementwise ops the kernel uses at its VMEM boundary, so
 gather-int8 and fused-int8 agree exactly like their bf16 twins.
+
+Sharded serving (shard_map on a (dp, tp) mesh): every function here is
+written against LOCAL shapes only — `n_kv` and `n_q` are read off the
+arrays, GQA group size is `n_q // n_kv`, and block ids index the pool's
+block axis directly — so the same code runs per-shard unchanged. The
+serving layer shards pools/scales over tp on the kv-head axis and
+REPLICATES the block axis over dp (`BlockAllocator.pool_pspec`), which
+is exactly what keeps each shard's `pool[table]` gather shard-local:
+tables carry global block ids, and every id resolves on every dp
+shard. Nothing in this module may introduce a cross-shard collective.
 """
 
 from __future__ import annotations

@@ -91,6 +91,46 @@ def test_fused_paged_workload_compiles_o_buckets(params):
     assert int(c3) <= 12
 
 
+def test_sharded_paged_workload_compiles_o_buckets(params):
+    """Sharded paged serving rides the SAME bucket ladder: the
+    shard_map-wrapped step/verify and the mesh-keyed gather/splice
+    programs are keyed on (kv_dtype, paged_kernel, mesh) — constants
+    for a given server — so mixed-length traffic on a 2x2 (dp, tp)
+    mesh still compiles O(buckets), fresh servers on the same mesh
+    reuse everything, and flipping the pool dtype re-keys <= 5
+    programs (the single-device budget carries over)."""
+    mesh = jax.sharding.Mesh(
+        np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    with count_compiles() as c:
+        srv = ContinuousServer(params, CFG, slots=4, smax=64,
+                               prefill_chunk=8, prefill_buckets="4,8",
+                               paged=True, mesh=mesh)
+        out = _workload(srv, PLENS, seed=6)
+    assert len(out) == len(PLENS)
+    buckets = len(srv.prefill_buckets)
+    # chunk program per bucket + probe + step + gather + splice
+    assert srv._prog_misses <= buckets + 5
+    assert int(c) <= buckets + 24
+    # a fresh sharded server, NEW prompt lengths: total reuse
+    with count_compiles() as c2:
+        srv2 = ContinuousServer(params, CFG, slots=4, smax=64,
+                                prefill_chunk=8, prefill_buckets="4,8",
+                                paged=True, mesh=mesh)
+        _workload(srv2, [7, 11, 19, 22], seed=7)
+    assert srv2._prog_misses == 0 and srv2._prog_hits > 0
+    assert int(c2) <= 2
+    # int8 pools on the mesh: only the kv_dtype-keyed programs rebuild
+    with count_compiles() as c3:
+        srv3 = ContinuousServer(params, CFG, slots=4, smax=64,
+                                prefill_chunk=8, prefill_buckets="4,8",
+                                paged=True, mesh=mesh,
+                                kv_dtype="int8")
+        out3 = _workload(srv3, PLENS, seed=8)
+    assert len(out3) == len(PLENS)
+    assert srv3._prog_misses <= 5
+    assert int(c3) <= 12
+
+
 def test_new_lengths_reuse_everything(params, recwarn):
     # warm wave (may share compiles with the test above when it ran
     # first — irrelevant, we only pin the SECOND wave)

@@ -150,10 +150,20 @@ def test_oom_evicts_and_recovers(params):
 
 # -- construction contracts --------------------------------------------------
 
-def test_paged_rejects_mesh(params):
-    with pytest.raises(ValueError, match="single-device"):
-        ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
-                         mesh=object())
+def test_paged_mesh_gate_restores_refusal(params):
+    """paged=True + mesh= is SUPPORTED now (see
+    test_sharded_paged_serving.py); hpx.serving.mesh.paged=0 is the
+    operational escape hatch back to the old single-device refusal —
+    it must fire before the mesh is even inspected."""
+    from hpx_tpu.core.config import runtime_config
+    rc = runtime_config()
+    rc.set("hpx.serving.mesh.paged", "0")
+    try:
+        with pytest.raises(ValueError, match="mesh.paged"):
+            ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
+                             mesh=object())
+    finally:
+        rc.set("hpx.serving.mesh.paged", "1")
 
 
 def test_paged_rejects_misaligned_smax(params):
