@@ -168,7 +168,10 @@ def _save_fallback() -> None:
 def _load_fallback(skip=()):
     """Labeled fallback lines from the most recent record (local run
     record preferred, committed seed otherwise), minus `skip` metrics
-    already measured live this run."""
+    already measured live this run. Every re-emitted line is stamped
+    ``"onchip": false`` — a fallback row is banked history, not a
+    fresh on-device measurement, and downstream consumers must be able
+    to tell without parsing provenance strings."""
     for path in (_FALLBACK_LOCAL, _FALLBACK_SEED):
         try:
             with open(path) as f:
@@ -184,6 +187,7 @@ def _load_fallback(skip=()):
             continue
         fb = dict(line)
         fb["provenance"] = "builder-session"
+        fb["onchip"] = False
         fb.setdefault("measured_at", rec.get("measured_at", "unknown"))
         out.append(fb)
     return out

@@ -7,13 +7,15 @@ measurement, and writes the winners to
 hpx_tpu/ops/flash_blocks.json, which ops/attention_pallas.resolve_blocks
 consults whenever callers don't pass blocks explicitly.
 
-With --paged the sweep instead covers the FUSED PAGED DECODE kernel's
-knob grid — cache block_size {8, 16, 32, 64} x kv_dtype {bf16, int8} —
-on a serving-decode shape (8 slots near a 2k horizon, N8 H128), and
-banks each kv_dtype's winning block size to
+With --paged the sweep instead covers the PAGED DECODE knob grid —
+cache block_size {8, 16, 32, 64} x kv_dtype {bf16, int8, fp8} x
+kernel {gather, fused, fused_online} — on a serving-decode shape
+(8 slots near a 2k horizon, N8 H128), and banks each kv_dtype's
+winning block size (best across kernels) to
 hpx_tpu/ops/paged_blocks.json keyed ``hd<head_dim>x<kv_dtype>``, which
 `ops/attention_pallas.resolve_paged_block` (and through it
-``hpx.cache.block_size=auto``) consults.
+``hpx.cache.block_size=auto``) consults. An unknown kv_dtype string is
+a hard error, never a silent fall-through to bf16 byte accounting.
 
 Usage: python benchmarks/flash_tune.py [--quick] [--paged]
   --quick: S in {2k, 4k} only and fewer samples (smoke/dev loops).
@@ -84,14 +86,36 @@ def _bank(table, blocks_file) -> int:
     return len(merged)
 
 
-def paged_measure(jax, jnp, S, bs, kvd, samples=3):
-    """Time one fused paged decode attention step at the serving shape:
+# Pool-row bytes per element by kv_dtype string. KeyError here is a
+# BUG GUARD: an unrecognized dtype must fail the sweep, not silently
+# get bf16 byte accounting (which would corrupt the banked winners).
+_PAGED_ITEMSIZE = {"bf16": 2, "int8": 1, "fp8": 1}
+_PAGED_KERNELS = ("gather", "fused", "fused_online")
+
+
+def paged_measure(jax, jnp, S, bs, kvd, kern, samples=3):
+    """Time one paged decode attention step at the serving shape:
     8 slots, every table fully mapped to DISTINCT pool blocks at a
     near-S horizon (the steady-state worst case — block-size effects
-    show up as grid/tiling overhead, not masked work). Returns
+    show up as grid/tiling overhead, not masked work). `kern` picks
+    the formulation: gather (XLA oracle), fused (bitwise Pallas), or
+    fused_online (O(block)-scratch online softmax). Returns
     (HBM-read GB/s, us per call, spread)."""
-    from hpx_tpu.ops.attention_pallas import fused_paged_attention
-    from hpx_tpu.ops.paged_attention import quantize_blocks
+    from hpx_tpu.ops.attention_pallas import (fused_paged_attention,
+                                              fused_paged_online_attention)
+    from hpx_tpu.ops.paged_attention import (gather_block_kv,
+                                             quantize_blocks)
+    try:
+        itemsize = _PAGED_ITEMSIZE[kvd]
+    except KeyError:
+        raise ValueError(
+            f"flash_tune --paged: unknown kv_dtype {kvd!r} (expected one "
+            f"of {sorted(_PAGED_ITEMSIZE)}) — refusing to fall back to "
+            "bf16 byte accounting") from None
+    if kern not in _PAGED_KERNELS:
+        raise ValueError(
+            f"flash_tune --paged: unknown kernel {kern!r} (expected one "
+            f"of {_PAGED_KERNELS})")
     B, nq, nkv, H = 8, 8, 8, 128
     maxb = S // bs
     nb = B * maxb + 1                  # + a trash-style spare block
@@ -103,18 +127,35 @@ def paged_measure(jax, jnp, S, bs, kvd, samples=3):
     table = jnp.asarray(
         np.arange(1, B * maxb + 1, dtype=np.int32).reshape(B, maxb))
     pos = jnp.full((B,), S - 1, jnp.int32)
-    itemsize = 2
-    if kvd == "int8":
-        kq, ks = quantize_blocks(jnp.asarray(kp, jnp.float32))
-        vq, vs = quantize_blocks(jnp.asarray(vp, jnp.float32))
-        f = jax.jit(lambda qq: fused_paged_attention(
-            qq, kq, vq, table, pos, k_scale=ks, v_scale=vs))
-        itemsize = 1
+    ks = vs = None
+    if kvd == "bf16":
+        kq = jnp.asarray(kp, jnp.bfloat16)
+        vq = jnp.asarray(vp, jnp.bfloat16)
     else:
-        kb = jnp.asarray(kp, jnp.bfloat16)
-        vb = jnp.asarray(vp, jnp.bfloat16)
-        f = jax.jit(lambda qq: fused_paged_attention(
-            qq, kb, vb, table, pos))
+        pool_dt = jnp.int8 if kvd == "int8" else jnp.float8_e4m3fn
+        kq, ks = quantize_blocks(jnp.asarray(kp, jnp.float32), pool_dt)
+        vq, vs = quantize_blocks(jnp.asarray(vp, jnp.float32), pool_dt)
+    if kern == "gather":
+        g = nq // nkv
+
+        def step(qq):
+            kc = gather_block_kv(kq, table, ks, qq.dtype)
+            vc = gather_block_kv(vq, table, vs, qq.dtype)
+            qg = qq.reshape(B, 1, nkv, g, H)
+            s = jnp.einsum("bqngh,bknh->bngqk", qg, kc) / (H ** 0.5)
+            live = jnp.arange(kc.shape[1])[None, :] <= pos[:, None]
+            s = jnp.where(live[:, None, None, None, :], s, -jnp.inf)
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1
+                               ).astype(qq.dtype)
+            return jnp.einsum("bngqk,bknh->bqngh", p, vc).reshape(
+                B, 1, nq, H)
+
+        f = jax.jit(step)
+    else:
+        fpa = (fused_paged_online_attention if kern == "fused_online"
+               else fused_paged_attention)
+        f = jax.jit(lambda qq: fpa(qq, kq, vq, table, pos,
+                                   k_scale=ks, v_scale=vs))
     out = f(q)
     jax.block_until_ready(out)
 
@@ -129,7 +170,7 @@ def paged_measure(jax, jnp, S, bs, kvd, samples=3):
     pers = sorted(slope_time(chain, 8, 50) for _ in range(samples))
     per = pers[(samples - 1) // 2]
     hbm = 2 * B * maxb * bs * nkv * H * itemsize    # K + V pool reads
-    if kvd == "int8":
+    if kvd in ("int8", "fp8"):
         hbm += 2 * B * maxb * nkv * 4               # scale sidecars
     return hbm / per / 1e9, per * 1e6, (pers[-1] - pers[0]) / per
 
@@ -138,30 +179,36 @@ def paged_main(jax, jnp, quick: bool) -> int:
     from hpx_tpu.ops.attention_pallas import _PAGED_BLOCKS_FILE
     S = 1024 if quick else 2048
     samples = 2 if quick else 3
+    kernels = ("fused", "fused_online") if quick else _PAGED_KERNELS
     H = 128
     table = {}
-    for kvd in ("bf16", "int8"):
-        best = None
-        for bs in (8, 16, 32, 64):
-            try:
-                gbs, us, spread = paged_measure(jax, jnp, S, bs, kvd,
-                                                samples=samples)
-            except Exception as e:  # noqa: BLE001 — eg VMEM OOM
+    for kvd in ("bf16", "int8", "fp8"):
+        best = None                    # (us, block_size, kernel)
+        for kern in kernels:
+            for bs in (8, 16, 32, 64):
+                try:
+                    gbs, us, spread = paged_measure(jax, jnp, S, bs,
+                                                    kvd, kern,
+                                                    samples=samples)
+                except Exception as e:  # noqa: BLE001 — eg VMEM OOM
+                    print(json.dumps({"S": S, "kv_dtype": kvd,
+                                      "kernel": kern, "block_size": bs,
+                                      "error": str(e)[:120]}),
+                          flush=True)
+                    continue
                 print(json.dumps({"S": S, "kv_dtype": kvd,
-                                  "block_size": bs,
-                                  "error": str(e)[:120]}), flush=True)
-                continue
-            print(json.dumps({"S": S, "kv_dtype": kvd,
-                              "block_size": bs,
-                              "hbm_gb_per_s": round(gbs, 1),
-                              "us_per_step": round(us, 1),
-                              "spread": round(spread, 3)}), flush=True)
-            if best is None or us < best[0]:
-                best = (us, bs)
+                                  "kernel": kern, "block_size": bs,
+                                  "hbm_gb_per_s": round(gbs, 1),
+                                  "us_per_step": round(us, 1),
+                                  "spread": round(spread, 3)}),
+                      flush=True)
+                if best is None or us < best[0]:
+                    best = (us, bs, kern)
         if best:
             table[f"hd{H}x{kvd}"] = best[1]
             total = _bank(table, _PAGED_BLOCKS_FILE)
             print(json.dumps({"kv_dtype": kvd, "winner": best[1],
+                              "kernel": best[2],
                               "us_per_step": round(best[0], 1),
                               "banked": total}), flush=True)
     print(json.dumps({"wrote": _PAGED_BLOCKS_FILE, "new": len(table)}))

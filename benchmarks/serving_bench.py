@@ -28,17 +28,21 @@ host; CPU for smoke runs with --cpu):
                            how fast identical tokens appear
   6. paged_decode        — the decode-attention roofline wave: one
                            greedy mix through the paged server in each
-                           (paged_kernel, kv_dtype) mode (fused modes
-                           on TPU only — interpret-mode Pallas is a
-                           test vehicle, not a serving path). Reports
-                           warm tokens/s, decode-attention HBM
+                           (paged_kernel, kv_dtype) mode over
+                           kv_dtype {bf16, int8, fp8} and kernel
+                           {gather, fused, fused_online} (fused
+                           kernels on TPU only — interpret-mode Pallas
+                           is a test vehicle, not a serving path).
+                           Reports warm tokens/s, decode-attention HBM
                            bytes/token (sampled at peak occupancy from
-                           the /cache hbm-read-per-token feed, so int8
-                           must show its ~2x reduction MEASURED) and
-                           the effective attention GFLOP/s, plus
-                           token identity across modes (bf16 modes
-                           must match exactly; int8 reports its greedy
-                           match against the bf16 oracle)
+                           the /cache hbm-read-per-token feed, so the
+                           int8/fp8 byte reductions are MEASURED, not
+                           modeled) and the effective attention
+                           GFLOP/s, plus a per-cell oracle-match gate:
+                           bf16 cells (any kernel, incl. the
+                           tolerance-budgeted fused_online) must match
+                           the gather/bf16 oracle exactly; quantized
+                           cells report their greedy match fraction
 
 Prints one JSON line per engine. This is an operator harness, not part
 of bench.py's driver metrics — serving throughput depends on the
@@ -237,17 +241,19 @@ def main() -> int:
     # 6. decode-attention roofline wave: the same greedy mix through
     # each (paged_kernel, kv_dtype) mode. bytes/token samples the
     # hbm_read_stats feed at PEAK table occupancy (mid-run max, not
-    # the post-run zero), so the int8 ~2x reduction is a measured
-    # number; effective GFLOP/s models decode attention as its two
-    # matmuls (QK^T + PV: 4 * S * n_heads * head_dim flops per token
-    # per layer over the occupancy-derived S).
+    # the post-run zero), so the int8 ~2x / fp8 ~4x-vs-f32 reductions
+    # are measured numbers; effective GFLOP/s models decode attention
+    # as its two matmuls (QK^T + PV: 4 * S * n_heads * head_dim flops
+    # per token per layer over the occupancy-derived S).
     def paged_decode_bench():
         dreqs = [(rng.integers(1, 1000, 24).tolist(), 48)
                  for _ in range(8)]
         dtotal = sum(m for _, m in dreqs)
-        modes = [("gather", "bf16"), ("gather", "int8")]
+        dtypes = ("bf16", "int8", "fp8")
+        modes = [("gather", kvd) for kvd in dtypes]
         if on_tpu:
-            modes += [("fused", "bf16"), ("fused", "int8")]
+            modes += [(kern, kvd) for kern in ("fused", "fused_online")
+                      for kvd in dtypes]
 
         def run_mode(kern, kvd):
             def run_once():

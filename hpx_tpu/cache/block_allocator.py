@@ -29,25 +29,28 @@ __all__ = ["BlockAllocator", "CacheOOM", "block_bytes",
            "blocks_for_budget"]
 
 # storage bytes per KV element, by `hpx.cache.kv_dtype`. The scale
-# sidecar rides separately: int8 pools carry one f32 scale per
-# (block, kv-head) per pool (K and V each), accounted by block_bytes.
-_KV_ITEMSIZE = {"bf16": 2, "f32": 4, "int8": 1}
+# sidecar rides separately: quantized pools (int8 AND fp8 — both
+# 1 byte/elem) carry one f32 scale per (block, kv-head) per pool
+# (K and V each), accounted by block_bytes.
+_KV_ITEMSIZE = {"bf16": 2, "f32": 4, "int8": 1, "fp8": 1}
 _SCALE_BYTES = 4          # f32 per (block, kv-head) sidecar entry
+_QUANTIZED_KV = ("int8", "fp8")   # kv_dtypes that ride a scale sidecar
 
 
 def block_bytes(block_size: int, n_kv: int, head_dim: int,
                 kv_dtype: str = "bf16", layers: int = 1) -> int:
     """HBM bytes ONE pool block costs across `layers` layers, K and V
-    pools both, INCLUDING the int8 scale sidecar — the unit for
-    dtype-aware pool sizing and for the bytes/token roofline counters
-    (cache/counters.py). int8 halves the row bytes vs bf16; the
+    pools both, INCLUDING the quantized-dtype scale sidecar — the unit
+    for dtype-aware pool sizing and for the bytes/token roofline
+    counters (cache/counters.py). int8 and fp8 (e4m3) both store
+    1 byte/elem — half of bf16, a quarter of an f32 compute dtype; the
     sidecar adds 4 bytes per (block, kv-head) per pool, amortized to
     noise for any real block_size * head_dim."""
     if kv_dtype not in _KV_ITEMSIZE:
         raise ValueError(f"unknown kv_dtype {kv_dtype!r}; expected one "
                          f"of {sorted(_KV_ITEMSIZE)}")
     rows = block_size * n_kv * head_dim * _KV_ITEMSIZE[kv_dtype]
-    sidecar = n_kv * _SCALE_BYTES if kv_dtype == "int8" else 0
+    sidecar = n_kv * _SCALE_BYTES if kv_dtype in _QUANTIZED_KV else 0
     return 2 * layers * (rows + sidecar)          # K pool + V pool
 
 
@@ -82,9 +85,10 @@ class BlockAllocator:
                              f"one of {sorted(_KV_ITEMSIZE)}")
         self.num_blocks = num_blocks
         self.block_size = block_size
-        # storage dtype of the pools this allocator's ids index — int8
-        # pools carry a [num_blocks, n_kv] f32 scale sidecar per pool,
-        # sized/accounted via block_bytes/pool_bytes
+        # storage dtype of the pools this allocator's ids index —
+        # quantized pools (int8/fp8) carry a [num_blocks, n_kv] f32
+        # scale sidecar per pool, sized/accounted via
+        # block_bytes/pool_bytes
         self.kv_dtype = kv_dtype
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._ref: Dict[int, int] = {}
@@ -191,7 +195,7 @@ class BlockAllocator:
         return (None, None, tp_axis, None)
 
     def scale_pspec(self, tp_axis: Optional[str] = None) -> tuple:
-        """PartitionSpec entries for the `[num_blocks, n_kv]` int8
+        """PartitionSpec entries for the `[num_blocks, n_kv]` int8/fp8
         scale sidecars — same placement rule as `pool_pspec` (blocks
         replicated, kv-heads over tp)."""
         return (None, tp_axis)
@@ -199,7 +203,7 @@ class BlockAllocator:
     def pool_bytes(self, n_kv: int, head_dim: int,
                    layers: int = 1) -> int:
         """Total HBM footprint of the pools this allocator sizes
-        (scale sidecars included for int8) — what the HBM-budget
+        (scale sidecars included for int8/fp8) — what the HBM-budget
         counters and `blocks_for_budget` callers reason about."""
         return self.num_blocks * block_bytes(
             self.block_size, n_kv, head_dim, self.kv_dtype, layers)

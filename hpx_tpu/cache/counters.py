@@ -41,7 +41,10 @@ Paged servers additionally export the cache counters::
     /cache{locality#L/server#i}/count/hbm-read-per-token  mapped blocks streamed
                                                           per decode token
     /cache{locality#L/server#i}/bytes/hbm-read-per-token  dtype-aware bytes of the
-                                                          above (int8 sidecar incl.)
+                                                          above (int8/fp8 scale
+                                                          sidecars incl. — fp8 pools
+                                                          report the ~0.25x ratio vs
+                                                          an f32 compute dtype)
 """
 
 from __future__ import annotations
@@ -150,7 +153,7 @@ def register_server(srv) -> str:
         put("cache", "prefill-tokens/computed",
             pc.CallbackCounter(_read(ref, lambda s: s._prefill_computed)))
         # decode-attention HBM roofline feed: mapped blocks (and their
-        # dtype-aware bytes, int8 scale sidecars included) streamed
+        # dtype-aware bytes, int8/fp8 scale sidecars included) streamed
         # per generated token — see ContinuousServer.hbm_read_stats
         put("cache", "count/hbm-read-per-token",
             pc.CallbackCounter(_read(ref, lambda s: s.hbm_read_stats()

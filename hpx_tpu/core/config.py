@@ -124,8 +124,12 @@ class Configuration:
     ``strict=True`` turns the config_schema registry into a runtime
     contract: reading or setting an undeclared ``hpx.``-prefixed key
     raises BadParameter instead of silently answering the default —
-    the runtime twin of hpxlint HPX014's static check. Keys outside
-    the ``hpx.`` namespace are never policed (application-private)."""
+    the runtime twin of hpxlint HPX014's static check — and setting an
+    enumerated str knob (one declared with ``choices=``) to a value
+    outside its valid set raises with that set spelled out (a typo'd
+    ``hpx.cache.kv_dtype=fp8_e5m2`` fails at the set() instead of
+    surfacing as a downstream serving error). Keys outside the
+    ``hpx.`` namespace are never policed (application-private)."""
 
     def __init__(self,
                  argv: Optional[Iterable[str]] = None,
@@ -187,6 +191,18 @@ class Configuration:
                 f"undeclared config key {key!r} (strict mode): declare it "
                 "in hpx_tpu/core/config_schema.py first", "config")
 
+    def _check_value(self, key: str, value: str) -> None:
+        """Strict mode: enumerated str knobs (declared with choices=)
+        only accept their valid set."""
+        if not (self._strict and key.startswith("hpx.")):
+            return
+        entry = config_schema.lookup(key)
+        if (entry is not None and entry.choices is not None
+                and value not in entry.choices):
+            raise BadParameter(
+                f"{key}={value!r} is not a valid value (strict mode); "
+                f"expected one of {list(entry.choices)}", "config")
+
     # -- queries ------------------------------------------------------------
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
         self._check_declared(key)
@@ -216,6 +232,7 @@ class Configuration:
 
     def set(self, key: str, value: Any) -> None:
         self._check_declared(str(key))
+        self._check_value(str(key), str(value))
         with self._lock:
             self._data[str(key)] = str(value)
 

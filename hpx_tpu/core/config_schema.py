@@ -25,7 +25,7 @@ or HPX014 flags the read as undeclared and tier-1 fails.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 _VALID_TYPES = ("str", "int", "bool", "float")
 
@@ -39,20 +39,36 @@ class ConfigKey:
     default: Optional[str]    # None = no compiled-in default
     doc: str
     reserved: bool = False    # HPX-parity: declared but not read (yet)
+    # closed value set for enumerated str knobs (None = free-form).
+    # ``Configuration(strict=True)`` rejects a set() outside it with
+    # the valid set in the error — a typo'd kv_dtype=fp8_e5m2 fails at
+    # the set, not as a silently-ignored setting downstream.
+    choices: Optional[Tuple[str, ...]] = None
 
 
 _SCHEMA: Dict[str, ConfigKey] = {}
 
 
 def declare(key: str, type: str, default: Optional[str], doc: str,
-            reserved: bool = False) -> ConfigKey:
-    """Register one knob; duplicate keys and unknown types are errors."""
+            reserved: bool = False,
+            choices: Optional[Tuple[str, ...]] = None) -> ConfigKey:
+    """Register one knob; duplicate keys and unknown types are errors.
+    ``choices`` declares a closed value set for enumerated str knobs
+    (the declared default must be a member)."""
     if type not in _VALID_TYPES:
         raise ValueError(f"config key {key!r}: bad type {type!r} "
                          f"(expected one of {_VALID_TYPES})")
     if key in _SCHEMA:
         raise ValueError(f"config key {key!r} declared twice")
-    entry = ConfigKey(key, type, default, doc, reserved)
+    if choices is not None:
+        choices = tuple(choices)
+        if type != "str":
+            raise ValueError(f"config key {key!r}: choices= is only "
+                             "meaningful for str knobs")
+        if default is not None and default not in choices:
+            raise ValueError(f"config key {key!r}: default {default!r} "
+                             f"not in choices {choices}")
+    entry = ConfigKey(key, type, default, doc, reserved, choices)
     _SCHEMA[key] = entry
     return entry
 
@@ -192,10 +208,19 @@ declare("hpx.cache.radix_budget_blocks", "str", "auto",
 declare("hpx.cache.prefix_reuse", "bool", "1",
         "radix prefix matching on admit")
 declare("hpx.cache.kv_dtype", "str", "bf16",
-        "paged pool storage: bf16 | int8")
+        "paged pool storage: bf16 (compute dtype) | int8 (absmax-scaled "
+        "integer blocks) | fp8 (e4m3 blocks, same f32 scale sidecars — "
+        "~0.25x decode bytes/token vs an f32 compute dtype)",
+        choices=("bf16", "int8", "fp8"))
 
 # -- serving ----------------------------------------------------------------
-declare("hpx.serving.paged_kernel", "str", "auto", "auto | gather | fused")
+declare("hpx.serving.paged_kernel", "str", "auto",
+        "decode-attention formulation: auto (fused on TPU, gather "
+        "elsewhere) | gather (XLA oracle) | fused (bitwise Pallas "
+        "block-table walk, O(S) VMEM scratch) | fused_online "
+        "(flash-style online softmax, O(block) scratch — "
+        "tolerance-budgeted vs the oracle, VMEM no longer bounds smax)",
+        choices=("auto", "gather", "fused", "fused_online"))
 declare("hpx.serving.prefill_chunk", "int", "128",
         "prompt tokens per prefill chunk")
 declare("hpx.serving.prefill_buckets", "str", "auto",

@@ -81,6 +81,26 @@ def _quantize(w: jax.Array, axes) -> QTensor:
     return QTensor(q=q, s=s)
 
 
+# fp8 (e4m3) twin of _quantize, used by the paged KV pools
+# (ops/paged_attention.quantize_blocks with kv_dtype=fp8). Same
+# symmetric-absmax scheme and same 1 byte/elem storage as int8, but the
+# values land on e4m3's FLOAT grid: the scale maps the group absmax
+# onto ±448 (e4m3 finfo.max) and the dtype cast does the rounding —
+# no clip/round ladder, and small values keep relative precision that
+# int8's uniform grid loses. Zero groups get scale 1.0 so fresh pools
+# roundtrip exactly (the _quantize convention).
+_FP8_DTYPE = jnp.float8_e4m3fn
+_FP8_MAX = 448.0          # jnp.finfo(float8_e4m3fn).max
+
+
+def _quantize_fp8(w: jax.Array, axes) -> QTensor:
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes,
+                   keepdims=True)
+    s = jnp.where(amax > 0, amax / _FP8_MAX, 1.0)
+    q = (w.astype(jnp.float32) / s).astype(_FP8_DTYPE)
+    return QTensor(q=q, s=s)
+
+
 def dequant(x: Any, dtype=jnp.bfloat16) -> Any:
     """QTensor/QTensor4 -> dense (fused into the consuming matmul under
     jit); anything else passes through."""

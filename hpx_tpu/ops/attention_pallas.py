@@ -44,6 +44,8 @@ _CompilerParams = getattr(pltpu, "CompilerParams",
 
 __all__ = ["flash_attention", "flash_attention_chunk",
            "flash_attention_bwd", "fused_paged_attention",
+           "fused_paged_online_attention",
+           "paged_online_scratch_shapes",
            "resolve_blocks", "resolve_paged_block"]
 
 
@@ -783,31 +785,31 @@ def flash_attention_chunk(q, k, v, acc, m, l, d,
 
 
 # ---------------------------------------------------------------------------
-# fused paged decode attention — the block-table kernel
+# fused paged decode attention — the block-table kernels
 # ---------------------------------------------------------------------------
 #
 # The serving decode hot loop: instead of materializing a
 # [B, max_blocks*block_size, n_kv, head_dim] gather per layer per step
-# (ops/paged_attention.gather_block_kv — the XLA oracle), the kernel
-# walks the int32 block table DIRECTLY. Grid (slot, kv-head, block);
+# (ops/paged_attention.gather_block_kv — the XLA oracle), the kernels
+# walk the int32 block table DIRECTLY. Grid (slot, kv-head, block);
 # the K/V BlockSpec index_map resolves logical block i of slot b to its
 # physical pool block via the scalar-prefetched table
 # (table_ref[b, i]), so each (block_size, head_dim) tile streams
 # HBM -> VMEM exactly once and no logical view ever touches HBM.
 #
-# int8 pools dequantize AT THE VMEM BOUNDARY: per-(block, kv-head)
-# absmax scales ride a sibling [num_blocks, n_kv] f32 array whose
-# BlockSpec follows the same table indirection, and
-# (int8 * scale).astype(q.dtype) happens on the freshly-landed tile —
-# HBM moves 1 byte/elem instead of 2.
+# Quantized (int8/fp8) pools dequantize AT THE VMEM BOUNDARY:
+# per-(block, kv-head) absmax scales ride a sibling [num_blocks, n_kv]
+# f32 array whose BlockSpec follows the same table indirection, and
+# (q * scale).astype(q.dtype) happens on the freshly-landed tile —
+# HBM moves 1 byte/elem instead of 2 (bf16) or 4 (f32).
 #
-# Numerics contract (why this is NOT the classic online softmax): the
-# fused path must keep emitting the SAME TOKENS as the gather oracle
-# and the dense server (tests pin dense == gather-paged == fused-paged
-# greedy/sampled/speculative). A running-max online softmax rescales
-# partial accumulators and drifts O(eps * S) from the oracle's
-# one-pass `jax.nn.softmax`. So the kernel spends its VMEM on
-# exactness instead: per-block score tiles are stashed into an
+# TWO kernels share that walk, trading VMEM for exactness differently:
+#
+# `fused` (_paged_kernel) — the BITWISE reference. The fused path must
+# be able to emit the SAME TOKENS as the gather oracle and the dense
+# server with bitwise-equal scores and softmax (tests pin dense ==
+# gather-paged == fused-paged greedy/sampled/speculative), so it
+# spends VMEM on exactness: per-block score tiles are stashed into an
 # (W*g, S) f32 scratch and dequantized V rows into an (S, hd) scratch
 # along the sequential block axis, and the LAST block step applies the
 # oracle's op order verbatim — mask to -inf, f32 softmax over the full
@@ -820,9 +822,35 @@ def flash_attention_chunk(q, k, v, acc, m, l, d,
 # same amount, as do its W=1 decode and W-window verify gemms), and
 # the reason every serving equivalence contract here is pinned at
 # exact TOKENS plus ulp-tight logits. VMEM cost is O(S*(W*g + hd))
-# per (slot, head) step — fine at serving smax — and the HBM story
-# (the thing the roofline cares about) is identical to a flash-style
-# walk.
+# per (slot, head) step, which is what CAPS the usable context: S
+# rides the scratch, so smax can't outgrow VMEM.
+#
+# `fused_online` (_paged_online_kernel) — the O(block) roofline leg.
+# The classic flash-attention move applied to the paged walk: the
+# kernel carries only the (acc, m, l) online-softmax state —
+# (W*g, hd) f32 accumulator plus two lane-replicated (W*g, 128)
+# running max/denominator rows — and each K/V block tile is consumed
+# the moment it lands (Pallas double-buffers the streamed BlockSpec
+# tiles against compute, exactly like the flash kernels above). NO
+# scratch has sequence extent, so VMEM no longer bounds smax and the
+# HBM traffic is unchanged — pure roofline win at long context. The
+# price is the numerics contract: a running-max softmax rescales
+# partial accumulators (exp(m_prev - m_new) multiplies) and its
+# reduction ORDER differs from the oracle's one-pass jax.nn.softmax,
+# so results drift O(eps * nblk) from the oracle — a few ulp at
+# serving shapes, NOT bitwise. The equivalence gate for fused_online
+# is therefore tolerance-budgeted (logits allclose at a few-ulp rtol;
+# greedy tokens identical across the dense/paged/spec sweep), with
+# `fused` kept as the bitwise reference. Masking stays EXACT-zero
+# (p = where(live, exp(s - m), 0)), so trash/pad blocks contribute
+# exactly 0.0 probability mass in both kernels, and both share the
+# per-window-row horizon `kpos <= pos0 + wrow` for the decode (W=1)
+# and spec-verify window entry points.
+#
+# Pick `fused` when byte-identity with the dense/gather server is the
+# contract (rollback-heavy speculation audits, A/B token equality);
+# pick `fused_online` when context length presses VMEM — the knob is
+# hpx.serving.paged_kernel = fused | fused_online | gather | auto.
 
 _PAGED_BLOCKS_FILE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "paged_blocks.json")
@@ -906,6 +934,100 @@ def _paged_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
         o_ref[0, 0] = att.astype(o_ref.dtype)
 
 
+def paged_online_scratch_shapes(wg_pad: int, head_dim: int) -> list:
+    """The fused_online VMEM carry: (acc, m, l) — (W*g, hd) f32
+    accumulator plus two lane-replicated (W*g, 128) running-max /
+    denominator rows. O(block) BY CONSTRUCTION: the function does not
+    even take a sequence length, so no scratch can carry S extent —
+    the acceptance gate for the online kernel asserts exactly this."""
+    return [
+        pltpu.VMEM((wg_pad, head_dim), jnp.float32),   # acc
+        pltpu.VMEM((wg_pad, 128), jnp.float32),        # m (running max)
+        pltpu.VMEM((wg_pad, 128), jnp.float32),        # l (denominator)
+    ]
+
+
+def _paged_online_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref,
+                         *rest, block_size: int, nblk: int, group: int,
+                         quantized: bool):
+    """One (slot b, kv-head h, logical block i) grid step of the
+    online-softmax paged walk.
+
+    Same operands and table indirection as `_paged_kernel`, but the
+    carry is the flash (acc, m, l) state (`paged_online_scratch_shapes`)
+    instead of the full score/V rows: each freshly-landed K/V tile is
+    folded into the running softmax immediately (delayed rescaling —
+    the corr multiply only fires when the running max moved, exactly
+    the `_flash_kernel` idiom) and the last block step normalizes.
+    Masked lanes get EXACT-zero probability (p is where()'d, not just
+    exp()'d), so trash/pad blocks contribute 0.0 like the bitwise
+    kernel's."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_s, m_s, l_s = rest
+    else:
+        o_ref, acc_s, m_s, l_s = rest
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    q = q_ref[0, 0]                                # (Wg, hd)
+    k = k_ref[0, :, 0, :]                          # (bs, hd)
+    v = v_ref[0, :, 0, :]
+    if quantized:
+        # dequantize at the VMEM boundary — elementwise-identical to
+        # the oracle's (pool * scale).astype(q.dtype)
+        k = (k.astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs_ref[0, 0]).astype(q.dtype)
+
+    # f32 score accumulation (the flash numerics contract) — this
+    # kernel's gate is tolerance-budgeted, so MXU-rate operands with
+    # f32 accumulation beat the bitwise kernel's oracle-order dots
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s / math.sqrt(q.shape[-1])                 # (Wg, bs) f32
+
+    pos0 = pos_ref[b]
+    kpos = i * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    wrow = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+    live = kpos <= pos0 + wrow                     # per-window-row horizon
+    s = jnp.where(live, s, _NEG_INF)
+
+    m_prev = m_s[:, :1]                            # (Wg, 1)
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(live, p, 0.0)                    # masked lanes: exact 0
+
+    # delayed rescaling: skip the corr multiply on every block where
+    # the running max didn't move (corr == exp(0) == 1)
+    @pl.when(jnp.logical_not((m_new == m_prev).all()))
+    def _rescale():
+        corr = jnp.exp(m_prev - m_new)
+        acc_s[:] = acc_s[:] * corr
+        l_s[:] = l_s[:] * corr
+
+    m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+    l_s[:] = l_s[:] + jnp.broadcast_to(
+        p.sum(axis=1, keepdims=True), l_s.shape)
+    acc_s[:] = acc_s[:] + jax.lax.dot_general(
+        p.astype(v.dtype) if v.dtype == jnp.bfloat16 else p, v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(i == nblk - 1)
+    def _finish():
+        # every real row has at least position 0 live, so l > 0; the
+        # guard covers only the 8-sublane pad rows (sliced off outside)
+        l = l_s[:, :1]
+        den = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_s[:] / den).astype(o_ref.dtype)
+
+
 def fused_paged_attention(q: jax.Array, k_pool: jax.Array,
                           v_pool: jax.Array, table: jax.Array,
                           pos0: jax.Array,
@@ -921,8 +1043,9 @@ def fused_paged_attention(q: jax.Array, k_pool: jax.Array,
     gather oracle); table: [B, max_blocks] int32; pos0: [B] int32 —
     window row w attends logical positions <= pos0 + w (W = 1: the
     inclusive `<= pos` decode mask). k_scale/v_scale: [num_blocks,
-    n_kv] f32 per-(block, head) absmax scales for int8 pools (None for
-    bf16/f32 pools). Returns att [B, W, n_q, head_dim] in q.dtype.
+    n_kv] f32 per-(block, head) absmax scales for quantized (int8/fp8)
+    pools (None for bf16/f32 pools). Returns att [B, W, n_q, head_dim]
+    in q.dtype.
 
     Every logical block (trash-padded tail included) is processed and
     masked, never skipped — rows past pos0+w contribute exact-zero
@@ -940,9 +1063,48 @@ def fused_paged_attention(q: jax.Array, k_pool: jax.Array,
     counts (tp slices the kv-head axis, so the GQA group n_q // n_kv
     is unchanged), the block axis is dp-replicated so the
     scalar-prefetched table's global block ids index the local pool
-    directly, and int8 scales arrive pre-sliced per (block, local
+    directly, and int8/fp8 scales arrive pre-sliced per (block, local
     head) — no kernel-visible difference from the single-device
     call."""
+    return _fused_paged_call(q, k_pool, v_pool, table, pos0,
+                             k_scale, v_scale, interpret, online=False)
+
+
+def fused_paged_online_attention(q: jax.Array, k_pool: jax.Array,
+                                 v_pool: jax.Array, table: jax.Array,
+                                 pos0: jax.Array,
+                                 k_scale: Optional[jax.Array] = None,
+                                 v_scale: Optional[jax.Array] = None,
+                                 interpret: Optional[bool] = None
+                                 ) -> jax.Array:
+    """`fused_paged_attention` with an in-kernel online softmax —
+    the O(block)-scratch variant (`hpx.serving.paged_kernel=
+    fused_online`).
+
+    Same operands, same scalar-prefetched table walk, same exact-zero
+    masking and decode/spec-verify window semantics as the bitwise
+    kernel — only the carry differs: instead of stashing (W*g, S)
+    scores + (S, hd) V rows, the kernel streams each K/V block through
+    the flash (acc, m, l) state (`paged_online_scratch_shapes` — no
+    scratch carries sequence extent), so VMEM stops bounding smax.
+    Pallas double-buffers the streamed tiles against compute along the
+    sequential block axis.
+
+    Numerics contract (tolerance-budgeted — NOT bitwise): the
+    running-max rescales reorder the softmax reduction, so logits
+    agree with the gather oracle to a few ulp (O(eps * num_blocks))
+    rather than bit-for-bit; greedy tokens are identical across the
+    dense/paged/spec test sweep. When byte-identity is the requirement,
+    use `fused` — that kernel stays the bitwise reference."""
+    return _fused_paged_call(q, k_pool, v_pool, table, pos0,
+                             k_scale, v_scale, interpret, online=True)
+
+
+def _fused_paged_call(q, k_pool, v_pool, table, pos0, k_scale, v_scale,
+                      interpret, online: bool) -> jax.Array:
+    """Shared launch path for the two paged kernels: identical grid,
+    BlockSpec table indirection, quantized-scale plumbing, and
+    pad/slice layout — only the kernel body and its scratch differ."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, w, nq, hd = q.shape
@@ -965,8 +1127,15 @@ def fused_paged_attention(q: jax.Array, k_pool: jax.Array,
 
     quantized = k_scale is not None
     kernel = functools.partial(
-        _paged_kernel, block_size=bs, nblk=maxb, group=g,
-        quantized=quantized)
+        _paged_online_kernel if online else _paged_kernel,
+        block_size=bs, nblk=maxb, group=g, quantized=quantized)
+    if online:
+        # the flash carry — O(block), no sequence extent anywhere
+        scratch = paged_online_scratch_shapes(wg_pad, hd)
+    else:
+        # the bitwise kernel banks full rows: O(S * (W*g + hd))
+        scratch = [pltpu.VMEM((wg_pad, seq), jnp.float32),
+                   pltpu.VMEM((seq, hd), jnp.float32)]
 
     q_spec = pl.BlockSpec((1, 1, wg_pad, hd),
                           lambda bb, hh, ii, *_: (bb, hh, 0, 0))
@@ -990,10 +1159,7 @@ def fused_paged_attention(q: jax.Array, k_pool: jax.Array,
             grid=(b, nkv, maxb),
             in_specs=in_specs,
             out_specs=[q_spec],
-            scratch_shapes=[
-                pltpu.VMEM((wg_pad, seq), jnp.float32),
-                pltpu.VMEM((seq, hd), jnp.float32),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=[_sds((b, nkv, wg_pad, hd), q.dtype, q, k_pool,
                         v_pool)],

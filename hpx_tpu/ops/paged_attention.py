@@ -19,26 +19,34 @@ exactly 0.0 — paged and dense servers emit byte-identical tokens.
 The gather materializes a `[B, S, n_kv, head_dim]` view per layer —
 the XLA-oracle formulation, and the DESIGNATED oracle module: hpxlint
 HPX010 flags `pool[table]`-shaped gathers anywhere else in the serving
-hot paths. The fused Pallas kernel that walks the block table in VMEM
-(`ops/attention_pallas.fused_paged_attention`) is the production
-decode path; `fused=True` on the two attention entry points routes
-through it, and the gather formulation here is what it is tested
-against (exact tokens, ulp-tight logits — see the kernel's numerics
-contract).
+hot paths. The fused Pallas kernels that walk the block table in VMEM
+(`ops/attention_pallas.fused_paged_attention` and its O(block)-scratch
+online-softmax sibling `fused_paged_online_attention`) are the
+production decode paths; `fused=True` / `fused="online"` on the two
+attention entry points routes through them, and the gather formulation
+here is what both are tested against (exact tokens; ulp-tight logits
+for `fused`, tolerance-budgeted for `fused="online"` — see the
+kernels' numerics contracts).
 
-Quantized KV (`hpx.cache.kv_dtype=int8`): pools store int8 blocks with
-per-(block, kv-head) symmetric-absmax scales in a sibling
-`[num_blocks, n_kv]` f32 array (the scheme of `models/quant.py`,
-applied per block instead of per output channel — paged blocks make
-per-block mixed precision natural). Writes quantize at the frontier:
-the `*_q` scatter variants read-modify-write the touched block
-(dequantize with the old scale, insert the new rows, recompute the
-block's absmax, requantize). Requantization of UNTOUCHED rows is
-exact whenever the block absmax didn't move (max|q| == 127 by
-construction, so the recomputed scale is bit-identical), and bounded
-by one rounding step when it did. The gather side dequantizes with
-the same elementwise ops the kernel uses at its VMEM boundary, so
-gather-int8 and fused-int8 agree exactly like their bf16 twins.
+Quantized KV (`hpx.cache.kv_dtype=int8` or `fp8`): pools store
+quantized blocks with per-(block, kv-head) symmetric-absmax scales in
+a sibling `[num_blocks, n_kv]` f32 array (the scheme of
+`models/quant.py`, applied per block instead of per output channel —
+paged blocks make per-block mixed precision natural). int8 rounds onto
+the 127-level integer ladder; fp8 (e4m3) scales the block absmax onto
+±448 and lets the float8 cast round — both 1 byte/elem. The `*_q`
+scatter variants pick the grid off the pool's dtype, so every code
+path below serves both. Writes quantize at the frontier: the `*_q`
+variants read-modify-write the touched block (dequantize with the old
+scale, insert the new rows, recompute the block's absmax, requantize).
+Requantization of UNTOUCHED rows is exact whenever the block absmax
+didn't move (int8: max|q| == 127 by construction so the recomputed
+scale is bit-identical; fp8: the e4m3 cast of an unchanged quotient
+reproduces itself), and bounded by one rounding step when it did. The
+gather side dequantizes with the same elementwise ops the kernels use
+at their VMEM boundary ((q * scale).astype(compute)), so
+gather-quantized and fused-quantized agree exactly like their bf16
+twins.
 
 Sharded serving (shard_map on a (dp, tp) mesh): every function here is
 written against LOCAL shapes only — `n_kv` and `n_q` are read off the
@@ -58,8 +66,9 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..models.quant import _quantize
-from .attention_pallas import fused_paged_attention
+from ..models.quant import _quantize, _quantize_fp8
+from .attention_pallas import (fused_paged_attention,
+                               fused_paged_online_attention)
 
 __all__ = [
     "gather_block_kv",
@@ -87,10 +96,10 @@ def gather_block_kv(pool: jax.Array, table: jax.Array,
     head_dim] — slot b's logical row p at index p (pad blocks yield
     garbage rows the causal mask must exclude).
 
-    For int8 pools pass `scale` ([num_blocks, n_kv] f32) and the
-    compute `out_dtype`: blocks dequantize with the same elementwise
-    ops the fused kernel applies at its VMEM boundary
-    ((int8 * scale).astype(out_dtype)), keeping the two int8 paths
+    For quantized (int8/fp8) pools pass `scale` ([num_blocks, n_kv]
+    f32) and the compute `out_dtype`: blocks dequantize with the same
+    elementwise ops the fused kernels apply at their VMEM boundary
+    ((q * scale).astype(out_dtype)), keeping the quantized paths
     exactly comparable."""
     g = pool[table]                       # [B, maxb, bs, nkv, hd]
     b, m, s, n, h = g.shape
@@ -101,12 +110,23 @@ def gather_block_kv(pool: jax.Array, table: jax.Array,
     return g.reshape(b, m * s, n, h)
 
 
-def quantize_blocks(rows: jax.Array):
-    """Symmetric-absmax int8 per (block, kv-head): rows [..., block_size,
-    n_kv, head_dim] -> (int8 rows, scales [..., n_kv] f32). Zero blocks
-    get scale 1.0 (models/quant._quantize's convention), so fresh pools
-    roundtrip exactly."""
-    qt = _quantize(rows, axes=(-3, -1))
+def quantize_blocks(rows: jax.Array, dtype=jnp.int8):
+    """Symmetric-absmax quantization per (block, kv-head): rows [...,
+    block_size, n_kv, head_dim] -> (quantized rows, scales [..., n_kv]
+    f32). `dtype` picks the grid — jnp.int8 (127-level integer ladder)
+    or jnp.float8_e4m3fn (e4m3 float grid, block absmax mapped onto
+    ±448); anything else is a loud error, never a silent fallback.
+    Zero blocks get scale 1.0 (models/quant's convention), so fresh
+    pools roundtrip exactly."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.int8):
+        qt = _quantize(rows, axes=(-3, -1))
+    elif dt == jnp.dtype(jnp.float8_e4m3fn):
+        qt = _quantize_fp8(rows, axes=(-3, -1))
+    else:
+        raise ValueError(
+            f"quantize_blocks: unsupported pool dtype {dt} (expected "
+            "int8 or float8_e4m3fn)")
     return qt.q, jnp.squeeze(qt.s, axis=(-3, -1))
 
 
@@ -156,9 +176,10 @@ def scatter_window(pool: jax.Array, table: jax.Array, pos0: jax.Array,
 def scatter_token_q(pool_q: jax.Array, scales: jax.Array,
                     table: jax.Array, pos: jax.Array,
                     val: jax.Array):
-    """`scatter_token` for int8 pools: read-modify-write the frontier
-    block. pool_q int8 [num_blocks, block_size, n_kv, head_dim]; scales
-    f32 [num_blocks, n_kv]; val [B, n_kv, head_dim] full-precision.
+    """`scatter_token` for quantized pools: read-modify-write the
+    frontier block. pool_q int8/fp8 [num_blocks, block_size, n_kv,
+    head_dim] (its dtype picks the requantization grid); scales f32
+    [num_blocks, n_kv]; val [B, n_kv, head_dim] full-precision.
     Returns (pool_q, scales).
 
     Each slot's frontier block is gathered (B blocks, not the full
@@ -181,7 +202,7 @@ def scatter_token_q(pool_q: jax.Array, scales: jax.Array,
     scl = scales[bidx]                    # [B, nkv]
     deq = blk.astype(jnp.float32) * scl[:, None, :, None]
     deq = deq.at[rows, pos % bs].set(val.astype(jnp.float32))
-    q8, s_new = quantize_blocks(deq)
+    q8, s_new = quantize_blocks(deq, pool_q.dtype)
     bidx = jnp.where(pos < maxb * bs, bidx, nb)         # OOB -> dropped
     pool_q = pool_q.at[bidx].set(q8, mode="drop")
     scales = scales.at[bidx].set(s_new, mode="drop")
@@ -191,7 +212,8 @@ def scatter_token_q(pool_q: jax.Array, scales: jax.Array,
 def scatter_window_q(pool_q: jax.Array, scales: jax.Array,
                      table: jax.Array, pos0: jax.Array,
                      vals: jax.Array):
-    """`scatter_window` for int8 pools: W sequential frontier RMWs.
+    """`scatter_window` for quantized pools: W sequential frontier
+    RMWs.
 
     vals [B, W, n_kv, head_dim]. The window's rows land one at a time
     (a Python-unrolled W-step chain, W is static and small) because
@@ -208,21 +230,21 @@ def scatter_window_q(pool_q: jax.Array, scales: jax.Array,
 
 def scatter_blocks_q(pool_q: jax.Array, scales: jax.Array,
                      bids: jax.Array, rows: jax.Array):
-    """`scatter_blocks` for int8 pools: whole blocks quantize in one
-    shot (no RMW — the writes fully replace their targets). Returns
-    (pool_q, scales)."""
-    q8, s = quantize_blocks(rows)
+    """`scatter_blocks` for quantized pools: whole blocks quantize in
+    one shot (no RMW — the writes fully replace their targets).
+    Returns (pool_q, scales)."""
+    q8, s = quantize_blocks(rows, pool_q.dtype)
     return pool_q.at[bids].set(q8), scales.at[bids].set(s)
 
 
 def scatter_seq_blocks_q(pool_q: jax.Array, scales: jax.Array,
                          table_row: jax.Array, rows: jax.Array):
-    """`scatter_seq_blocks` for int8 pools (the chunked-prefill
+    """`scatter_seq_blocks` for quantized pools (the chunked-prefill
     splice): every block of one sequence quantizes whole. Trash-pad
     duplicates behave exactly as in the bf16 splice — garbage blocks
     get garbage scales, gathered only under exact-zero masks. Returns
     (pool_q, scales)."""
-    q8, s = quantize_blocks(rows)
+    q8, s = quantize_blocks(rows, pool_q.dtype)
     return (pool_q.at[table_row].set(q8),
             scales.at[table_row].set(s))
 
@@ -255,7 +277,7 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
                            v_pool: jax.Array, table: jax.Array,
                            pos: jax.Array, k_scale: jax.Array = None,
                            v_scale: jax.Array = None,
-                           fused: bool = False, interpret=None):
+                           fused=False, interpret=None):
     """One decode step of attention over paged K/V.
 
     q: [B, 1, n_q, head_dim] (post-rope); k_new/v_new: [B, n_kv,
@@ -266,11 +288,14 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
     precedes the attention so each slot attends its own fresh token
     (the mask is `<= pos`, inclusive).
 
-    `fused=True` routes the attention through the Pallas block-table
-    kernel instead of the gather formulation (same writes either way).
-    int8 pools pass k_scale/v_scale ([num_blocks, n_kv] f32): the new
-    rows quantize at write time (frontier RMW) and the return grows to
-    (att, k_pool, v_pool, k_scale, v_scale)."""
+    `fused=True` routes the attention through the bitwise Pallas
+    block-table kernel instead of the gather formulation;
+    `fused="online"` routes through the O(block)-scratch online-softmax
+    variant (tolerance-budgeted — see its numerics contract). Same
+    writes either way. Quantized (int8/fp8) pools pass k_scale/v_scale
+    ([num_blocks, n_kv] f32): the new rows quantize at write time
+    (frontier RMW, grid picked off the pool dtype) and the return grows
+    to (att, k_pool, v_pool, k_scale, v_scale)."""
     quant = k_scale is not None
     if quant:
         k_pool, k_scale = scatter_token_q(k_pool, k_scale, table, pos,
@@ -281,9 +306,11 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
         k_pool = scatter_token(k_pool, table, pos, k_new)
         v_pool = scatter_token(v_pool, table, pos, v_new)
     if fused:
-        att = fused_paged_attention(q, k_pool, v_pool, table, pos,
-                                    k_scale=k_scale, v_scale=v_scale,
-                                    interpret=interpret)
+        fpa = (fused_paged_online_attention if fused == "online"
+               else fused_paged_attention)
+        att = fpa(q, k_pool, v_pool, table, pos,
+                  k_scale=k_scale, v_scale=v_scale,
+                  interpret=interpret)
     else:
         kc = gather_block_kv(k_pool, table, k_scale, q.dtype)
         vc = gather_block_kv(v_pool, table, v_scale, q.dtype)
@@ -309,7 +336,7 @@ def paged_window_attention(q: jax.Array, k_new: jax.Array,
                            v_pool: jax.Array, table: jax.Array,
                            pos0: jax.Array, k_scale: jax.Array = None,
                            v_scale: jax.Array = None,
-                           fused: bool = False, interpret=None):
+                           fused=False, interpret=None):
     """W-token speculative-verify attention over paged K/V.
 
     q: [B, W, n_q, head_dim] (post-rope); k_new/v_new: [B, W, n_kv,
@@ -317,8 +344,10 @@ def paged_window_attention(q: jax.Array, k_new: jax.Array,
     int32 first position per slot (window row i sits at pos0+i).
     Returns (att [B, W, n_q, head_dim], k_pool, v_pool) — plus the
     updated scales when k_scale/v_scale are given, exactly like
-    `paged_decode_attention`; `fused=True` routes through the Pallas
-    block-table kernel (whose per-window-row horizon mask matches).
+    `paged_decode_attention`; `fused=True` routes through the bitwise
+    Pallas block-table kernel and `fused="online"` through the
+    online-softmax variant (both share the per-window-row horizon
+    mask).
 
     Per-query causal horizon: window row i attends positions
     `<= pos0 + i` — exactly the horizon W sequential `scatter_token` +
@@ -328,7 +357,7 @@ def paged_window_attention(q: jax.Array, k_new: jax.Array,
     the same write-precedes-gather reason as the dense scratch tail:
     a position is only ever attended once the frontier reaches it, and
     the frontier only advances past freshly (re)written rows. Under
-    int8 that garbage ALSO sits under the block's absmax until
+    quantized pools that garbage ALSO sits under the block's absmax until
     rewritten — rejected rows can widen their block's scale, which
     costs the block's live rows at most one extra requantization
     rounding, identically on the gather and fused paths."""
@@ -342,9 +371,11 @@ def paged_window_attention(q: jax.Array, k_new: jax.Array,
         k_pool = scatter_window(k_pool, table, pos0, k_new)
         v_pool = scatter_window(v_pool, table, pos0, v_new)
     if fused:
-        att = fused_paged_attention(q, k_pool, v_pool, table, pos0,
-                                    k_scale=k_scale, v_scale=v_scale,
-                                    interpret=interpret)
+        fpa = (fused_paged_online_attention if fused == "online"
+               else fused_paged_attention)
+        att = fpa(q, k_pool, v_pool, table, pos0,
+                  k_scale=k_scale, v_scale=v_scale,
+                  interpret=interpret)
     else:
         kc = gather_block_kv(k_pool, table, k_scale, q.dtype)
         vc = gather_block_kv(v_pool, table, v_scale, q.dtype)

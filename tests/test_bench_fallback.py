@@ -45,6 +45,7 @@ def test_emit_fallback_labels_provenance(tmp_path, capsys):
     assert len(out) == 2
     for line in out:
         assert line["provenance"] == "builder-session"
+        assert line["onchip"] is False   # explicit: banked, not live
         assert line["measured_at"] == "2026-01-01T00:00:00+00:00"
     # emission order preserved: the headline metric stays LAST so the
     # driver's last-line parser picks it up
@@ -93,6 +94,7 @@ def test_save_fallback_roundtrip(tmp_path, capsys):
     line = json.loads(capsys.readouterr().out.strip())
     assert line["metric"] == "x_metric" and line["value"] == 1.234
     assert line["provenance"] == "builder-session"
+    assert line["onchip"] is False
 
 
 def test_probe_budget_env_bounds_retries(tmp_path, monkeypatch):
@@ -128,6 +130,7 @@ def test_cli_dead_tunnel_emits_labeled_fallback(tmp_path):
     rest = lines[1:]
     assert rest, proc.stdout
     assert all(ln.get("provenance") == "builder-session" for ln in rest)
+    assert all(ln.get("onchip") is False for ln in rest)
     assert proc.returncode == 0
 
 

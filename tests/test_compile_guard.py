@@ -89,6 +89,29 @@ def test_fused_paged_workload_compiles_o_buckets(params):
     assert len(out3) == len(PLENS)
     assert srv3._prog_misses <= 5
     assert int(c3) <= 12
+    # fp8 pools ride the SAME kv_dtype re-key budget — a new dtype
+    # value, not a new keying dimension
+    with count_compiles() as c4:
+        srv4 = ContinuousServer(params, CFG, slots=4, smax=64,
+                                prefill_chunk=8, prefill_buckets="4,8",
+                                paged=True, paged_kernel="fused",
+                                kv_dtype="fp8")
+        out4 = _workload(srv4, PLENS, seed=5)
+    assert len(out4) == len(PLENS)
+    assert srv4._prog_misses <= 5
+    assert int(c4) <= 12
+    # fused_online: paged_kernel is already a key component, so the
+    # online kernel re-keys the same <= 5 programs and rides the
+    # bucket ladder untouched
+    with count_compiles() as c5:
+        srv5 = ContinuousServer(params, CFG, slots=4, smax=64,
+                                prefill_chunk=8, prefill_buckets="4,8",
+                                paged=True,
+                                paged_kernel="fused_online")
+        out5 = _workload(srv5, PLENS, seed=5)
+    assert len(out5) == len(PLENS)
+    assert srv5._prog_misses <= 5
+    assert int(c5) <= 12
 
 
 def test_sharded_paged_workload_compiles_o_buckets(params):

@@ -69,3 +69,46 @@ def test_section_query_and_dump():
     sec = cfg.section("hpx.parcel")
     assert "port" in sec and "enable" in sec
     assert "hpx.parcel.port = 7910" in cfg.dump()
+
+
+def test_strict_mode_rejects_undeclared_keys():
+    cfg = Configuration(environ={}, strict=True)
+    with pytest.raises(BadParameter, match="undeclared"):
+        cfg.set("hpx.cache.kv_dytpe", "int8")   # transposed typo
+    with pytest.raises(BadParameter, match="undeclared"):
+        cfg.get("hpx.serving.paged_kernal")
+    # non-hpx keys are application-private, never policed
+    cfg.set("myapp.anything", "1")
+    assert Configuration(environ={}).get("hpx.nope") is None  # lax: ok
+
+
+def test_strict_mode_enforces_declared_choices():
+    """Enumerated knobs (declared with choices=) reject out-of-set
+    values at set() time with the valid set spelled out — a typo'd
+    kv_dtype fails HERE, not as a downstream serving error."""
+    cfg = Configuration(environ={}, strict=True)
+    for ok in ("bf16", "int8", "fp8"):
+        cfg.set("hpx.cache.kv_dtype", ok)
+    for ok in ("auto", "gather", "fused", "fused_online"):
+        cfg.set("hpx.serving.paged_kernel", ok)
+    with pytest.raises(BadParameter, match="bf16.*int8.*fp8"):
+        cfg.set("hpx.cache.kv_dtype", "fp8_e5m2")
+    with pytest.raises(BadParameter, match="fused_online"):
+        cfg.set("hpx.serving.paged_kernel", "online")
+    # free-form str keys stay free-form under strict
+    cfg.set("hpx.queuing", "whatever-scheduler")
+    # lax mode: choices are documentation, not enforcement
+    Configuration(environ={}).set("hpx.cache.kv_dtype", "fp8_e5m2")
+
+
+def test_declare_validates_choices():
+    from hpx_tpu.core import config_schema
+    with pytest.raises(ValueError, match="choices"):
+        config_schema.declare("hpx.test.bogus_choice_key", "str", "c",
+                              "default outside its own choices",
+                              choices=("a", "b"))
+    assert not config_schema.is_declared("hpx.test.bogus_choice_key")
+    key = config_schema.lookup("hpx.cache.kv_dtype")
+    assert key.choices == ("bf16", "int8", "fp8")
+    assert config_schema.lookup("hpx.serving.paged_kernel").choices == \
+        ("auto", "gather", "fused", "fused_online")

@@ -7,8 +7,22 @@ section comment): bitwise-equal scores and softmax, final logits within
 ~1 ulp (the PV contraction is the kernel's 2-D dot vs XLA's batched
 einsum), and therefore EXACT tokens — which the server-level tests here
 assert across dense/paged-gather/paged-fused, greedy/sampled,
-speculative/non-speculative, bf16 and int8 KV. All kernel runs use
-interpret mode off-TPU, so this file is CPU-CI green by construction.
+speculative/non-speculative, bf16 and int8 KV.
+
+`fused_paged_online_attention` (paged_kernel="fused_online") carries a
+WEAKER, tolerance-budgeted contract: the online-softmax recurrence
+renormalizes per block, so logits drift O(eps * num_blocks) from the
+oracle — a few f32 ulp at test extents — while greedy tokens stay
+identical on the acceptance sweep. Its VMEM scratch is O(block): the
+(acc, m, l) carry never allocates a sequence-extent array, which
+`paged_online_scratch_shapes` makes checkable by construction.
+
+fp8 (e4m3) KV pools reuse the int8 sidecar plumbing wholesale: same
+per-(block, kv-head) absmax scales, same `*_q` scatter OOB-drop
+semantics, same dequant-at-gather on both formulations — so fused
+vs gather stays ulp-tight under fp8 even though fp8 vs full precision
+is a lossy ~2^-4 relative grid. All kernel runs use interpret mode
+off-TPU, so this file is CPU-CI green by construction.
 """
 
 import os
@@ -133,6 +147,125 @@ def test_fused_int8_matches_gather_int8(bs):
                                rtol=2e-6, atol=2e-6)
 
 
+# -- op level: fused_online vs gather ---------------------------------------
+
+@pytest.mark.parametrize("bs", [8, 16, 32])
+def test_online_decode_matches_gather(bs):
+    """The tolerance-budgeted contract: block-streamed online softmax
+    drifts O(eps * num_blocks) from the oracle, a few f32 ulp here."""
+    kp, vp, table, pos, q, kn, vn = _paged_state(bs, maxb=3,
+                                                 seed=300 + bs)
+    ag, kg, vg = paged_decode_attention(q, kn, vn, kp, vp, table, pos)
+    ao, ko, vo = paged_decode_attention(q, kn, vn, kp, vp, table, pos,
+                                        fused="online", interpret=True)
+    assert (np.asarray(kg) == np.asarray(ko)).all()
+    assert (np.asarray(vg) == np.asarray(vo)).all()
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(ao),
+                               rtol=5e-6, atol=5e-6)
+
+
+@pytest.mark.parametrize("bs", [8, 16])
+def test_online_window_matches_gather(bs):
+    # W=4 verify window, GQA (4 q heads over 2 kv heads), ragged pos0 —
+    # the per-window-row horizon mask is shared with the bitwise kernel
+    kp, vp, table, pos, q, kn, vn = _paged_state(bs, maxb=3, w=4,
+                                                 seed=400 + bs)
+    ag, _, _ = paged_window_attention(q, kn, vn, kp, vp, table, pos)
+    ao, _, _ = paged_window_attention(q, kn, vn, kp, vp, table, pos,
+                                      fused="online", interpret=True)
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(ao),
+                               rtol=5e-6, atol=5e-6)
+
+
+@pytest.mark.parametrize("bs", [8, 16])
+def test_online_int8_matches_gather_int8(bs):
+    kp, vp, table, pos, q, kn, vn = _paged_state(bs, maxb=3,
+                                                 seed=500 + bs)
+    kq, ks = quantize_blocks(kp)
+    vq, vs = quantize_blocks(vp)
+    ag, _, _, _, _ = paged_decode_attention(
+        q, kn, vn, kq, vq, table, pos, k_scale=ks, v_scale=vs)
+    ao, _, _, _, _ = paged_decode_attention(
+        q, kn, vn, kq, vq, table, pos, k_scale=ks, v_scale=vs,
+        fused="online", interpret=True)
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(ao),
+                               rtol=5e-6, atol=5e-6)
+
+
+def test_online_scratch_is_o_block():
+    """The acceptance gate on the kernel's memory shape: the online
+    kernel's VMEM scratch is the (acc, m, l) flash carry — a function
+    of (padded q rows, head_dim) ONLY. No sequence extent reaches the
+    allocation, by signature: a refactor that reintroduces an
+    (S,)-shaped scratch has to change this function to get it."""
+    import inspect
+    sig = inspect.signature(ap.paged_online_scratch_shapes)
+    assert list(sig.parameters) == ["wg_pad", "head_dim"]
+    shapes = [tuple(s.shape)
+              for s in ap.paged_online_scratch_shapes(8, 8)]
+    assert shapes == [(8, 8), (8, 128), (8, 128)]
+    # scratch does not grow with anything sequence-like
+    assert shapes == [tuple(s.shape)
+                      for s in ap.paged_online_scratch_shapes(8, 8)]
+    big = [tuple(s.shape)
+           for s in ap.paged_online_scratch_shapes(16, 128)]
+    assert big == [(16, 128), (16, 128), (16, 128)]
+
+
+# -- fp8 pools ---------------------------------------------------------------
+
+def test_fp8_quantize_roundtrip():
+    """e4m3 blocks under the per-(block, kv-head) absmax scale: the
+    round-trip lands on the fp8 grid — relative error bounded by the
+    format's 2^-4 mantissa step, never biased past one step."""
+    rng = np.random.default_rng(9)
+    rows = jnp.asarray(rng.standard_normal((4, 16, 2, 8)), jnp.float32)
+    pq, sc = quantize_blocks(rows, jnp.float8_e4m3fn)
+    assert pq.dtype == jnp.float8_e4m3fn
+    assert sc.shape == (4, 2)                 # per-(block, kv-head)
+    deq = (np.asarray(pq, np.float32)
+           * np.asarray(sc)[:, None, :, None])
+    orig = np.asarray(rows)
+    err = np.abs(deq - orig)
+    amax = np.abs(orig).max(axis=(1, 3), keepdims=True)
+    assert (err <= np.abs(orig) * 2.0 ** -4 + amax * 2.0 ** -7).all()
+
+
+def test_quantize_blocks_rejects_unknown_dtype():
+    rows = jnp.zeros((1, 4, 1, 8), jnp.float32)
+    with pytest.raises(ValueError, match="unsupported pool dtype"):
+        quantize_blocks(rows, jnp.float16)
+
+
+@pytest.mark.parametrize("bs", [8, 16])
+def test_fused_fp8_matches_gather_fp8(bs):
+    """Both formulations see the SAME e4m3 pools and dequantize with
+    the same elementwise ops — fused vs gather stays ulp-tight even
+    though fp8 vs full precision is lossy."""
+    kp, vp, table, pos, q, kn, vn = _paged_state(bs, maxb=3,
+                                                 seed=600 + bs)
+    kq, ks = quantize_blocks(kp, jnp.float8_e4m3fn)
+    vq, vs = quantize_blocks(vp, jnp.float8_e4m3fn)
+    assert kq.dtype == jnp.float8_e4m3fn
+    ag, kg, vg, ksg, vsg = paged_decode_attention(
+        q, kn, vn, kq, vq, table, pos, k_scale=ks, v_scale=vs)
+    assert kg.dtype == jnp.float8_e4m3fn      # frontier RMW kept fp8
+    af, kf, vf, ksf, vsf = paged_decode_attention(
+        q, kn, vn, kq, vq, table, pos, k_scale=ks, v_scale=vs,
+        fused=True, interpret=True)
+    ao, _, _, _, _ = paged_decode_attention(
+        q, kn, vn, kq, vq, table, pos, k_scale=ks, v_scale=vs,
+        fused="online", interpret=True)
+    assert (np.asarray(kg, np.float32)
+            == np.asarray(kf, np.float32)).all()
+    assert (np.asarray(ksg) == np.asarray(ksf)).all()
+    assert (np.asarray(vsg) == np.asarray(vsf)).all()
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(af),
+                               rtol=2e-6, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(ag), np.asarray(ao),
+                               rtol=5e-6, atol=5e-6)
+
+
 # -- quantized scatter: OOB drop regression ---------------------------------
 
 def test_scatter_window_q_oob_drops_rows_and_scales():
@@ -161,6 +294,37 @@ def test_scatter_window_q_oob_drops_rows_and_scales():
     tol = amax / 127 + 1e-6                 # one quantization step
     # the two in-range rows hold the window's first two values; the
     # block's pre-existing rows survive the RMW requantization
+    np.testing.assert_allclose(deq[2], np.asarray(vals[0, 0]), atol=tol)
+    np.testing.assert_allclose(deq[3], np.asarray(vals[0, 1]), atol=tol)
+    np.testing.assert_allclose(deq[:2], orig[:2], atol=tol)
+
+
+def test_scatter_window_q_oob_drops_fp8_rows_and_scales():
+    """The same OOB-drop regression under fp8 pools: the sidecar
+    plumbing is shared with int8, so a clamped write corrupting the
+    frontier block (or its scale) would be a DTYPE-DISPATCH bug, not a
+    new scatter bug — pin it anyway."""
+    bs, maxb, nkv, hd = 4, 2, 2, 8
+    rng = np.random.default_rng(13)
+    base = jnp.asarray(rng.standard_normal((3, bs, nkv, hd)),
+                       jnp.float32)
+    pq, sc = quantize_blocks(base, jnp.float8_e4m3fn)
+    table = jnp.asarray([[0, 1]], jnp.int32)
+    vals = jnp.asarray(rng.standard_normal((1, 4, nkv, hd)),
+                       jnp.float32)
+    npq, nsc = scatter_window_q(pq, sc, table, jnp.asarray([6]), vals)
+    assert npq.dtype == jnp.float8_e4m3fn
+    assert (np.asarray(npq[0], np.float32)
+            == np.asarray(pq[0], np.float32)).all()
+    assert (np.asarray(npq[2], np.float32)
+            == np.asarray(pq[2], np.float32)).all()
+    assert (np.asarray(nsc[0]) == np.asarray(sc[0])).all()
+    assert (np.asarray(nsc[2]) == np.asarray(sc[2])).all()
+    deq = (np.asarray(npq[1], np.float32)
+           * np.asarray(nsc[1])[None, :, None])
+    orig = np.asarray(base[1])
+    amax = np.abs(np.asarray(vals)).max() + np.abs(orig).max()
+    tol = amax * 2.0 ** -4 + 1e-6           # one e4m3 grid step
     np.testing.assert_allclose(deq[2], np.asarray(vals[0, 0]), atol=tol)
     np.testing.assert_allclose(deq[3], np.asarray(vals[0, 1]), atol=tol)
     np.testing.assert_allclose(deq[:2], orig[:2], atol=tol)
@@ -207,6 +371,32 @@ def test_server_fused_spec_matches_nonspec(params, k):
     base, _ = _serve(params, REQS)
     spec, srv = _serve(params, REQS, paged=True, paged_kernel="fused",
                        spec=True, spec_k=k)
+    assert spec == base
+    assert srv.spec_stats()["emitted"] > 0
+
+
+@pytest.mark.parametrize("reqs", [REQS, SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_server_fused_online_matches_dense_and_gather(params, reqs):
+    """The acceptance sweep's token gate: the online kernel's few-ulp
+    logit drift never flips a token on this workload — greedy AND
+    sampled, against BOTH the dense and the paged-gather servers."""
+    dense, _ = _serve(params, reqs)
+    gather, _ = _serve(params, reqs, paged=True, paged_kernel="gather")
+    online, srv = _serve(params, reqs, paged=True,
+                         paged_kernel="fused_online")
+    assert srv._paged_kernel == "fused_online"
+    assert srv._paged_fused == "online"
+    assert online == gather == dense
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_server_fused_online_spec_matches_nonspec(params, k):
+    # spec-verify routes through the window entry point: the shared
+    # per-window-row horizon mask must hold under the online carry too
+    base, _ = _serve(params, REQS)
+    spec, srv = _serve(params, REQS, paged=True,
+                       paged_kernel="fused_online", spec=True, spec_k=k)
     assert spec == base
     assert srv.spec_stats()["emitted"] > 0
 
@@ -271,6 +461,57 @@ def test_server_int8_halves_hbm_read_bytes(params):
     assert 0.5 < ratio < 0.6
 
 
+def test_server_fp8_kernels_agree_and_quarter_hbm_read_bytes(params):
+    """The fp8 acceptance gates. Tokens: both kernels over the same
+    e4m3 pools emit IDENTICAL tokens (fp8-vs-dense is lossy and makes
+    no token claim — kernel-vs-kernel over shared pools is exact).
+    Bytes: the live hbm_read_stats() counters account fp8 blocks at
+    1 byte/elem + f32 sidecars; against this CPU run's f32 compute
+    pools that is the tentpole's <= 0.30x bytes/token (on a bf16
+    compute dtype the same pools sit at ~0.52x, like int8)."""
+    from hpx_tpu.cache.block_allocator import block_bytes
+
+    g, _ = _serve(params, REQS, paged=True, paged_kernel="gather",
+                  kv_dtype="fp8")
+    o, srv = _serve(params, REQS, paged=True,
+                    paged_kernel="fused_online", kv_dtype="fp8")
+    assert srv._kv_dtype == "fp8"
+    assert o == g
+    gs, _ = _serve(params, REQS, paged=True, paged_kernel="gather",
+                   kv_dtype="fp8", spec=True, spec_k=2)
+    os_, _ = _serve(params, REQS, paged=True,
+                    paged_kernel="fused_online", kv_dtype="fp8",
+                    spec=True, spec_k=2)
+    assert os_ == gs
+
+    nkv, hd, nl = CFG.kv_heads, CFG.head_dim, CFG.n_layers
+    stats = {}
+    for kvd in ("bf16", "fp8"):
+        srv = ContinuousServer(params, CFG, slots=2, smax=64,
+                               paged=True, kv_dtype=kvd)
+        for r in REQS[:2]:
+            srv.submit(**r)
+        while srv.step():
+            st = srv.hbm_read_stats()
+            if st["hbm_read_bytes_per_token"]:
+                stats.setdefault(kvd, (st, srv.block_size,
+                                       srv._kv_acct_dtype()))
+    for kvd in ("bf16", "fp8"):
+        st, bs, acct = stats[kvd]
+        assert st["hbm_read_blocks_per_token"] > 0
+        assert st["hbm_read_bytes_per_token"] == pytest.approx(
+            st["hbm_read_blocks_per_token"]
+            * block_bytes(bs, nkv, hd, acct, layers=nl))
+    assert stats["fp8"][2] == "fp8"
+    bs, base_acct = stats["fp8"][1], stats["bf16"][2]
+    ratio = (block_bytes(bs, nkv, hd, "fp8", layers=nl)
+             / block_bytes(bs, nkv, hd, base_acct, layers=nl))
+    if base_acct == "f32":                  # CPU CI: the 0.25x leg
+        assert ratio <= 0.30
+    else:                                   # bf16 pools: same as int8
+        assert 0.5 < ratio < 0.6
+
+
 def test_paged_kernel_knob_validation(params):
     with pytest.raises(ValueError, match="paged_kernel"):
         ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
@@ -278,9 +519,15 @@ def test_paged_kernel_knob_validation(params):
     with pytest.raises(ValueError, match="kv_dtype"):
         ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
                          kv_dtype="fp4")
+    # near-miss dtype strings fail loudly, never silently serve bf16
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
+                         kv_dtype="fp8_e5m2")
     # the knobs are paged-only
     with pytest.raises(ValueError):
         ContinuousServer(params, CFG, slots=2, smax=64,
                          paged_kernel="fused")
     with pytest.raises(ValueError):
         ContinuousServer(params, CFG, slots=2, smax=64, kv_dtype="int8")
+    with pytest.raises(ValueError):
+        ContinuousServer(params, CFG, slots=2, smax=64, kv_dtype="fp8")

@@ -1,10 +1,12 @@
 """Real-chip leg of the fused paged-attention contract (ROADMAP item
-3): the Pallas block-table kernel compiled by Mosaic must match the
+3): the Pallas block-table kernels compiled by Mosaic must match the
 XLA gather-oracle formulation ON THE SAME TPU — decode and verify
-windows, bf16 and int8 pools. tests/ covers interpret mode on CPU;
-this is the only place the actual Mosaic lowering is checked, so a
-regression fails a test instead of silently showing up as a serving
-numerics drift. Skips cleanly off-chip (see conftest)."""
+windows; bf16, int8 and fp8 (e4m3) pools; the bitwise `fused` kernel
+AND the O(block)-scratch `fused_online` online-softmax kernel. tests/
+covers interpret mode on CPU; this is the only place the actual
+Mosaic lowering (incl. the double-buffered online carry) is checked,
+so a regression fails a test instead of silently showing up as a
+serving numerics drift. Skips cleanly off-chip (see conftest)."""
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +56,9 @@ class TestFusedPagedDecode:
             )(q, kn, vn, kp, vp)
             return att
         _close(run(True), run(False), 3e-2)
+        # the online kernel's tolerance budget is O(eps * num_blocks)
+        # past the bitwise kernel's — identical bf16 tolerance here
+        _close(run("online"), run(False), 3e-2)
 
     def test_matches_gather_int8(self):
         """int8 pools + absmax scale sidecars: both paths dequantize
@@ -81,6 +86,37 @@ class TestFusedPagedDecode:
             )(q, kn, vn, kp, vp, ks, vs)
             return att
         _close(run(True), run(False), 3e-2)
+        _close(run("online"), run(False), 3e-2)
+
+    def test_matches_gather_fp8(self):
+        """fp8 (e4m3) pools + the same f32 scale sidecars: the Mosaic
+        lowering of the in-kernel float8 dequant must agree with the
+        gather formulation over the same stored bytes — both fused
+        kernels."""
+        from hpx_tpu.ops.paged_attention import (paged_decode_attention,
+                                                 quantize_blocks)
+        B, nb, bs, maxb, nkv, nq, hd = 2, 16, 32, 2, 2, 4, 64
+        kf, vf = _pools(nb, bs, nkv, hd, seed=9)
+        kp, ks = quantize_blocks(kf, jnp.float8_e4m3fn)
+        vp, vs = quantize_blocks(vf, jnp.float8_e4m3fn)
+        table = _table(B, maxb, nb, seed=10)
+        pos = jnp.asarray([44, 17], jnp.int32)
+        rng = np.random.default_rng(11)
+        q = jnp.asarray(rng.standard_normal((B, 1, nq, hd), np.float32),
+                        jnp.bfloat16)
+        kn, vn = (jnp.asarray(
+            rng.standard_normal((B, nkv, hd), np.float32), jnp.bfloat16)
+            for _ in range(2))
+
+        def run(fused):
+            att, *_ = jax.jit(
+                lambda q, kn, vn, kp, vp, ks, vs: paged_decode_attention(
+                    q, kn, vn, kp, vp, table, pos, k_scale=ks,
+                    v_scale=vs, fused=fused)
+            )(q, kn, vn, kp, vp, ks, vs)
+            return att
+        _close(run(True), run(False), 3e-2)
+        _close(run("online"), run(False), 3e-2)
 
 
 class TestFusedPagedWindow:
@@ -106,3 +142,5 @@ class TestFusedPagedWindow:
             )(q, kn, vn, kp, vp)
             return att
         _close(run(True), run(False), 3e-2)
+        # per-window-row horizon under the online (acc, m, l) carry
+        _close(run("online"), run(False), 3e-2)
