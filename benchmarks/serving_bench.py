@@ -103,10 +103,25 @@ PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
                            --xla_force_host_platform_device_count=8);
                            emits a skipped line otherwise.
 
+ 10. serving_fleet      — the fleet wave (--fleet): the SAME warm
+                           Zipf-shared-prefix Poisson mix through a
+                           FleetRouter in placement=load (pure
+                           least-loaded, the baseline) and
+                           placement=prefix (digest-scored routing +
+                           prefix-seeded prefills). Reports TTFT
+                           p50/p99, decode-stall p50/p99, placement
+                           counts by policy, and the prefill tokens
+                           each mode ACTUALLY skipped on the measured
+                           wave. GATES on: sha-identical tokens
+                           between the two modes (placement moves
+                           work, never changes it), the prefix mode
+                           saving strictly more prefill tokens than
+                           least-loaded, and zero leaked KV blocks.
+
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
                                           [--paged-decode-only] [--mesh]
-                                          [--chaos] [--disagg]
+                                          [--chaos] [--disagg] [--fleet]
                                           [--trace-out PATH]
 """
 
@@ -602,6 +617,127 @@ def main() -> int:
                 "kv_blocks_leaked": ch_leak}), flush=True)
             raise SystemExit(2)
 
+    def fleet_bench() -> None:
+        import hashlib
+        from hpx_tpu.core.config import runtime_config
+        from hpx_tpu.svc.fleet import FleetRouter
+
+        frng = np.random.default_rng(17)
+        npfx = 4
+        prefixes = [frng.integers(1, 1000, 40).tolist()
+                    for _ in range(npfx)]
+        zw = np.array([1.0 / (r + 1) for r in range(npfx)])
+        zw /= zw.sum()
+        nreq = 12
+        arrivals = np.cumsum(frng.exponential(0.05, nreq))
+        wave = []
+        for i in range(nreq):
+            pfx = prefixes[int(frng.choice(npfx, p=zw))]
+            tail = frng.integers(1, 1000,
+                                 int(frng.integers(4, 12))).tolist()
+            wave.append((pfx + tail, int(frng.integers(10, 20)),
+                         float(arrivals[i])))
+
+        def pctl(xs, q):
+            return round(float(np.percentile(xs, q)) * 1e3, 2) \
+                if xs else None
+
+        def drive(r):
+            t0 = time.perf_counter()
+            pending = list(wave)
+            stalls, live, last = [], False, t0
+            busy = None
+            while pending or busy is None or busy:
+                now = time.perf_counter() - t0
+                while pending and pending[0][2] <= now:
+                    p, m, _ = pending.pop(0)
+                    r.submit(p, m)
+                busy = r.step()
+                t = time.perf_counter()
+                if live:
+                    stalls.append(t - last)
+                live, last = bool(busy), t
+            return time.perf_counter() - t0, stalls
+
+        def run_mode(mode):
+            rc = runtime_config()
+            old = {k: rc.get(k) for k in
+                   ("hpx.serving.fleet.placement",
+                    "hpx.serving.fleet.digest_refresh_s")}
+            rc.set("hpx.serving.fleet.placement", mode)
+            rc.set("hpx.serving.fleet.digest_refresh_s", "0.01")
+            try:
+                r = FleetRouter(params, cfg, prefill_workers=2,
+                                decode_workers=2, slots=4, smax=96)
+                # two cold passes (same mix, unpaced): the first
+                # warms the decode workers' radix trees, the second
+                # takes placement hits and compiles the SEEDED
+                # prefill programs — so the measured wave is the
+                # steady Zipf state placement is for
+                for _ in range(2):
+                    for p, m, _ in wave:
+                        r.submit(p, m)
+                    r.run()
+                warm_stats = r.stats()
+                secs, stalls = drive(r)
+                out = dict(r.results)
+                st = r.stats()
+                ttft = {rid: r.ttft[rid] for rid in out
+                        if rid in r.ttft}
+                r.close()
+                leak = r.leaked_blocks()
+            finally:
+                for k, v in old.items():
+                    if v is None:
+                        rc._data.pop(k, None)
+                    else:
+                        rc.set(k, v)
+            saved = (st["prefill_tokens_saved"]
+                     - warm_stats["prefill_tokens_saved"])
+            placed = {"prefix": st["placed_prefix"]
+                      - warm_stats["placed_prefix"],
+                      "load": st["placed_load"]
+                      - warm_stats["placed_load"]}
+            return out, ttft, secs, stalls, placed, saved, leak
+
+        def sha(out):
+            return hashlib.sha256(json.dumps(
+                [out[r] for r in sorted(out)]).encode()).hexdigest()
+
+        results = {}
+        for mode in ("load", "prefix"):
+            out, ttft, secs, stalls, placed, saved, leak = \
+                run_mode(mode)
+            results[mode] = (out, saved, leak)
+            ts = sorted(ttft.values())
+            emit(f"serving_fleet_{mode}",
+                 sum(len(t) for t in out.values()), secs,
+                 mix=f"{nreq} reqs, {npfx} Zipf prefixes, "
+                     "Poisson 50ms, warm caches",
+                 workers="2 prefill + 2 decode",
+                 placement=placed,
+                 prefill_tokens_saved=saved,
+                 ttft_p50_ms=pctl(ts, 50),
+                 ttft_p99_ms=pctl(ts, 99),
+                 decode_stall_p50_ms=pctl(stalls, 50),
+                 decode_stall_p99_ms=pctl(stalls, 99),
+                 kv_blocks_leaked=leak,
+                 output_sha=sha(out)[:16])
+        (lo, lo_saved, lo_leak) = results["load"]
+        (pf, pf_saved, pf_leak) = results["prefix"]
+        if (sha(lo) != sha(pf) or pf_saved <= lo_saved
+                or lo_leak != 0 or pf_leak != 0):
+            print(json.dumps({
+                "error": "fleet gate failed",
+                "load_sha": sha(lo)[:16],
+                "prefix_sha": sha(pf)[:16],
+                "prefill_tokens_saved": {"load": lo_saved,
+                                         "prefix": pf_saved},
+                "kv_blocks_leaked": {"load": lo_leak,
+                                     "prefix": pf_leak}}),
+                flush=True)
+            raise SystemExit(2)
+
     def finish() -> int:
         if tracer is not None:
             from hpx_tpu.svc import tracing
@@ -632,6 +768,10 @@ def main() -> int:
 
     if "--disagg" in sys.argv:
         disagg_bench("--chaos" in sys.argv)
+        return finish()
+
+    if "--fleet" in sys.argv:
+        fleet_bench()
         return finish()
 
     if "--chaos" in sys.argv:

@@ -9,9 +9,9 @@ the `hpx.cache.*` config keys (`core/config.py`).
 """
 
 from .block_allocator import BlockAllocator, CacheOOM
-from .counters import register_server
+from .counters import register_fleet, register_server
 from .page_table import PageTable, materialize
-from .radix import RadixCache
+from .radix import RadixCache, prefix_hashes
 
 __all__ = [
     "BlockAllocator",
@@ -19,5 +19,7 @@ __all__ = [
     "PageTable",
     "RadixCache",
     "materialize",
+    "prefix_hashes",
+    "register_fleet",
     "register_server",
 ]

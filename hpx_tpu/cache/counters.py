@@ -55,11 +55,13 @@ from typing import Dict, List, Tuple
 from ..svc import performance_counters as pc
 from ..synchronization import Mutex
 
-__all__ = ["register_server"]
+__all__ = ["register_fleet", "register_server"]
 
 _lock = Mutex()
 _servers: Dict[int, Tuple["weakref.ref", List[str]]] = {}
 _next_idx = 0
+_fleets: Dict[int, Tuple["weakref.ref", List[str]]] = {}
+_next_fleet_idx = 0
 
 
 def _read(ref, fn):
@@ -167,6 +169,60 @@ def register_server(srv) -> str:
     return inst
 
 
+def register_fleet(rt) -> str:
+    """Register one FleetRouter's ``/serving{...}/fleet/*`` counters;
+    returns its instance name (``fleet#<i>``). Called from
+    svc/fleet.FleetRouter.__init__, same weakref discipline as
+    :func:`register_server` — a collected router reads 0 and its
+    names GC out of discovery.
+
+    Per-worker queue-depth counters register up to the AUTOSCALE
+    CEILING (``fleet/worker#k/queue-depth``): an index past the
+    current pool reads 0, so scale-up/-down changes values, never the
+    counter namespace (discovery stays stable across a wave)."""
+    global _next_fleet_idx
+    with _lock:
+        idx = _next_fleet_idx
+        _next_fleet_idx += 1
+    inst = f"fleet#{idx}"
+    ref = weakref.ref(rt)
+    names: List[str] = []
+
+    def put(counter: str, c: pc.Counter) -> None:
+        name = pc.counter_name("serving", counter, inst)
+        pc.register_counter(name, c)
+        names.append(name)
+
+    put("fleet/placed/prefix",
+        pc.CallbackCounter(_read(ref, lambda r: r._placed_prefix)))
+    put("fleet/placed/load",
+        pc.CallbackCounter(_read(ref, lambda r: r._placed_load)))
+    put("fleet/digest/staleness-s",
+        pc.CallbackCounter(_read(ref,
+                                 lambda r: r.digest_staleness_s())))
+    put("fleet/autoscale/up",
+        pc.CallbackCounter(_read(ref, lambda r: r._autoscale_up)))
+    put("fleet/autoscale/down",
+        pc.CallbackCounter(_read(ref, lambda r: r._autoscale_down)))
+    put("fleet/prefill-tokens/saved",
+        pc.CallbackCounter(_read(ref,
+                                 lambda r: r.prefill_tokens_saved)))
+    put("fleet/workers/decode",
+        pc.CallbackCounter(_read(ref,
+                                 lambda r: len(r._alive(r._decode)))))
+    put("fleet/queue/depth",
+        pc.CallbackCounter(_read(ref, lambda r: (len(r._qi)
+                                                 + len(r._qb)))))
+    for k in range(int(rt._pool_max)):
+        put(f"fleet/worker#{k}/queue-depth",
+            pc.CallbackCounter(_read(
+                ref, lambda r, k=k: r.worker_queue_depth(k))))
+
+    with _lock:
+        _fleets[idx] = (ref, names)
+    return inst
+
+
 def _refresh() -> None:
     """Refresh hook: unregister the counters of collected servers (the
     reverse of the builtins' lazily-appearing pools — servers lazily
@@ -176,7 +232,11 @@ def _refresh() -> None:
                 if ref() is None]
         for i, _ in dead:
             del _servers[i]
-    for _, names in dead:
+        dead_fleets = [(i, names) for i, (ref, names)
+                       in _fleets.items() if ref() is None]
+        for i, _ in dead_fleets:
+            del _fleets[i]
+    for _, names in dead + dead_fleets:
         for n in names:
             pc.unregister_counter(n)
 

@@ -23,13 +23,40 @@ than wall time.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..svc import tracing
 from ..synchronization import Mutex
 from .block_allocator import BlockAllocator
 
-__all__ = ["RadixCache"]
+__all__ = ["RadixCache", "prefix_hashes"]
+
+
+def _chunk_bytes(chunk: Sequence[int]) -> bytes:
+    return b"".join(int(t).to_bytes(8, "little", signed=True)
+                    for t in chunk)
+
+
+def _chain(parent: bytes, chunk: Sequence[int]) -> bytes:
+    return hashlib.blake2b(parent + _chunk_bytes(chunk),
+                           digest_size=8).digest()
+
+
+def prefix_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+    """The router-side mirror of :meth:`RadixCache.prefix_digest`: one
+    64-bit chain hash per whole-block prefix of `tokens` — entry ``i``
+    fingerprints ``tokens[:(i+1)*block_size]``. A worker whose digest
+    contains entry ``i`` retains that ENTIRE prefix (chain hashing
+    makes a match positional, not positional-chunk-coincidental), so
+    the longest matching entry is the worker's cached-prefix depth for
+    this prompt."""
+    out: List[int] = []
+    parent = b""
+    for s in range(0, len(tokens) - block_size + 1, block_size):
+        parent = _chain(parent, tokens[s:s + block_size])
+        out.append(int.from_bytes(parent, "little"))
+    return out
 
 
 class _Node:
@@ -153,6 +180,34 @@ class RadixCache:
                 tail = ()
                 node = best
             return [int(t) for t in out[:k]]
+
+    def prefix_digest(self, max_entries: int = 64) -> List[int]:
+        """Cheap placement fingerprint: the chain hash of every
+        retained prefix (one 64-bit int per node — the blake2b of the
+        parent's chain hash plus this node's block of tokens),
+        MRU-first and truncated to `max_entries`.
+
+        A fleet router compares these against
+        :func:`prefix_hashes`(prompt) to score how deep each worker's
+        tree covers a prompt WITHOUT shipping token lists around: the
+        digest is O(entries) ints, refreshes on a knob-set interval,
+        and staleness only mis-scores placement — never correctness
+        (admission re-matches the real tree). Truncation drops the
+        LRU tail first, which is exactly the part eviction takes
+        next."""
+        with self._lock:
+            ranked: List[Tuple[int, int]] = []
+            stack: List[Tuple[_Node, bytes]] = [(self._root, b"")]
+            while stack:
+                node, parent = stack.pop()
+                if node is not self._root:
+                    parent = _chain(parent, node.key)
+                    ranked.append((node.last_used,
+                                   int.from_bytes(parent, "little")))
+                stack.extend((c, parent)
+                             for c in node.children.values())
+            ranked.sort(key=lambda e: -e[0])
+            return [h for _, h in ranked[:max(0, int(max_entries))]]
 
     def insert(self, tokens: Sequence[int],
                block_ids: Sequence[int]) -> int:

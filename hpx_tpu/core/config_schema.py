@@ -264,6 +264,31 @@ declare("hpx.serving.mesh.paged", "bool", "1",
         "sharded paged serving (0 restores the single-device refusal)")
 declare("hpx.serving.mesh.table_residency", "str", "sharded",
         "device block-table placement on mesh: sharded | replicated")
+declare("hpx.serving.fleet.prefill_workers", "int", "2",
+        "fleet: prefill workers stood up by default")
+declare("hpx.serving.fleet.decode_workers", "int", "2",
+        "fleet: decode workers stood up at construction")
+declare("hpx.serving.fleet.decode_pool_min", "int", "1",
+        "fleet: autoscale floor on decode workers")
+declare("hpx.serving.fleet.decode_pool_max", "int", "4",
+        "fleet: autoscale ceiling on decode workers")
+declare("hpx.serving.fleet.digest_entries", "int", "64",
+        "fleet: prefix-digest entries pulled per decode worker")
+declare("hpx.serving.fleet.digest_refresh_s", "float", "0.25",
+        "fleet: seconds a pulled prefix digest stays fresh")
+declare("hpx.serving.fleet.placement", "str", "prefix",
+        "fleet decode placement policy", choices=("prefix", "load"))
+declare("hpx.serving.fleet.w_prefix", "float", "1.0",
+        "fleet placement: score weight per digest-matched block")
+declare("hpx.serving.fleet.w_pressure", "float", "0.05",
+        "fleet placement: score penalty per eviction/s of pressure")
+declare("hpx.serving.fleet.scale_high", "int", "8",
+        "fleet autoscale: queue depth that spins a decode worker up")
+declare("hpx.serving.fleet.scale_low", "int", "0",
+        "fleet autoscale: queue depth that drains a decode worker")
+declare("hpx.serving.fleet.idle_ticks", "int", "16",
+        "fleet autoscale: consecutive idle router ticks before an "
+        "idle decode worker drains")
 
 # -- fault injection --------------------------------------------------------
 declare("hpx.fault.enable", "bool", "0", "svc/faultinject master switch")

@@ -211,9 +211,13 @@ class DecodeWorker:
     router-global request ids and local server rids."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int = 4,
-                 smax: int = 512, **server_kwargs) -> None:
+                 smax: int = 512, mesh=None, **server_kwargs) -> None:
+        # `mesh=` mirrors ContinuousServer(mesh=...) exactly: None is
+        # the single-device paged server, a (dp, tp) Mesh runs decode
+        # + verify under shard_map (PR 10's sharded paged serving) —
+        # one constructor for both, so a fleet mixes them freely
         self.srv = ContinuousServer(params, cfg, slots=slots,
-                                    smax=smax, paged=True,
+                                    smax=smax, paged=True, mesh=mesh,
                                     **server_kwargs)
         self.recv = TransferReceiver()
         self._local_of: Dict[str, int] = {}
@@ -221,6 +225,28 @@ class DecodeWorker:
 
     def block_size(self) -> int:
         return self.srv.block_size
+
+    def prefix_digest(self, max_entries: int = 64) -> Dict[str, Any]:
+        """Placement fingerprint for fleet routing: the radix tree's
+        chain-hash digest (cache/radix.prefix_digest) plus the
+        pressure signals the router folds into its score. Cheap by
+        construction — O(entries) ints, no token lists, no leases."""
+        srv = self.srv
+        return {
+            "hashes": srv._radix.prefix_digest(max_entries),
+            "evictions": int(srv._radix.total_evictions),
+            "blocks_held": int(srv._radix.blocks_held),
+            "blocks_free": int(srv._alloc.free_count),
+        }
+
+    def fetch_prefix(self, prompt: List[int]) -> Dict[str, Any]:
+        """Export this worker's longest cached whole-block prefix of
+        `prompt` as raw host rows (ContinuousServer.
+        export_prefix_rows) — the fleet router frames them as retained
+        KV segments and seeds the prefill worker's scratch, so only
+        the suffix recomputes."""
+        matched, rows = self.srv.export_prefix_rows(prompt)
+        return {"matched": matched, "rows": rows}
 
     def ingest(self, seg: KVSegment) -> Dict[str, Any]:
         return self.recv.ingest(seg)
@@ -312,6 +338,11 @@ class WorkerHandle:
     role: str
     locality: int
     alive: bool
+    # autoscale drain flag (svc/fleet): a draining worker finishes or
+    # hands off what it owns but takes no NEW placements; the base
+    # router only ever reads it (class default keeps plain disagg
+    # topologies oblivious)
+    draining: bool = False
 
     def call(self, method: str, *args: Any, **kwargs: Any) -> Any:
         raise NotImplementedError
@@ -452,6 +483,7 @@ class DisaggRouter:
     def __init__(self, params, cfg: TransformerConfig,
                  prefill_workers: int = 1, decode_workers: int = 1, *,
                  slots: int = 4, smax: int = 512,
+                 decode_mesh=None,
                  prefill_handles: Optional[List[WorkerHandle]] = None,
                  decode_handles: Optional[List[WorkerHandle]] = None,
                  server_kwargs: Optional[dict] = None) -> None:
@@ -459,6 +491,7 @@ class DisaggRouter:
         rc = runtime_config()
         self.params, self.cfg = params, cfg
         self.slots, self.smax = slots, smax
+        self.decode_mesh = decode_mesh
         self._srv_kwargs = dict(server_kwargs or {})
         self.max_queue = rc.get_int("hpx.serving.disagg.max_queue", 64)
         self._pump_steps = max(1, rc.get_int(
@@ -469,27 +502,28 @@ class DisaggRouter:
             "hpx.serving.disagg.xfer_retries", 4))
         if decode_handles is None:
             decode_handles = [
-                InProcHandle("decode", DecodeWorker(
-                    params, cfg, slots=slots, smax=smax,
-                    **self._srv_kwargs), locality=0)
+                InProcHandle("decode", self._make_decode_worker(),
+                             locality=0)
                 for _ in range(decode_workers)]
         self._decode = list(decode_handles)
         self.failovers = {"prefill": 0, "decode": 0}
+        # prefill segments (and placement prefix hashes) must be
+        # block-aligned to the DECODE pool's grid; a decode worker
+        # already dead at construction just fails over to the next
+        # for the query
+        bs = None
+        for h in self._decode:
+            try:
+                bs = int(h.call("block_size"))
+                break
+            except (NetworkError, FutureError):
+                h.alive = False
+                self.failovers["decode"] += 1
+        if bs is None:
+            bs = 16   # every decode worker dead: the first step
+                      # degrades to colocated; bs is moot
+        self._block_size = bs
         if prefill_handles is None:
-            # prefill segments must be block-aligned to the DECODE
-            # pool's grid; a decode worker already dead at construction
-            # just fails over to the next for the query
-            bs = None
-            for h in self._decode:
-                try:
-                    bs = int(h.call("block_size"))
-                    break
-                except (NetworkError, FutureError):
-                    h.alive = False
-                    self.failovers["decode"] += 1
-            if bs is None:
-                bs = 16   # every decode worker dead: the first step
-                          # degrades to colocated; bs is moot
             prefill_handles = [
                 InProcHandle("prefill", PrefillWorker(
                     params, cfg, smax=smax, block_size=bs),
@@ -597,16 +631,55 @@ class DisaggRouter:
     def _alive(self, handles: List[WorkerHandle]) -> List[WorkerHandle]:
         return [h for h in handles if h.alive]
 
-    def _least_loaded_decode(self) -> WorkerHandle:
-        alive = self._alive(self._decode)
-        load = {id(h): 0 for h in alive}
+    def _make_decode_worker(self) -> DecodeWorker:
+        """Mint one decode worker on this router's construction recipe
+        — the default-handle path AND the fleet autoscaler both come
+        through here, so scaled-up workers are indistinguishable from
+        constructed ones (same mesh, same kwargs, same program-cache
+        keys)."""
+        return DecodeWorker(self.params, self.cfg, slots=self.slots,
+                            smax=self.smax, mesh=self.decode_mesh,
+                            **self._srv_kwargs)
+
+    def _decode_load(self) -> Dict[int, int]:
+        """In-flight requests per decode handle (by id) — the shared
+        currency of every placement policy here and in svc/fleet."""
+        load = {id(h): 0 for h in self._decode}
         for r in self._reqs.values():
             if (r.state in ("prefill", "decode")
                     and r.decode_h is not None
                     and id(r.decode_h) in load):
                 load[id(r.decode_h)] += 1
-        return min(alive, key=lambda h: (load[id(h)],
+        return load
+
+    def _placeable_decode(self) -> List[WorkerHandle]:
+        """Candidates for NEW placements: alive and not draining. A
+        fleet drain empties the pool's tail, never the whole pool, but
+        failover must still find a home if it somehow does — fall back
+        to anything alive rather than strand a request."""
+        alive = self._alive(self._decode)
+        return [h for h in alive if not h.draining] or alive
+
+    def _least_loaded_decode(self) -> WorkerHandle:
+        cands = self._placeable_decode()
+        load = self._decode_load()
+        return min(cands, key=lambda h: (load[id(h)],
                                          self._decode.index(h)))
+
+    def _place_decode(self, req: _RouterReq) -> WorkerHandle:
+        """Pick the decode worker for one request. The base policy is
+        least-loaded; svc/fleet overrides this with prefix-cache-aware
+        scoring. Called with the request still QUEUED (a worker death
+        inside placement re-places on a later tick)."""
+        return self._least_loaded_decode()
+
+    def _start_prefill_job(self, req: _RouterReq,
+                           h: WorkerHandle) -> None:
+        """Open the prefill job on `h` — the one cross-worker send of
+        dispatch. svc/fleet overrides this to seed the job with the
+        placed decode worker's cached prefix rows first."""
+        self._call(h, "start", req.grid, req.prompt,
+                   req.temperature, req.key)
 
     def _dispatch_prefills(self) -> None:
         alive = self._alive(self._prefill)
@@ -626,9 +699,8 @@ class DisaggRouter:
             q = self._qi if self._qi else self._qb
             req = self._reqs[q[0]]     # peek: a death during start
             req.prefill_h = h          # must leave the rid queued for
-            req.decode_h = self._least_loaded_decode()  # re-dispatch
-            self._call(h, "start", req.grid, req.prompt,
-                       req.temperature, req.key)
+            req.decode_h = self._place_decode(req)      # re-dispatch
+            self._start_prefill_job(req, h)
             q.popleft()
             req.state = "prefill"
             jobs[id(h)] += 1
@@ -747,7 +819,7 @@ class DisaggRouter:
         already running, re-admit — the survivor replays the whole
         decode from the transferred KV, deterministically emitting the
         tokens the dead worker lost."""
-        req.decode_h = self._least_loaded_decode()
+        req.decode_h = self._place_decode(req)
         for seg in req.segments:
             self._ship(req, seg)
         if req.state == "decode":

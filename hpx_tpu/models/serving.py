@@ -1669,6 +1669,54 @@ class ContinuousServer:
             xfer_rows=rows, xfer_seed=int(seed_token)))
         return rid
 
+    def export_prefix_rows(self, tokens):
+        """The other direction of :meth:`admit_prefilled`: the longest
+        radix-cached whole-block prefix of `tokens`, exported as raw
+        compute-dtype host rows ``[n_layers, 2, matched, n_kv,
+        head_dim]`` (the exact layout a prefill worker's scratch
+        seeds from and a KV segment frames). Returns ``(matched,
+        rows)`` — ``(0, None)`` on a cold tree.
+
+        This is what lets a fleet router turn a placement HIT into a
+        prefill SAVING: the rows a retired request published here get
+        pulled once, shipped as ordinary retained segments, and the
+        prefill worker computes only the suffix. Quantized pools
+        dequantize through the same elementwise ops the fused kernels
+        apply ((q * scale).astype(dtype)), so bf16/f32 pools roundtrip
+        bit-exactly; int8/fp8 exports carry the pool's quantization —
+        same contract as colocated prefix reuse on those pools. The
+        match's block leases drop before returning (the caller gets
+        BYTES, not references — nothing here can leak pool blocks)."""
+        if not self.paged:
+            raise ValueError("export_prefix_rows() requires paged=True")
+        matched, bids = self._radix.match(tokens)
+        if not matched:
+            return 0, None
+        try:
+            nkv, hd = self.cfg.kv_heads, self.cfg.head_dim
+            idx = jnp.asarray(bids, jnp.int32)
+            layers = []
+            for li, (kp, vp) in enumerate(self._pools):
+                sides = []
+                for side, pool in enumerate((kp, vp)):
+                    # hpxlint: disable-next=HPX010 — host-side export
+                    # of a few matched blocks (once per fleet
+                    # placement hit), not the decode attention loop
+                    g = pool[idx]                 # [nblk, bs, nkv, hd]
+                    if self._scales is not None:
+                        sc = self._scales[li][side][idx]
+                        g = (g.astype(jnp.float32)
+                             * sc[:, None, :, None])
+                    g = g.astype(self.cfg.dtype)
+                    sides.append(np.asarray(g).reshape(
+                        matched, nkv, hd))
+                layers.append(np.stack(sides))
+            rows = np.stack(layers)
+        finally:
+            for bid in bids:
+                self._alloc.decref(bid)
+        return matched, rows
+
     def shutdown(self) -> None:
         """Close the intake: every later submit() raises
         ServerClosedError. Queued and in-flight requests are NOT

@@ -852,6 +852,75 @@ def backward():
 
 
 # ---------------------------------------------------------------------------
+# HPX012/HPX013 coverage over the fleet module's shapes
+# ---------------------------------------------------------------------------
+
+HPX012_FLEET_BAD = """\
+from hpx_tpu.dist.actions import async_action
+
+class Router:
+    def _digest(self, loc):
+        # the placement-loop digest pull: a hung worker must not
+        # wedge the router, so a bare get() is exactly the bug
+        return async_action("prefix_digest", loc, 64).get()
+"""
+
+HPX012_FLEET_GOOD = """\
+from hpx_tpu.dist.actions import async_action
+
+class Router:
+    def _digest(self, loc):
+        return async_action("prefix_digest", loc, 64).get(0.25)
+"""
+
+
+def test_hpx012_flags_fleet_style_digest_pull():
+    fs = findings(HPX012_FLEET_BAD, path="hpx_tpu/svc/fleet_fx.py")
+    assert rules_of(fs) == ["HPX012"]
+    assert findings(HPX012_FLEET_GOOD,
+                    path="hpx_tpu/svc/fleet_fx.py") == []
+
+
+def test_hpx013_fleet_instance_lock_inversion_fires():
+    # fleet-shaped: the router's bookkeeping lock (an instance-attr
+    # Mutex, like FleetRouter._fl_lock) inverted against a worker
+    # module's lock must still be a whole-tree lock identity
+    src = """\
+from hpx_tpu.synchronization import Mutex
+
+class Router:
+    def __init__(self):
+        self._fl_lock = Mutex()
+        self._pool_lock = Mutex()
+
+    def place(self):
+        with self._fl_lock:
+            with self._pool_lock:
+                pass
+
+    def retire(self):
+        with self._pool_lock:
+            with self._fl_lock:
+                pass
+"""
+    res = lint_sources({"hpx_tpu/svc/fleet_fx.py": src},
+                       rules=all_rules(["HPX013"]))
+    assert rules_of(res.findings) == ["HPX013"]
+
+
+def test_project_index_has_fleet_router_lock():
+    # the real tree: HPX013's index must see svc/fleet's bookkeeping
+    # lock, so fleet code is inside the lock-order contract
+    from hpx_tpu.analysis.engine import FileContext
+    from hpx_tpu.analysis.project import ProjectIndex
+    path = os.path.join(REPO, "hpx_tpu", "svc", "fleet.py")
+    with open(path) as fh:
+        ctx = FileContext(fh.read(), "hpx_tpu/svc/fleet.py")
+    index = ProjectIndex([ctx])
+    assert "hpx_tpu.svc.fleet.FleetRouter._fl_lock" in index.locks
+
+
+# ---------------------------------------------------------------------------
 # HPX014 — config keys must be declared in core/config_schema.py
 # ---------------------------------------------------------------------------
 

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from hpx_tpu.cache import (BlockAllocator, CacheOOM, PageTable,
-                           RadixCache, materialize)
+                           RadixCache, materialize, prefix_hashes)
 
 
 # -- BlockAllocator ----------------------------------------------------------
@@ -215,6 +215,50 @@ def test_oom_evict_retry_loop():
         a.alloc()
     assert r.evict(1) == 1
     a.alloc()                                # retry succeeds
+
+
+def test_prefix_digest_mirrors_prefix_hashes():
+    # the fleet-placement contract: a retained chain's digest entries
+    # are exactly the prompt-side chain hashes of its whole-block
+    # prefixes, so longest-match scoring needs no token lists
+    a = BlockAllocator(8, 4)
+    r = RadixCache(a)
+    toks = list(range(9))                    # 2 full blocks + tail
+    r.insert(toks, _chain(a, 2))
+    hs = prefix_hashes(toks, 4)
+    assert len(hs) == 2
+    assert set(r.prefix_digest()) == set(hs)
+    # a different chain that shares block 0's TOKENS at a different
+    # depth must not alias: chain hashing is positional
+    other = [9, 9, 9, 9] + toks[:4]
+    r.insert(other, [_chain(a, 1)[0], a.alloc()])
+    dg = set(r.prefix_digest())
+    assert prefix_hashes(other, 4)[1] in dg
+    assert prefix_hashes(toks[:4], 4)[0] in dg
+    # same token block, different depth -> different chain hash
+    assert prefix_hashes(other, 4)[1] != prefix_hashes(toks[:4], 4)[0]
+
+
+def test_prefix_digest_truncates_mru_first():
+    a = BlockAllocator(16, 4)
+    r = RadixCache(a)
+    cold = [50, 51, 52, 53]
+    hot = [60, 61, 62, 63]
+    r.insert(cold, _chain(a, 1))
+    r.insert(hot, _chain(a, 1))
+    r.match(hot)                             # touch: hot is MRU
+    dg = r.prefix_digest(max_entries=1)
+    assert dg == [prefix_hashes(hot, 4)[0]]
+    # takes no leases and mutates nothing
+    assert r.prefix_digest() and r.blocks_held == 2
+    assert r.prefix_digest(max_entries=0) == []
+
+
+def test_prefix_hashes_short_and_ragged():
+    assert prefix_hashes([1, 2, 3], 4) == []
+    one = prefix_hashes([1, 2, 3, 4], 4)
+    assert len(one) == 1
+    assert prefix_hashes([1, 2, 3, 4, 9], 4) == one  # tail ignored
 
 
 def test_match_updates_hit_rate():
