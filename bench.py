@@ -700,9 +700,30 @@ def _bench_main() -> None:
               f"{doc['otherData']['dropped_events']} dropped)",
               file=sys.stderr)
 
+    # --metrics-out rides the same env channel as --trace-out: dump
+    # the registered-counter plane (histograms as mergeable snapshots)
+    # as a hpx_tpu.metrics.v1 artifact at the end of the child run.
+    metrics_out = os.environ.get(_METRICS_ENV)
+    if metrics_out:
+        from hpx_tpu.svc import metrics as svc_metrics
+        reg = svc_metrics.registry_snapshot("*")
+        doc = {"schema": "hpx_tpu.metrics.v1",
+               "histograms": {n: {"snapshot": s}
+                              for n, s in reg["histograms"].items()},
+               "counters": reg["counters"]}
+        tmp = f"{metrics_out}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, metrics_out)
+        print(f"# metrics written: {metrics_out} "
+              f"({len(doc['counters'])} counters, "
+              f"{len(doc['histograms'])} histograms)",
+              file=sys.stderr)
+
 
 _CHILD_ENV = "_HPX_BENCH_CHILD"
 _TRACE_ENV = "_HPX_BENCH_TRACE_OUT"
+_METRICS_ENV = "_HPX_BENCH_METRICS_OUT"
 
 
 def main() -> None:
@@ -711,6 +732,9 @@ def main() -> None:
     if "--trace-out" in sys.argv:
         os.environ[_TRACE_ENV] = os.path.abspath(
             sys.argv[sys.argv.index("--trace-out") + 1])
+    if "--metrics-out" in sys.argv:
+        os.environ[_METRICS_ENV] = os.path.abspath(
+            sys.argv[sys.argv.index("--metrics-out") + 1])
     if os.environ.get(_CHILD_ENV) == "1":
         return _bench_main()
 

@@ -123,6 +123,16 @@ Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--paged-decode-only] [--mesh]
                                           [--chaos] [--disagg] [--fleet]
                                           [--trace-out PATH]
+                                          [--metrics-out PATH]
+
+With --metrics-out PATH the waves' live HistogramCounters (TTFT,
+queue wait, KV transfer, decode stall, E2E — merged across workers
+for disagg/fleet) are written as a hpx_tpu.metrics.v1 JSON artifact:
+full mergeable snapshots plus derived p50/p95/p99.  When --trace-out
+and --fleet combine, the router tracer and every worker's private
+span ring are stitched by trace_export.merge_traces into ONE Perfetto
+trace — per-worker pid rows, clock-aligned, with rid flow arrows
+place → prefill → transfer → decode across processes.
 """
 
 import json
@@ -132,6 +142,38 @@ import time
 
 sys.path.insert(0, os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
+
+# --metrics-out artifact schema; tests/test_metrics.py smoke-checks it
+METRICS_SCHEMA = "hpx_tpu.metrics.v1"
+
+
+def metrics_artifact(histograms, counters=None,
+                     quantiles=(0.5, 0.95, 0.99)):
+    """JSON-safe SLO artifact from LIVE HistogramCounters: each
+    histogram's full mergeable snapshot plus its derived quantiles
+    (bounded-relative-error estimates, not a post-hoc sort of raw
+    samples)."""
+    hists = {}
+    for name in sorted(histograms):
+        h = histograms[name]
+        hists[name] = {
+            "snapshot": h.snapshot(),
+            "quantiles": {f"p{round(q * 100.0, 4):g}": h.quantile(q)
+                          for q in quantiles},
+            "relative_error_bound": h.relative_error_bound(),
+        }
+    return {"schema": METRICS_SCHEMA, "histograms": hists,
+            "counters": dict(counters or {})}
+
+
+def write_metrics_artifact(path, doc):
+    """Atomic write (tmp + rename) so a watcher never reads a torn
+    artifact."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return doc
 
 
 def main() -> int:
@@ -155,6 +197,15 @@ def main() -> int:
         from hpx_tpu.svc import tracing
         runtime_config().set("hpx.trace.enabled", "1")
         tracer = tracing.start_if_configured()
+
+    metrics_out = sys.argv[sys.argv.index("--metrics-out") + 1] \
+        if "--metrics-out" in sys.argv else None
+    # live HistogramCounters the waves hand to finish() for the
+    # --metrics-out artifact, keyed "<bench>/<metric>"
+    collected_hists = {}
+    # (label, chrome-doc) pairs from the fleet wave's worker rings —
+    # finish() stitches them with the router tracer into ONE trace
+    fleet_trace_docs = []
 
     d = 64 * scale
     cfg = tfm.TransformerConfig(
@@ -537,7 +588,7 @@ def main() -> int:
                 lambda p, m, slo: srv.submit(p, max_new=m),
                 srv.step, None)
             out = dict(srv._done)
-            return out, dict(srv.ttft), secs, stalls
+            return out, dict(srv.ttft), secs, stalls, srv.hist
 
         def run_disagg(fi=None):
             if fi is not None:
@@ -550,29 +601,34 @@ def main() -> int:
                     r.step, None)
                 out = dict(r.results)
                 st = r.stats()
+                hists = r.merged_hist()
                 r.close()
                 leak = r.leaked_blocks()
             finally:
                 if fi is not None:
                     faultinject.uninstall()
-            return out, dict(r.ttft), secs, stalls, st, leak
+            return out, dict(r.ttft), secs, stalls, st, leak, hists
 
         def sha(out):
             return hashlib.sha256(json.dumps(
                 [out[r] for r in sorted(out)]).encode()).hexdigest()
 
+        def hq(h, q):
+            return round(h.quantile(q) * 1e3, 2)
+
         run_colocated()                                # compile
         run_disagg()                                   # compile
-        co_out, co_ttft, co_secs, co_stalls = run_colocated()
-        dg_out, dg_ttft, dg_secs, dg_stalls, dg_st, dg_leak = \
-            run_disagg()
-        for name, out, ttft, secs, stalls, extra in (
+        co_out, co_ttft, co_secs, co_stalls, co_hist = run_colocated()
+        dg_out, dg_ttft, dg_secs, dg_stalls, dg_st, dg_leak, \
+            dg_hist = run_disagg()
+        for name, out, ttft, secs, stalls, hists, extra in (
                 ("serving_colocated", co_out, co_ttft, co_secs,
-                 co_stalls, {}),
+                 co_stalls, co_hist, {}),
                 ("serving_disagg", dg_out, dg_ttft, dg_secs,
-                 dg_stalls, {"workers": "2 prefill + 2 decode",
-                             "failovers": dg_st["failovers"],
-                             "kv_blocks_leaked": dg_leak})):
+                 dg_stalls, dg_hist,
+                 {"workers": "2 prefill + 2 decode",
+                  "failovers": dg_st["failovers"],
+                  "kv_blocks_leaked": dg_leak})):
             goodput = sum(len(t) for t in out.values())
             ts = sorted(ttft.values())
             line = {"mix": f"{nreq} reqs, {npfx} Zipf prefixes, "
@@ -581,9 +637,19 @@ def main() -> int:
                     "ttft_p95_ms": pctl(ts, 95),
                     "ttft_p99_ms": pctl(ts, 99),
                     "decode_stall_p50_ms": pctl(stalls, 50),
-                    "decode_stall_p99_ms": pctl(stalls, 99)}
+                    "decode_stall_p99_ms": pctl(stalls, 99),
+                    # live-histogram view (svc/metrics, merged across
+                    # workers for disagg) of the same SLOs
+                    "slo_hist_ms": {
+                        k: {"p50": hq(hists[k], 0.5),
+                            "p95": hq(hists[k], 0.95),
+                            "p99": hq(hists[k], 0.99)}
+                        for k in ("ttft", "queue_wait",
+                                  "decode_stall")}}
             line.update(extra)
             emit(name, goodput, secs, **line)
+            for k, h in hists.items():
+                collected_hists[f"{name}/{k}"] = h
         if co_out != {r: t for r, t in dg_out.items()}:
             print(json.dumps({"error": "disagg diverged from "
                               "colocated"}), flush=True)
@@ -620,6 +686,7 @@ def main() -> int:
     def fleet_bench() -> None:
         import hashlib
         from hpx_tpu.core.config import runtime_config
+        from hpx_tpu.svc import metrics as svc_metrics
         from hpx_tpu.svc.fleet import FleetRouter
 
         frng = np.random.default_rng(17)
@@ -682,6 +749,13 @@ def main() -> int:
                 secs, stalls = drive(r)
                 out = dict(r.results)
                 st = r.stats()
+                merged = r.merged_hist()
+                wsnaps = [{k: h.snapshot() for k, h in per.items()}
+                          for per in r.whist.values()]
+                if tracer is not None and mode == "prefix":
+                    # harvest the worker rings BEFORE close() tears
+                    # the handles down; finish() stitches them
+                    fleet_trace_docs[:] = r.worker_trace_docs()
                 ttft = {rid: r.ttft[rid] for rid in out
                         if rid in r.ttft}
                 r.close()
@@ -698,17 +772,36 @@ def main() -> int:
                       - warm_stats["placed_prefix"],
                       "load": st["placed_load"]
                       - warm_stats["placed_load"]}
-            return out, ttft, secs, stalls, placed, saved, leak
+            return (out, ttft, secs, stalls, placed, saved, leak,
+                    merged, wsnaps)
 
         def sha(out):
             return hashlib.sha256(json.dumps(
                 [out[r] for r in sorted(out)]).encode()).hexdigest()
 
+        def hq(h, q):
+            return round(h.quantile(q) * 1e3, 2)
+
         results = {}
         for mode in ("load", "prefix"):
-            out, ttft, secs, stalls, placed, saved, leak = \
-                run_mode(mode)
+            out, ttft, secs, stalls, placed, saved, leak, merged, \
+                wsnaps = run_mode(mode)
             results[mode] = (out, saved, leak)
+            # fleet-wide == merge() of the per-worker histograms:
+            # re-fold the per-worker SNAPSHOTS independently and
+            # compare against the router's merged view
+            refold = svc_metrics.latency_histograms()
+            for snap in wsnaps:
+                for k in refold:
+                    refold[k] = refold[k].merge(
+                        svc_metrics.HistogramCounter.from_snapshot(
+                            snap[k]))
+            merge_identity = all(
+                refold[k].snapshot()["counts"]
+                == merged[k].snapshot()["counts"]
+                and refold[k].snapshot()["count"]
+                == merged[k].snapshot()["count"]
+                for k in refold)
             ts = sorted(ttft.values())
             emit(f"serving_fleet_{mode}",
                  sum(len(t) for t in out.values()), secs,
@@ -721,8 +814,21 @@ def main() -> int:
                  ttft_p99_ms=pctl(ts, 99),
                  decode_stall_p50_ms=pctl(stalls, 50),
                  decode_stall_p99_ms=pctl(stalls, 99),
+                 slo_hist_ms={
+                     k: {"p50": hq(merged[k], 0.5),
+                         "p95": hq(merged[k], 0.95),
+                         "p99": hq(merged[k], 0.99)}
+                     for k in ("ttft", "queue_wait", "decode_stall")},
+                 hist_merge_identity=merge_identity,
                  kv_blocks_leaked=leak,
                  output_sha=sha(out)[:16])
+            for k, h in merged.items():
+                collected_hists[f"serving_fleet_{mode}/{k}"] = h
+            if not merge_identity:
+                print(json.dumps({
+                    "error": "fleet-wide histograms != merge() of "
+                             "per-worker histograms"}), flush=True)
+                raise SystemExit(2)
         (lo, lo_saved, lo_leak) = results["load"]
         (pf, pf_saved, pf_leak) = results["prefix"]
         if (sha(lo) != sha(pf) or pf_saved <= lo_saved
@@ -742,11 +848,47 @@ def main() -> int:
         if tracer is not None:
             from hpx_tpu.svc import tracing
             tracing.stop_tracing()
-            doc = tracer.export(trace_out)
+            if fleet_trace_docs:
+                # stitch router + every worker ring into ONE trace:
+                # per-worker pid rows, clock-aligned, rid flow arrows
+                from hpx_tpu.svc.trace_export import (
+                    merge_traces, to_chrome_trace, write_trace_doc)
+                router_doc = to_chrome_trace(
+                    tracer.snapshot(), tracer.thread_names(),
+                    tracer.t0, tracer.dropped,
+                    t0_wall=tracer.t0_wall)
+                doc = merge_traces([("router", router_doc)]
+                                   + fleet_trace_docs)
+                write_trace_doc(trace_out, doc)
+                print(json.dumps({
+                    "trace": os.path.abspath(trace_out),
+                    "trace_events": len(doc["traceEvents"]),
+                    "dropped_events":
+                        doc["otherData"]["dropped_events"],
+                    "stitched_processes":
+                        doc["otherData"]["processes"],
+                    "stitched_rids": doc["otherData"]["stitched_rids"],
+                    "rid_flow_arrows":
+                        doc["otherData"]["rid_flow_arrows"],
+                }), flush=True)
+            else:
+                doc = tracer.export(trace_out)
+                print(json.dumps({
+                    "trace": os.path.abspath(trace_out),
+                    "trace_events": len(doc["traceEvents"]),
+                    "dropped_events":
+                        doc["otherData"]["dropped_events"],
+                }), flush=True)
+        if metrics_out:
+            from hpx_tpu.svc import metrics as svc_metrics
+            reg = svc_metrics.registry_snapshot("*")
+            doc = metrics_artifact(collected_hists,
+                                   counters=reg["counters"])
+            write_metrics_artifact(metrics_out, doc)
             print(json.dumps({
-                "trace": os.path.abspath(trace_out),
-                "trace_events": len(doc["traceEvents"]),
-                "dropped_events": doc["otherData"]["dropped_events"],
+                "metrics": os.path.abspath(metrics_out),
+                "schema": doc["schema"],
+                "histograms": len(doc["histograms"]),
             }), flush=True)
         return 0
 

@@ -27,6 +27,10 @@ Per-file tier (rules.py) — each rule sees one parsed file:
 * HPX006 bare-except         — ``except:`` swallows future exceptions
   (and KeyboardInterrupt/SystemExit) on the completion path.
 * HPX007–HPX012              — see the README lint table.
+* HPX016 counter-name-discipline — counter names that fail the
+  ``/object{locality#N/instance}/counter`` registry grammar, and bare
+  ``h.record()`` statements that drop the histogram timing context
+  manager unrecorded.
 
 Whole-program tier (project.py) — every file is parsed once into a
 shared :class:`~.project.ProjectIndex` (symbol table, class-level lock

@@ -10,6 +10,7 @@ the hazard and the fix — the CLI prints these for ``--list-rules``.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable, List, Set, Tuple
 
 from .engine import FileContext, Finding, Rule, register
@@ -887,3 +888,93 @@ class UnboundedRemoteWaitRule(Rule):
                         "or route the call through dist.actions."
                         "resilient_action (timeout + bounded retry + "
                         "idempotent re-delivery)")
+
+
+# the full counter-name grammar from svc/performance_counters._NAME_RE:
+# /object{locality#N/instance}/counter  (N is a number or '*')
+_COUNTER_NAME_RE = re.compile(
+    r"^/[^{/]+\{locality#(\d+|\*)/[^}]+\}/[^{}]+$")
+
+# registry entry points whose FIRST argument is a full counter name
+_COUNTER_NAME_SINKS = {
+    "register_counter", "unregister_counter", "query_counter",
+    "query_counter_async", "parse_counter_name",
+}
+
+# helpers whose first two arguments are (object, counter) fragments
+_COUNTER_FRAGMENT_SINKS = {"counter_name", "put"}
+
+
+@register
+class CounterNameDiscipline(Rule):
+    """HPX016: counter names must parse against the registry grammar
+    and histogram timers must not be silently dropped.  A counter
+    name that fails ``/object{locality#N/instance}/counter`` raises
+    only when the counter is first QUERIED — typically in a dashboard
+    scrape long after the registering commit landed; and a bare
+    ``h.record()`` statement mints a timing context manager and
+    throws it away, recording nothing.  Fix: match the grammar
+    (``performance_counters.counter_name`` builds it for you), and
+    either pass ``record(value)`` or hold the timer in a ``with``."""
+
+    id = "HPX016"
+    name = "counter-name-discipline"
+    severity = "error"
+
+    @staticmethod
+    def _literal_str(node: ast.AST) -> "str | None":
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            return node.value
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.display_path.startswith("tests/") \
+                or "/tests/" in ctx.display_path:
+            return
+        for node in ast.walk(ctx.tree):
+            # dropped histogram timer: an expression STATEMENT whose
+            # value is a no-arg .record() call
+            if isinstance(node, ast.Expr) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr == "record" \
+                    and not node.value.args \
+                    and not node.value.keywords:
+                yield self.finding(
+                    ctx, node,
+                    "bare record() statement drops the timing "
+                    "context manager without entering it — nothing "
+                    "is recorded; pass record(value) or use "
+                    "`with h.record():` around the timed region")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = (fn.attr if isinstance(fn, ast.Attribute)
+                      else fn.id if isinstance(fn, ast.Name) else "")
+            if callee in _COUNTER_NAME_SINKS and node.args:
+                lit = self._literal_str(node.args[0])
+                if lit is not None and lit.startswith("/") \
+                        and not _COUNTER_NAME_RE.match(lit):
+                    yield self.finding(
+                        ctx, node,
+                        f"counter name {lit!r} does not match "
+                        "/object{locality#N/instance}/counter — it "
+                        "registers silently and raises at first "
+                        "query; build it with performance_counters."
+                        "counter_name()")
+            elif callee in _COUNTER_FRAGMENT_SINKS \
+                    and len(node.args) >= 2:
+                obj = self._literal_str(node.args[0])
+                ctr = self._literal_str(node.args[1])
+                if obj is not None and ctr is not None:
+                    full = f"/{obj}{{locality#0/total}}/{ctr}"
+                    if not _COUNTER_NAME_RE.match(full):
+                        yield self.finding(
+                            ctx, node,
+                            f"counter fragments ({obj!r}, {ctr!r}) "
+                            "assemble into a name that fails the "
+                            "registry grammar /object{locality#N/"
+                            "instance}/counter — it raises at first "
+                            "query, not at registration")

@@ -124,6 +124,20 @@ def register_server(srv) -> str:
         pc.CallbackCounter(_read(ref, lambda s: s.fault_stats()
                            ["restore_p99_s"])))
 
+    # SLO latency distributions: the server's live HistogramCounters
+    # registered as-is (a histogram IS a Counter, value = mean, and
+    # holds no reference back) plus derived pNN quantile counters —
+    # /serving{...}/latency/ttft-s, .../ttft-s/p99, ...
+    from ..svc.metrics import register_histogram
+    _HIST_KEYS = (("ttft", "latency/ttft-s"),
+                  ("queue_wait", "latency/queue-wait-s"),
+                  ("transfer", "latency/transfer-s"),
+                  ("decode_stall", "latency/decode-stall-s"),
+                  ("e2e", "latency/e2e-s"))
+    for attr, cname in _HIST_KEYS:
+        names.extend(register_histogram("serving", cname,
+                                        srv.hist[attr], inst))
+
     if getattr(srv, "_spec", False):
         put("serving", "spec/drafted",
             pc.CallbackCounter(_read(ref, lambda s: s._spec_drafted)))
@@ -217,6 +231,25 @@ def register_fleet(rt) -> str:
         put(f"fleet/worker#{k}/queue-depth",
             pc.CallbackCounter(_read(
                 ref, lambda r, k=k: r.worker_queue_depth(k))))
+
+    # fleet-wide SLO quantiles: merge() of every per-worker histogram,
+    # computed at query time (so the value is BY CONSTRUCTION equal to
+    # the merge of the per-worker distributions, the acceptance
+    # contract serving_bench asserts) — /serving{locality#L/fleet#i}/
+    # latency/ttft-s/p99 etc.
+    from ..svc.metrics import (LATENCY_KEYS, configured_quantiles,
+                               quantile_label)
+    _CNAMES = {"ttft": "latency/ttft-s",
+               "queue_wait": "latency/queue-wait-s",
+               "transfer": "latency/transfer-s",
+               "decode_stall": "latency/decode-stall-s",
+               "e2e": "latency/e2e-s"}
+    for key in LATENCY_KEYS:
+        for q in configured_quantiles():
+            put(f"{_CNAMES[key]}/{quantile_label(q)}",
+                pc.CallbackCounter(_read(
+                    ref, lambda r, k=key, q=q:
+                    r.merged_hist()[k].quantile(q))))
 
     with _lock:
         _fleets[idx] = (ref, names)

@@ -309,6 +309,20 @@ declare("hpx.trace.counter_interval", "float", "0.05",
 declare("hpx.trace.counters", "str", "/serving*,/cache*,/threads*",
         "csv counter patterns sampled into the trace")
 
+# -- metrics (svc/metrics histograms + timelines) ---------------------------
+declare("hpx.metrics.hist_lo", "float", "1e-6",
+        "latency histogram lowest bucket bound, seconds (values below "
+        "land in the underflow bucket)")
+declare("hpx.metrics.hist_hi", "float", "1e4",
+        "latency histogram highest bucket bound, seconds")
+declare("hpx.metrics.hist_subbuckets", "int", "8",
+        "histogram buckets per octave (gamma = 2**(1/n); 8 bounds "
+        "quantile relative error at ~4.4%)")
+declare("hpx.metrics.quantiles", "str", "0.5,0.95,0.99",
+        "csv quantiles derived as .../pNN counters per histogram")
+declare("hpx.metrics.timeline_capacity", "int", "1024",
+        "rids retained per RequestTimeline (drop-oldest)")
+
 # -- checkpoint / resiliency / exec -----------------------------------------
 declare("hpx.checkpoint.dir", "str", "./checkpoints",
         "base directory for checkpoint_path() relative names")

@@ -53,8 +53,9 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,7 +65,8 @@ from ..cache.transfer import (KVSegment, TransferCorruptError,
                               TransferReceiver, make_segment)
 from ..core.errors import (Error, FutureError, HpxError, LocalityLost,
                            NetworkError)
-from ..svc import faultinject
+from ..svc import faultinject, tracing
+from ..svc import metrics as _metrics
 from ..svc.resiliency import sync_replay
 from .serving import (ContinuousServer, RequestShedError,
                       ServerClosedError, _normalize_key)
@@ -94,7 +96,45 @@ class _PrefillJob:
     key: Any
 
 
-class PrefillWorker:
+class _WorkerRing:
+    """Per-worker span ring for cross-worker trace stitching.
+
+    Workers live in their own event-loop turn (or their own process,
+    behind a :class:`RemoteHandle`), so they cannot write into the
+    router's tracer.  Instead each worker lazily mints a PRIVATE
+    :class:`tracing.Tracer` the first time a span opens while the
+    process tracer is active, and exposes the ring as a Chrome-trace
+    doc via :meth:`trace_doc` — `trace_export.merge_traces` stitches
+    those docs with the router's own export into one timeline.  When
+    tracing is off the instrumentation is a shared no-op span."""
+
+    _ring: Optional[tracing.Tracer] = None
+
+    def _wspan(self, name: str, **args):
+        if tracing.active_tracer() is None:
+            return tracing.null_span()
+        if self._ring is None:
+            from ..core.config import runtime_config
+            cap = runtime_config().get_int("hpx.trace.buffer_events",
+                                           65536)
+            self._ring = tracing.Tracer(capacity=cap,
+                                        sample_counters=False)
+        return self._ring.span(name, "serving", **args)
+
+    def trace_doc(self) -> Optional[Dict[str, Any]]:
+        """This worker's ring as a Chrome-trace doc (None if the ring
+        never opened a span); carries the wall-clock anchor that
+        merge_traces uses for clock alignment."""
+        if self._ring is None:
+            return None
+        from ..svc.trace_export import to_chrome_trace
+        return to_chrome_trace(self._ring.snapshot(),
+                               self._ring.thread_names(),
+                               self._ring.t0, self._ring.dropped,
+                               t0_wall=self._ring.t0_wall)
+
+
+class PrefillWorker(_WorkerRing):
     """Computes prompt KV on a b=1 dense scratch with the colocated
     server's OWN bucketed chunk/probe programs (an embedded dense
     ``ContinuousServer`` is the program cache), emitting block-aligned
@@ -120,27 +160,30 @@ class PrefillWorker:
               temperature: float = 0.0, key=None,
               prefix_rows=None) -> int:
         """Open (or reopen) a prefill; returns the resume cursor."""
-        eng = self._eng
-        prompt = [int(t) for t in prompt]
-        nkv, hd = eng.cfg.kv_heads, eng.cfg.head_dim
-        scratch = [(jnp.zeros((1, eng.smax, nkv, hd), eng.cfg.dtype),
-                    jnp.zeros((1, eng.smax, nkv, hd), eng.cfg.dtype))
-                   for _ in range(eng.cfg.n_layers)]
-        done = 0
-        if prefix_rows is not None:
-            rows = np.asarray(prefix_rows)
-            done = int(rows.shape[2])
-            scratch = [
-                (k.at[0, :done].set(jnp.asarray(rows[li, 0],
-                                                eng.cfg.dtype)),
-                 v.at[0, :done].set(jnp.asarray(rows[li, 1],
-                                                eng.cfg.dtype)))
-                for li, (k, v) in enumerate(scratch)]
-        self._jobs[rid] = _PrefillJob(
-            prompt=prompt, caches=scratch, done=done, emitted=done,
-            temperature=float(temperature),
-            key=_normalize_key(key) if key is not None else None)
-        return done
+        with self._wspan("prefill.start", rid=rid, plen=len(prompt)):
+            eng = self._eng
+            prompt = [int(t) for t in prompt]
+            nkv, hd = eng.cfg.kv_heads, eng.cfg.head_dim
+            scratch = [(jnp.zeros((1, eng.smax, nkv, hd),
+                                  eng.cfg.dtype),
+                        jnp.zeros((1, eng.smax, nkv, hd),
+                                  eng.cfg.dtype))
+                       for _ in range(eng.cfg.n_layers)]
+            done = 0
+            if prefix_rows is not None:
+                rows = np.asarray(prefix_rows)
+                done = int(rows.shape[2])
+                scratch = [
+                    (k.at[0, :done].set(jnp.asarray(rows[li, 0],
+                                                    eng.cfg.dtype)),
+                     v.at[0, :done].set(jnp.asarray(rows[li, 1],
+                                                    eng.cfg.dtype)))
+                    for li, (k, v) in enumerate(scratch)]
+            self._jobs[rid] = _PrefillJob(
+                prompt=prompt, caches=scratch, done=done,
+                emitted=done, temperature=float(temperature),
+                key=_normalize_key(key) if key is not None else None)
+            return done
 
     def step(self, rid: str) -> Dict[str, Any]:
         """Advance one bucketed chunk; returns ``{"segments", "seed",
@@ -148,37 +191,41 @@ class PrefillWorker:
         first token when the prompt finished (probe ran)."""
         job = self._jobs[rid]
         eng, plen, bs = self._eng, len(job.prompt), self.block_size
-        if job.done < plen:
-            n = min(eng.prefill_chunk, plen - job.done)
-            width = eng._bucket_width(n)
-            toks = job.prompt[job.done:job.done + n] + [0] * (width - n)
-            job.caches = eng._chunk_prog(width)(
-                eng.params, job.caches,
-                jnp.asarray([toks], jnp.int32),
-                jnp.asarray(job.done, jnp.int32))
-            job.done += n
-        segs: List[KVSegment] = []
-        # pre-probe emission cap: row plen-1 is rewritten by the probe
-        cap = ((plen - 1) // bs) * bs
-        while job.emitted + bs <= min(job.done, cap):
-            segs.append(self._emit(rid, job, job.emitted,
-                                   job.emitted + bs, plen))
-        seed: Optional[int] = None
-        finished = job.done >= plen
-        if finished:
-            tok = jnp.asarray([[job.prompt[-1]]], jnp.int32)
-            job.caches, logits = eng._probe_prog()(
-                eng.params, job.caches, tok,
-                jnp.asarray(plen - 1, jnp.int32))
-            if job.temperature > 0.0:
-                # generate()'s tok0 draw: position plen-1, row 0
-                seed = int(_sample_row(logits[0], job.temperature,
-                                       job.key, plen - 1, 0))
-            else:
-                seed = int(jnp.argmax(logits[0]))
-            segs.append(self._emit(rid, job, job.emitted, plen, plen))
-            del self._jobs[rid]
-        return {"segments": segs, "seed": seed, "done": finished}
+        with self._wspan("prefill.step", rid=rid):
+            if job.done < plen:
+                n = min(eng.prefill_chunk, plen - job.done)
+                width = eng._bucket_width(n)
+                toks = (job.prompt[job.done:job.done + n]
+                        + [0] * (width - n))
+                job.caches = eng._chunk_prog(width)(
+                    eng.params, job.caches,
+                    jnp.asarray([toks], jnp.int32),
+                    jnp.asarray(job.done, jnp.int32))
+                job.done += n
+            segs: List[KVSegment] = []
+            # pre-probe emission cap: row plen-1 is rewritten by the
+            # probe
+            cap = ((plen - 1) // bs) * bs
+            while job.emitted + bs <= min(job.done, cap):
+                segs.append(self._emit(rid, job, job.emitted,
+                                       job.emitted + bs, plen))
+            seed: Optional[int] = None
+            finished = job.done >= plen
+            if finished:
+                tok = jnp.asarray([[job.prompt[-1]]], jnp.int32)
+                job.caches, logits = eng._probe_prog()(
+                    eng.params, job.caches, tok,
+                    jnp.asarray(plen - 1, jnp.int32))
+                if job.temperature > 0.0:
+                    # generate()'s tok0 draw: position plen-1, row 0
+                    seed = int(_sample_row(logits[0], job.temperature,
+                                           job.key, plen - 1, 0))
+                else:
+                    seed = int(jnp.argmax(logits[0]))
+                segs.append(self._emit(rid, job, job.emitted, plen,
+                                       plen))
+                del self._jobs[rid]
+            return {"segments": segs, "seed": seed, "done": finished}
 
     def _emit(self, rid: str, job: _PrefillJob, a: int, b: int,
               plen: int) -> KVSegment:
@@ -204,7 +251,7 @@ class PrefillWorker:
         self._eng.shutdown()
 
 
-class DecodeWorker:
+class DecodeWorker(_WorkerRing):
     """Paged ``ContinuousServer`` plus a :class:`TransferReceiver`:
     ingests segments (idempotently), admits completed transfers via
     ``admit_prefilled``, and pumps decode steps, translating between
@@ -249,18 +296,20 @@ class DecodeWorker:
         return {"matched": matched, "rows": rows}
 
     def ingest(self, seg: KVSegment) -> Dict[str, Any]:
-        return self.recv.ingest(seg)
+        with self._wspan("decode.ingest", rid=seg.rid, seq=seg.seq):
+            return self.recv.ingest(seg)
 
     def admit(self, rid: str, prompt: List[int], seed: int,
               max_new: int, eos_id: Optional[int] = None,
               temperature: float = 0.0, key=None) -> int:
-        rows = self.recv.assemble(rid)
-        local = self.srv.admit_prefilled(
-            prompt, rows, seed, max_new, eos_id=eos_id,
-            temperature=temperature, key=key)
-        self._local_of[rid] = local
-        self._global_of[local] = rid
-        return local
+        with self._wspan("decode.admit", rid=rid, plen=len(prompt)):
+            rows = self.recv.assemble(rid)
+            local = self.srv.admit_prefilled(
+                prompt, rows, seed, max_new, eos_id=eos_id,
+                temperature=temperature, key=key)
+            self._local_of[rid] = local
+            self._global_of[local] = rid
+            return local
 
     def pump(self, steps: int = 1) -> Dict[str, Any]:
         """Run up to `steps` server steps; returns ``{"done",
@@ -269,10 +318,11 @@ class DecodeWorker:
         router's progress checkpoint for post-failover replay
         verification."""
         busy = False
-        for _ in range(max(1, steps)):
-            busy = self.srv.step()
-            if not busy:
-                break
+        with self._wspan("decode.pump", steps=steps):
+            for _ in range(max(1, steps)):
+                busy = self.srv.step()
+                if not busy:
+                    break
         done: Dict[str, List[int]] = {}
         for lrid in list(self.srv._done):
             grid = self._global_of.pop(lrid, None)
@@ -543,6 +593,15 @@ class DisaggRouter:
         self._local_map: Dict[int, int] = {}   # local rid -> router rid
         self.ttft: Dict[int, float] = {}
         self._t_submit: Dict[int, float] = {}
+        # -- SLO metrics plane: per-decode-worker latency histograms
+        # (keyed by creation-order index, stable across failover) plus
+        # a rid-keyed lifecycle timeline.  merged_hist() folds the
+        # per-worker histograms into the fleet-wide view.
+        self._worker_idx: Dict[int, int] = {}
+        self._next_widx = 0
+        self.whist: Dict[int, Dict[str, _metrics.HistogramCounter]] = {}
+        self.timeline = _metrics.RequestTimeline()
+        self._last_pump_t: Dict[int, float] = {}
 
     # -- admission --------------------------------------------------------
 
@@ -569,8 +628,9 @@ class DisaggRouter:
                          _normalize_key(key) if key is not None
                          else None, slo)
         self._reqs[rid] = req
-        import time
         self._t_submit[rid] = time.monotonic()
+        self.timeline.event(req.grid, "submit", slo=slo,
+                            plen=len(prompt))
         # bounded admission: shed BATCH work first (newest first), an
         # overflowing batch submit sheds itself, and only a queue full
         # of interactive work sheds an interactive submit
@@ -652,6 +712,60 @@ class DisaggRouter:
                 load[id(r.decode_h)] += 1
         return load
 
+    # -- SLO metrics plane ------------------------------------------------
+
+    def _widx(self, h: Optional[WorkerHandle]) -> int:
+        """Creation-order index of a decode handle — stable across
+        failover and autoscale (-1 covers the degraded / no-worker
+        path)."""
+        if h is None:
+            return -1
+        key = id(h)
+        if key not in self._worker_idx:
+            self._worker_idx[key] = self._next_widx
+            self._next_widx += 1
+        return self._worker_idx[key]
+
+    def _whist(self, h: Optional[WorkerHandle]
+               ) -> Dict[str, _metrics.HistogramCounter]:
+        """The latency histograms attributed to one decode worker,
+        minted lazily on first touch."""
+        idx = self._widx(h)
+        hist = self.whist.get(idx)
+        if hist is None:
+            hist = self.whist[idx] = _metrics.latency_histograms()
+        return hist
+
+    def merged_hist(self) -> Dict[str, _metrics.HistogramCounter]:
+        """The fleet-wide latency view: a fold of every per-worker
+        histogram under :meth:`HistogramCounter.merge`, computed at
+        query time — so the fleet-wide quantiles EQUAL the merge of
+        the per-worker histograms by construction."""
+        out = _metrics.latency_histograms()
+        for per in self.whist.values():
+            for k in _metrics.LATENCY_KEYS:
+                out[k] = out[k].merge(per[k])
+        return out
+
+    def worker_trace_docs(self) -> List[Tuple[str, Dict[str, Any]]]:
+        """Chrome-trace docs from every live worker's private span
+        ring, labelled ``role#index`` — feed these together with the
+        router's own export to ``trace_export.merge_traces`` for the
+        single stitched fleet timeline."""
+        docs: List[Tuple[str, Dict[str, Any]]] = []
+        for role, pool in (("prefill", self._prefill),
+                           ("decode", self._decode)):
+            for i, h in enumerate(pool):
+                if not h.alive:
+                    continue
+                try:
+                    doc = self._call(h, "trace_doc")
+                except _WorkerDown:
+                    continue
+                if doc is not None:
+                    docs.append((f"{role}#{i}", doc))
+        return docs
+
     def _placeable_decode(self) -> List[WorkerHandle]:
         """Candidates for NEW placements: alive and not draining. A
         fleet drain empties the pool's tail, never the whole pool, but
@@ -697,13 +811,23 @@ class DisaggRouter:
             if jobs[id(h)] >= self._prefill_jobs:
                 return
             q = self._qi if self._qi else self._qb
-            req = self._reqs[q[0]]     # peek: a death during start
-            req.prefill_h = h          # must leave the rid queued for
-            req.decode_h = self._place_decode(req)      # re-dispatch
-            self._start_prefill_job(req, h)
+            # peek: a death during start must leave the rid queued
+            # for re-dispatch
+            req = self._reqs[q[0]]
+            with tracing.span("serving.place", "serving",
+                              rid=req.grid):
+                req.prefill_h = h
+                req.decode_h = self._place_decode(req)
+                self._start_prefill_job(req, h)
             q.popleft()
             req.state = "prefill"
             jobs[id(h)] += 1
+            now = time.monotonic()
+            self._whist(req.decode_h)["queue_wait"].record(
+                now - self._t_submit[req.rid])
+            self.timeline.event(req.grid, "place", t=now,
+                                worker=self._widx(req.decode_h))
+            self.timeline.event(req.grid, "prefill_start", t=now)
 
     def _advance_prefills(self) -> None:
         for rid in sorted(r.rid for r in self._reqs.values()
@@ -726,10 +850,17 @@ class DisaggRouter:
         """Deliver one segment, re-sending on checksum corruption
         (bounded, backed off); connectivity errors propagate to the
         failover path."""
-        sync_replay(self._xfer_retries,
-                    lambda: self._call(req.decode_h, "ingest", seg),
-                    retry_on=(TransferCorruptError,),
-                    backoff_s=0.005)
+        if seg.seq == 0:
+            self.timeline.event(req.grid, "kv_transfer",
+                                worker=self._widx(req.decode_h))
+        with tracing.span("serving.transfer", "serving", rid=req.grid,
+                          seq=seg.seq), \
+                self._whist(req.decode_h)["transfer"].record():
+            sync_replay(self._xfer_retries,
+                        lambda: self._call(req.decode_h, "ingest",
+                                           seg),
+                        retry_on=(TransferCorruptError,),
+                        backoff_s=0.005)
 
     def _admit_decode(self, req: _RouterReq) -> None:
         # transition BEFORE the call: prefill is finished (its job is
@@ -741,13 +872,21 @@ class DisaggRouter:
                    req.temperature, req.key)
 
     def _pump_decodes(self) -> None:
-        import time
         for h in self._alive(self._decode):
+            widx = self._widx(h)
             assigned = any(r.decode_h is h and r.state == "decode"
                            for r in self._reqs.values())
             if not assigned:
+                self._last_pump_t.pop(widx, None)
                 continue
+            # decode stall: the gap since this worker's previous pump
+            # returned while it still held live work
+            now = time.monotonic()
+            last = self._last_pump_t.get(widx)
+            if last is not None:
+                self._whist(h)["decode_stall"].record(now - last)
             out = self._call(h, "pump", self._pump_steps)
+            self._last_pump_t[widx] = time.monotonic()
             for grid, toks in sorted(out["done"].items()):
                 self._finish(self._req_of(grid), toks)
             for grid, err in sorted(out["failed"].items()):
@@ -759,8 +898,11 @@ class DisaggRouter:
                 req = self._req_of(grid)
                 req.progress = toks
                 if req.rid not in self.ttft and toks:
-                    self.ttft[req.rid] = (time.monotonic()
-                                          - self._t_submit[req.rid])
+                    ttft = time.monotonic() - self._t_submit[req.rid]
+                    self.ttft[req.rid] = ttft
+                    self._whist(req.decode_h)["ttft"].record(ttft)
+                    self.timeline.event(req.grid, "first_token",
+                                        worker=widx)
 
     def _req_of(self, grid: str) -> _RouterReq:
         return self._reqs[int(grid[1:])]
@@ -775,9 +917,16 @@ class DisaggRouter:
         req.state = "done"
         req.segments = []
         self.results[req.rid] = toks
-        import time
-        self.ttft.setdefault(req.rid, time.monotonic()
-                             - self._t_submit[req.rid])
+        now = time.monotonic()
+        if req.rid not in self.ttft:
+            ttft = now - self._t_submit[req.rid]
+            self.ttft[req.rid] = ttft
+            self._whist(req.decode_h)["ttft"].record(ttft)
+            self.timeline.event(req.grid, "first_token",
+                                worker=self._widx(req.decode_h))
+        self._whist(req.decode_h)["e2e"].record(
+            now - self._t_submit[req.rid])
+        self.timeline.event(req.grid, "retire", tokens=len(toks))
 
     # -- failover ---------------------------------------------------------
 
@@ -884,6 +1033,7 @@ class DisaggRouter:
     # -- lifecycle --------------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:
+        merged = self.merged_hist()
         return {
             "failovers": dict(self.failovers),
             "shed": self.shed,
@@ -891,6 +1041,12 @@ class DisaggRouter:
             "unfinished": self._unfinished(),
             "prefill_alive": len(self._alive(self._prefill)),
             "decode_alive": len(self._alive(self._decode)),
+            # fleet-wide quantiles from LIVE histograms — the merge of
+            # the per-worker views, not a post-hoc sort of raw samples
+            "latency": {
+                k: {_metrics.quantile_label(q): merged[k].quantile(q)
+                    for q in _metrics.configured_quantiles()}
+                for k in _metrics.LATENCY_KEYS},
         }
 
     def leaked_blocks(self) -> int:

@@ -862,7 +862,18 @@ class ContinuousServer:
         self._flt_shed = 0
         self._flt_degraded = 0
         self._restored_by_site: Dict[str, int] = {}
-        self._restore_s: List[float] = []
+        # SLO latency distributions (svc/metrics): live log-bucketed
+        # histograms, one per family, registered (with derived pNN
+        # counters) as /serving{...}/latency/* — plus the per-request
+        # lifecycle timeline and checkpoint-restore timings (the
+        # faults/restore-p99-s feed)
+        from ..svc import metrics as _metrics
+        self.hist: Dict[str, _metrics.HistogramCounter] = \
+            _metrics.latency_histograms()
+        self._restore_hist = _metrics.HistogramCounter()
+        self.timeline = _metrics.RequestTimeline()
+        self._last_step_t: Optional[float] = None
+        self._stall_live = False
         from ..cache.counters import register_server
         self.counter_instance = register_server(self)
 
@@ -1563,9 +1574,10 @@ class ContinuousServer:
         """Resiliency observability snapshot — the scalar fields feed
         the /serving{...}/faults/* performance counters; the chaos
         bench reads `restored_by_site` for its per-fault-class gate
-        and `restore_p99_s` for the restore-latency column."""
-        rs = sorted(self._restore_s)
-        p99 = rs[max(0, math.ceil(0.99 * len(rs)) - 1)] if rs else 0.0
+        and `restore_p99_s` for the restore-latency column (a live
+        HistogramCounter quantile — bounded relative error, O(buckets)
+        memory — not a sorted sample list)."""
+        p99 = self._restore_hist.quantile(0.99)
         return {
             "injected": self._flt_injected,
             "retried": self._flt_retried,
@@ -1616,6 +1628,7 @@ class ContinuousServer:
             rid, prompt, max_new, eos_id, temperature, key,
             t_submit=now, deadline_s=deadline_s,
             t_deadline=(now + deadline_s) if deadline_s else None))
+        self.timeline.event(rid, "submit", t=now, plen=len(prompt))
         return rid
 
     def admit_prefilled(self, prompt, kv_rows, seed_token: int,
@@ -1859,7 +1872,10 @@ class ContinuousServer:
             self._slot_acc[slot] = 1.0
             if self._draft_params is not None:
                 self._draft_prefill(slot, req.prompt)
-        self.ttft[req.rid] = time.monotonic() - req.t_submit
+        ttft = time.monotonic() - req.t_submit
+        self.ttft[req.rid] = ttft
+        self.hist["ttft"].record(ttft)
+        self.timeline.event(req.rid, "first_token", slot=slot)
         # seed checkpoint: a fault before the first cadence capture
         # restores to the freshly-admitted state instead of losing the
         # slot (the seed token is already part of the checkpoint)
@@ -1890,6 +1906,13 @@ class ContinuousServer:
                    and slot not in self._pending and self._queue):
                 req = self._queue.popleft()
                 plen = len(req.prompt)
+                # queue wait = submit -> first admission attempt (an
+                # OOM-deferred request re-dequeues but records once)
+                if req.rid not in self._admit_defers:
+                    self.hist["queue_wait"].record(
+                        time.monotonic() - req.t_submit)
+                    self.timeline.event(req.rid, "prefill_start",
+                                        slot=slot)
                 try:
                     with tracing.span("serving.admit", "serving",
                                       rid=req.rid, slot=slot,
@@ -1967,7 +1990,11 @@ class ContinuousServer:
             self._slot_acc[slot] = 1.0
             if self._draft_params is not None:
                 self._draft_prefill(slot, req.prompt)
-        self.ttft[req.rid] = time.monotonic() - req.t_submit
+        ttft = time.monotonic() - req.t_submit
+        self.ttft[req.rid] = ttft
+        self.hist["ttft"].record(ttft)
+        self.timeline.event(req.rid, "transfer_admit", slot=slot,
+                            plen=plen)
         self._prefill_saved += plen    # prefill compute happened remotely
         self._capture(slot)
         self._maybe_retire(slot)
@@ -2389,7 +2416,7 @@ class ContinuousServer:
         if restored:
             self._restored_by_site[site] = \
                 self._restored_by_site.get(site, 0) + 1
-            self._restore_s.append(time.monotonic() - t0)
+            self._restore_hist.record(time.monotonic() - t0)
 
     def _shed_req(self, req: "_Request", err: HpxError) -> None:
         """Fail one request with a typed error, surfaced via `failed`
@@ -2480,6 +2507,9 @@ class ContinuousServer:
                           rid=req.rid, slot=slot,
                           tokens=len(req.tokens), eos=hit_eos):
             self._done[req.rid] = req.tokens
+            self.hist["e2e"].record(time.monotonic() - req.t_submit)
+            self.timeline.event(req.rid, "retire",
+                                tokens=len(req.tokens))
             if self._slot_req[slot] is req:
                 self._slot_req[slot] = None
                 self._drop_ckpt(slot)
@@ -2518,6 +2548,13 @@ class ContinuousServer:
         budget exhausts, every in-flight request sheds with a typed
         error into `failed` and the loop moves on."""
         self._shed_expired()
+        # decode-stall feed: the gap between consecutive step() entries
+        # while the PREVIOUS step left live slots — the inter-token
+        # latency a streaming client would observe
+        now = time.monotonic()
+        if self._stall_live and self._last_step_t is not None:
+            self.hist["decode_stall"].record(now - self._last_step_t)
+        self._last_step_t = now
         try:
             return sync_replay(
                 self._step_retries, self._step_inner,
@@ -2527,6 +2564,9 @@ class ContinuousServer:
         except (faultinject.InjectedFault, CacheOOM) as e:
             self._shed_everything(e)
             return bool(self._queue or self._pending)
+        finally:
+            self._stall_live = any(r is not None
+                                   for r in self._slot_req)
 
     def _step_inner(self) -> bool:
         self._admit()

@@ -219,6 +219,11 @@ class FleetRouter(DisaggRouter):
                     self._placed_prefix += 1
                 else:
                     self._placed_load += 1
+            self.timeline.event(
+                req.grid, "fleet_place",
+                by="prefix" if best_matched else "load",
+                matched_blocks=best_matched,
+                worker=self._decode.index(best))
             if tracing.active_tracer() is not None:
                 tracing.instant(
                     "serving.fleet.placed", "serving", rid=req.rid,

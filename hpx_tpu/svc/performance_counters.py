@@ -251,6 +251,17 @@ def discover_counters(pattern: str = "*") -> List[str]:
     return sorted(n for n in names if fnmatch.fnmatchcase(n, pattern))
 
 
+def registered_counters(pattern: str = "*") -> Dict[str, Counter]:
+    """The Counter OBJECTS behind :func:`discover_counters` — exposition
+    layers (svc/metrics render_prometheus) need the instances, not just
+    query values, to tell histograms from scalars."""
+    _refresh()
+    with _registry_lock:
+        snap = dict(_registry)
+    return {n: snap[n] for n in sorted(snap)
+            if fnmatch.fnmatchcase(n, pattern)}
+
+
 def query_counter(name: str, reset: bool = False,
                   _do_refresh: bool = True) -> CounterValue:
     """Query one counter. A name addressed to another locality routes
@@ -468,6 +479,18 @@ def _register_builtins() -> None:
     put("runtime", "count/dropped-observer-callbacks",
         CallbackCounter(lambda: float(_prof.dropped_callbacks()),
                         reset_fn=_prof.reset_dropped_callbacks))
+
+    # tracer-ring health: spans lost to the drop-oldest ring of the
+    # ACTIVE process tracer (0 when tracing is off).  Nonzero means the
+    # ring is undersized for the workload — raise hpx.trace.buffer_events
+    # or narrow hpx.trace.counters.
+    from . import tracing as _tracing
+
+    def _dropped_spans() -> float:
+        tr = _tracing.active_tracer()
+        return float(tr.dropped) if tr is not None else 0.0
+    put("runtime", "trace/dropped-spans",
+        CallbackCounter(_dropped_spans))
 
     # parcel layer (only once the distributed runtime is up). Read the
     # CURRENT runtime at query time: closing over the runtime object

@@ -25,8 +25,8 @@ import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["to_chrome_trace", "write_chrome_trace",
-           "validate_chrome_trace", "load_chrome_trace"]
+__all__ = ["to_chrome_trace", "write_chrome_trace", "write_trace_doc",
+           "merge_traces", "validate_chrome_trace", "load_chrome_trace"]
 
 _PID = 1                       # single-process trace; localities could
                                # map to pids in a multi-host merge
@@ -39,11 +39,16 @@ def _us(ts: float, t0: float) -> float:
 def to_chrome_trace(events: List[tuple],
                     thread_names: Optional[Dict[int, str]] = None,
                     t0: float = 0.0,
-                    dropped: int = 0) -> dict:
+                    dropped: int = 0,
+                    t0_wall: Optional[float] = None) -> dict:
     """Convert a `Tracer.snapshot()` (record-order flat tuples) into
-    the Chrome trace-event JSON document."""
+    the Chrome trace-event JSON document.  ``t0_wall`` (the tracer's
+    wall-clock anchor for its monotonic ``t0``) lands in
+    ``otherData.clock_sync`` so :func:`merge_traces` can align rings
+    born at different times."""
     thread_names = thread_names or {}
     out: List[dict] = []
+    orphans = 0                    # E/f halves whose opener was evicted
 
     # pass 1: which span/flow ids have their opening half in-buffer,
     # and the trace end timestamp for closing dangling spans
@@ -73,7 +78,8 @@ def to_chrome_trace(events: List[tuple],
             open_spans[eid] = rec
         elif ph == "E":
             if eid not in begun:
-                continue           # its B was evicted: keep pairs matched
+                orphans += 1       # its B was evicted: keep pairs matched
+                continue
             open_spans.pop(eid, None)
             out.append({"ph": "E", "pid": _PID, "tid": tid,
                         "ts": _us(ts, t0), "name": name, "cat": cat})
@@ -90,7 +96,8 @@ def to_chrome_trace(events: List[tuple],
                         "id": eid})
         elif ph == "f":
             if eid not in flow_started:
-                continue           # unresolved arrow: drop the head
+                orphans += 1       # unresolved arrow: drop the head
+                continue
             out.append({"ph": "f", "pid": _PID, "tid": tid,
                         "ts": _us(ts, t0), "name": name, "cat": cat,
                         "id": eid, "bp": "e"})
@@ -102,7 +109,9 @@ def to_chrome_trace(events: List[tuple],
     # drop flow tails whose head span never ran (task still queued at
     # snapshot): validators demand every s resolve to an f
     finished = {e["id"] for e in out if e["ph"] == "f"}
-    out = [e for e in out if e["ph"] != "s" or e["id"] in finished]
+    kept = [e for e in out if e["ph"] != "s" or e["id"] in finished]
+    orphans += len(out) - len(kept)
+    out = kept
 
     # close spans still open at snapshot so B/E always balance —
     # innermost (most recent B) first, preserving stack nesting
@@ -122,21 +131,135 @@ def to_chrome_trace(events: List[tuple],
         meta.append({"ph": "M", "pid": _PID, "tid": ident,
                      "name": "thread_name", "args": {"name": tname}})
 
+    # janitor summary: ring drops (satellite of the
+    # /runtime{...}/trace/dropped-spans counter), orphans discarded,
+    # dangling spans synthetically closed — an artifact that "validates"
+    # after heavy repair should say so
+    other: Dict[str, Any] = {
+        "dropped_events": dropped,
+        "format": "hpx_tpu.svc.tracing",
+        "janitor": {"orphan_events_discarded": orphans,
+                    "spans_closed_at_end": len(open_spans)},
+    }
+    if t0_wall is not None:
+        other["clock_sync"] = {"t0_wall": t0_wall}
     return {"traceEvents": meta + out,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": dropped,
-                          "format": "hpx_tpu.svc.tracing"}}
+            "otherData": other}
 
 
-def write_chrome_trace(path: str, tracer: Any) -> dict:
-    """Snapshot `tracer` and write the JSON artifact to `path`."""
-    doc = to_chrome_trace(tracer.snapshot(), tracer.thread_names(),
-                          tracer.t0, tracer.dropped)
+def write_trace_doc(path: str, doc: dict) -> dict:
+    """Atomically write an already-built trace document."""
     tmp = f"{path}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     os.replace(tmp, path)          # readers never see a half-written trace
     return doc
+
+
+def write_chrome_trace(path: str, tracer: Any) -> dict:
+    """Snapshot `tracer` and write the JSON artifact to `path`."""
+    doc = to_chrome_trace(tracer.snapshot(), tracer.thread_names(),
+                          tracer.t0, tracer.dropped,
+                          t0_wall=getattr(tracer, "t0_wall", None))
+    return write_trace_doc(path, doc)
+
+
+def merge_traces(docs: List[Tuple[str, dict]]) -> dict:
+    """Stitch several exported trace documents — the router's process
+    tracer plus every worker's private ring — into ONE Perfetto
+    document.
+
+    * Each input becomes its own pid row (pid = position + 1) named by
+      its label via a ``process_name`` metadata row; per-doc thread
+      rows ride along under the new pid.
+    * Clocks align through each doc's ``otherData.clock_sync.t0_wall``
+      wall anchor: timestamps shift by the anchor delta against the
+      earliest anchor (a doc without an anchor keeps its own zero).
+    * Flow ids are namespaced per doc (``"<i>:<id>"``) so rings that
+      each counted from 1 do not weld unrelated arrows together.
+    * Request stitching: B spans carrying a string ``rid`` arg are
+      grouped per rid across ALL docs and consecutive spans landing in
+      DIFFERENT pids get a fresh ``s``/``f`` flow pair — the
+      place → prefill → transfer → decode arrows that cross worker
+      rows.  (ContinuousServer's slot-local integer rids never collide
+      with the router's "r<N>" strings, so in-worker spans do not
+      false-link across workers.)
+
+    The result passes :func:`validate_chrome_trace`.
+    """
+    meta: List[dict] = []
+    merged: List[dict] = []
+    anchors = [d.get("otherData", {}).get("clock_sync", {})
+               .get("t0_wall") for _, d in docs]
+    known = [a for a in anchors if a is not None]
+    ref = min(known) if known else 0.0
+    dropped = 0
+    per_process: Dict[str, int] = {}
+    # rid -> [(ts, pid, tid, span name)] over every doc's B events
+    rid_spans: Dict[str, List[Tuple[float, int, int, str]]] = {}
+
+    for i, (label, doc) in enumerate(docs):
+        pid = i + 1
+        off = (anchors[i] - ref) * 1e6 if anchors[i] is not None else 0.0
+        meta.append({"ph": "M", "pid": pid, "tid": 0,
+                     "name": "process_name", "args": {"name": label}})
+        od = doc.get("otherData", {})
+        dropped += int(od.get("dropped_events", 0) or 0)
+        per_process[label] = int(od.get("dropped_events", 0) or 0)
+        for ev in doc.get("traceEvents", []):
+            ph = ev.get("ph")
+            if ph == "M":
+                if ev.get("name") == "process_name":
+                    continue       # replaced by the labelled row above
+                e2 = dict(ev)
+                e2["pid"] = pid
+                meta.append(e2)
+                continue
+            e2 = dict(ev)
+            e2["pid"] = pid
+            e2["ts"] = round(ev["ts"] + off, 3)
+            if ph in ("s", "f"):
+                e2["id"] = f"{i}:{ev['id']}"
+            merged.append(e2)
+            if ph == "B":
+                rid = (ev.get("args") or {}).get("rid")
+                if isinstance(rid, str):
+                    rid_spans.setdefault(rid, []).append(
+                        (e2["ts"], pid, ev.get("tid", 0),
+                         ev.get("name", "")))
+
+    arrows: List[dict] = []
+    fid_seq = 0
+    stitched_rids = 0
+    for rid in sorted(rid_spans):
+        spans = sorted(rid_spans[rid])
+        crossed = False
+        for (ts0, p0, tid0, _n0), (ts1, p1, tid1, _n1) in \
+                zip(spans, spans[1:]):
+            if p0 == p1:
+                continue
+            fid = f"rid:{rid}:{fid_seq}"
+            fid_seq += 1
+            crossed = True
+            arrows.append({"ph": "s", "pid": p0, "tid": tid0, "ts": ts0,
+                           "name": "rid-flow", "cat": "rid", "id": fid})
+            arrows.append({"ph": "f", "pid": p1, "tid": tid1, "ts": ts1,
+                           "name": "rid-flow", "cat": "rid", "id": fid,
+                           "bp": "e"})
+        if crossed:
+            stitched_rids += 1
+    merged.extend(arrows)
+    merged.sort(key=lambda e: e["ts"])
+
+    return {"traceEvents": meta + merged,
+            "displayTimeUnit": "ms",
+            "otherData": {"format": "hpx_tpu.svc.tracing/merged",
+                          "processes": [label for label, _ in docs],
+                          "dropped_events": dropped,
+                          "dropped_events_per_process": per_process,
+                          "stitched_rids": stitched_rids,
+                          "rid_flow_arrows": len(arrows) // 2}}
 
 
 def load_chrome_trace(path: str) -> dict:

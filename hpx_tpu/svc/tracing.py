@@ -147,6 +147,10 @@ class Tracer:
         self._tls = threading.local()
         self._threads: Dict[int, str] = {}   # ident -> thread name
         self.t0 = time.perf_counter()
+        # wall anchor taken at the same instant as t0: trace_export
+        # merge_traces aligns rings born at different times by shifting
+        # each doc's monotonic timestamps with the wall-anchor delta
+        self.t0_wall = time.time()
         self.counter_interval = float(counter_interval)
         self.counter_patterns = list(counter_patterns or [])
         self._sample_counters = bool(sample_counters)
@@ -487,6 +491,13 @@ def span(name: str, cat: str = "user", **args: Any):
     if tr is None:
         return _NULL_SPAN
     return tr.span(name, cat, **args)
+
+
+def null_span() -> _NullSpan:
+    """The shared no-op span, for instrumentation that keeps its OWN
+    ring (disagg worker rings) and needs the do-nothing branch when
+    process tracing is off."""
+    return _NULL_SPAN
 
 
 def instant(name: str, cat: str = "user", **args: Any) -> None:

@@ -741,12 +741,79 @@ def test_hpx012_skips_tests():
                     path="tests/test_fixture.py") == []
 
 
+# ---------------------------------------------------------------------------
+# HPX016 — counter-name grammar + dropped histogram timers
+# ---------------------------------------------------------------------------
+
+HPX016_BAD_NAME = """\
+from hpx_tpu.svc.performance_counters import query_counter
+
+def scrape():
+    return query_counter("/serving/locality#0/ttft-p99")
+"""
+
+HPX016_BAD_FRAGMENT = """\
+from hpx_tpu.svc.performance_counters import counter_name
+
+def name():
+    return counter_name("serving", "latency/{oops}")
+"""
+
+HPX016_BAD_DROPPED = """\
+def observe(h):
+    h.record()
+    return h
+"""
+
+HPX016_GOOD = """\
+from hpx_tpu.svc.performance_counters import counter_name, query_counter
+
+def scrape():
+    return query_counter("/serving{locality#0/total}/latency/ttft-s/p99")
+
+def name():
+    return counter_name("serving", "latency/ttft-s")
+
+def observe(h):
+    h.record(0.25)
+    with h.record():
+        pass
+    return h
+"""
+
+
+def test_hpx016_malformed_full_name():
+    fs = findings(HPX016_BAD_NAME, path="hpx_tpu/svc/fixture.py")
+    assert rules_of(fs) == ["HPX016"]
+    assert "grammar" in fs[0].message or "counter name" in fs[0].message
+
+
+def test_hpx016_malformed_fragments():
+    fs = findings(HPX016_BAD_FRAGMENT, path="hpx_tpu/svc/fixture.py")
+    assert rules_of(fs) == ["HPX016"]
+
+
+def test_hpx016_dropped_timer():
+    fs = findings(HPX016_BAD_DROPPED, path="hpx_tpu/svc/fixture.py")
+    assert rules_of(fs) == ["HPX016"]
+    assert "record" in fs[0].message
+
+
+def test_hpx016_silent_after_fix():
+    assert findings(HPX016_GOOD, path="hpx_tpu/svc/fixture.py") == []
+
+
+def test_hpx016_skips_tests():
+    assert findings(HPX016_BAD_DROPPED,
+                    path="tests/test_fixture.py") == []
+
+
 def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
                    "HPX005", "HPX006", "HPX007", "HPX008",
                    "HPX009", "HPX010", "HPX011", "HPX012",
-                   "HPX013", "HPX014", "HPX015"]
+                   "HPX013", "HPX014", "HPX015", "HPX016"]
 
 
 def test_rule_registry_completeness(capsys):

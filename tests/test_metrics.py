@@ -1,0 +1,396 @@
+"""The SLO metrics plane: log-bucketed HistogramCounter accuracy and
+algebra, counter-registry derivation, Prometheus rendering, request
+timelines, dropped-span accounting, the serving_bench metrics
+artifact, and cross-worker trace stitching on a live 2-worker fleet.
+
+The quantile contract under test is the whole point of the design:
+``quantile(q)`` is a nearest-rank estimate whose RELATIVE error is
+bounded by ``sqrt(gamma) - 1`` (gamma = 2**(1/subbuckets)) regardless
+of the distribution, and ``merge`` is exact (vector addition of
+counts) and associative — so fleet-wide quantiles computed from merged
+per-worker histograms carry the same bound as any single worker's.
+"""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from hpx_tpu.svc import metrics
+from hpx_tpu.svc import performance_counters as pc
+from hpx_tpu.svc import tracing
+from hpx_tpu.svc.metrics import (
+    HistogramCounter,
+    RequestTimeline,
+    latency_histograms,
+    register_histogram,
+)
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+# ---------------------------------------------------------------------------
+# quantile accuracy vs the exact nearest-rank answer
+# ---------------------------------------------------------------------------
+
+
+def _exact_quantile(xs, q):
+    """The nearest-rank quantile the histogram approximates."""
+    xs = sorted(xs)
+    k = max(1, math.ceil(q * len(xs)))
+    return xs[k - 1]
+
+
+def _check_bound(xs, quantiles=(0.5, 0.9, 0.95, 0.99)):
+    h = HistogramCounter()
+    for x in xs:
+        h.record(x)
+    bound = h.relative_error_bound()
+    for q in quantiles:
+        est, exact = h.quantile(q), _exact_quantile(xs, q)
+        assert est == pytest.approx(exact, rel=bound + 1e-9), (
+            f"q={q}: est {est} vs exact {exact} "
+            f"(bound {bound:.4f})")
+
+
+def test_quantile_accuracy_lognormal():
+    rng = np.random.default_rng(7)
+    _check_bound(np.exp(rng.normal(-3.0, 1.5, 5000)).tolist())
+
+
+def test_quantile_accuracy_uniform():
+    rng = np.random.default_rng(11)
+    _check_bound(rng.uniform(1e-4, 2.0, 5000).tolist())
+
+
+def test_quantile_adversarial_shapes():
+    # constant: every quantile is the one observed value, and the
+    # [vmin, vmax] clamp makes the estimate EXACT
+    h = HistogramCounter()
+    for _ in range(100):
+        h.record(0.125)
+    for q in (0.01, 0.5, 0.99):
+        assert h.quantile(q) == 0.125
+    # two-point mass straddling many octaves
+    _check_bound([1e-5] * 90 + [10.0] * 10)
+    # values pinned to bucket boundaries (powers of gamma), spanning
+    # ~30 octaves but staying inside [lo, hi) where the bound holds
+    g = 2.0 ** (1.0 / 8)
+    _check_bound([1e-6 * g ** i for i in range(0, 240, 7)])
+    # full dynamic range incl. under/overflow clamps
+    h = HistogramCounter(lo=1e-3, hi=1.0)
+    for v in (1e-6, 5e-4, 0.1, 50.0, 2000.0):
+        h.record(v)
+    assert h.quantile(0.0) >= 1e-6
+    assert h.quantile(1.0) <= 2000.0 + 1e-9
+
+
+def test_quantile_empty_and_mean():
+    h = HistogramCounter()
+    assert h.quantile(0.5) == 0.0
+    h.record(2.0)
+    h.record(4.0)
+    assert h.mean() == pytest.approx(3.0)
+    assert h.get_value().value == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# merge: exact, associative, layout-checked
+# ---------------------------------------------------------------------------
+
+
+def _fill(seed, n):
+    rng = np.random.default_rng(seed)
+    h = HistogramCounter()
+    for x in np.exp(rng.normal(-2.0, 2.0, n)):
+        h.record(float(x))
+    return h
+
+
+def test_merge_associative_and_exact():
+    a, b, c = _fill(1, 400), _fill(2, 300), _fill(3, 500)
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    assert left.snapshot() == right.snapshot()
+    assert left.count == a.count + b.count + c.count
+    assert left.sum == pytest.approx(a.sum + b.sum + c.sum)
+    # merge with an empty histogram is the identity
+    assert a.merge(HistogramCounter()).snapshot() == a.snapshot()
+
+
+def test_merge_layout_mismatch_raises():
+    with pytest.raises(ValueError):
+        HistogramCounter(subbuckets=8).merge(
+            HistogramCounter(subbuckets=4))
+
+
+def test_merge_quantile_equals_per_worker_fold():
+    """The acceptance identity: quantiles of the merged histogram are
+    what you get folding per-worker snapshots through from_snapshot —
+    the fleet-wide view IS the merge of the worker views."""
+    workers = [_fill(s, 250) for s in (5, 6, 7)]
+    merged = workers[0].merge(workers[1]).merge(workers[2])
+    refold = HistogramCounter()
+    for w in workers:
+        refold = refold.merge(
+            HistogramCounter.from_snapshot(w.snapshot()))
+    for q in (0.5, 0.95, 0.99):
+        assert merged.quantile(q) == pytest.approx(
+            refold.quantile(q), rel=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# snapshot / delta / roundtrip
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_roundtrip():
+    h = _fill(9, 600)
+    snap = h.snapshot()
+    json.dumps(snap)                     # JSON-safe by contract
+    back = HistogramCounter.from_snapshot(snap)
+    assert back.snapshot() == snap
+    for q in (0.5, 0.99):
+        assert back.quantile(q) == pytest.approx(
+            h.quantile(q), rel=h.relative_error_bound())
+
+
+def test_empty_snapshot_roundtrip():
+    h = HistogramCounter()
+    snap = h.snapshot()
+    assert snap["min"] is None and snap["max"] is None
+    back = HistogramCounter.from_snapshot(snap)
+    assert back.count == 0 and back.quantile(0.5) == 0.0
+
+
+def test_delta_window():
+    h = HistogramCounter()
+    h.record(0.1)
+    prev = h.snapshot()
+    h.record(0.2)
+    h.record(0.4)
+    d = h.delta(prev)
+    assert d["count"] == 2
+    assert d["sum"] == pytest.approx(0.6)
+    win = HistogramCounter.from_snapshot(d)
+    assert win.count == 2
+    # delta counts + prev counts == current counts, bucket by bucket
+    cur = h.snapshot()
+    assert [p + w for p, w in zip(prev["counts"], d["counts"])] \
+        == cur["counts"]
+
+
+def test_record_timer_context():
+    h = HistogramCounter()
+    with h.record():
+        pass
+    assert h.count == 1
+    assert h.vmin >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# registry derivation + Prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def test_register_histogram_derives_quantile_counters():
+    h = HistogramCounter()
+    for v in (0.01, 0.02, 0.04, 0.08):
+        h.record(v)
+    names = register_histogram("serving", "latency/test-s", h,
+                               instance="t0")
+    try:
+        base = "/serving{locality#0/t0}/latency/test-s"
+        assert base in names
+        assert f"{base}/p50" in names and f"{base}/p99" in names
+        assert pc.query_counter(f"{base}/p99").value \
+            == pytest.approx(h.quantile(0.99))
+        # mean rides the base counter
+        assert pc.query_counter(base).value == pytest.approx(h.mean())
+        text = metrics.render_prometheus("/serving{locality#0/t0}/*")
+        assert "hpx_serving_latency_test_s_bucket" in text
+        assert 'le="+Inf"' in text
+        assert 'hpx_serving_latency_test_s_count' \
+               '{locality="0",instance="t0"} 4' in text
+    finally:
+        for n in names:
+            pc.unregister_counter(n)
+
+
+def test_registry_snapshot_shapes():
+    h = HistogramCounter()
+    h.record(0.5)
+    names = register_histogram("serving", "latency/snap-s", h,
+                               instance="t1")
+    try:
+        snap = metrics.registry_snapshot("/serving{locality#0/t1}/*")
+        base = "/serving{locality#0/t1}/latency/snap-s"
+        assert snap["histograms"][base]["count"] == 1
+        assert f"{base}/p50" in snap["counters"]
+        json.dumps(snap)
+    finally:
+        for n in names:
+            pc.unregister_counter(n)
+
+
+def test_dropped_spans_counter():
+    tr = tracing.start_tracing(capacity=4, sample_counters=False)
+    try:
+        for i in range(32):
+            with tracing.span(f"s{i}", "test"):
+                pass
+        got = pc.query_counter(
+            "/runtime{locality#0/total}/trace/dropped-spans").value
+        assert got > 0
+    finally:
+        tracing.stop_tracing()
+
+
+# ---------------------------------------------------------------------------
+# request timelines
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_capacity_drop_oldest():
+    tl = RequestTimeline(capacity=2)
+    tl.event("r0", "submit")
+    tl.event("r1", "submit")
+    tl.event("r0", "retire", tokens=3)
+    tl.event("r2", "submit")             # evicts r1 (oldest rid)
+    assert tl.dropped == 1
+    assert [e["name"] for e in tl.events("r0")] == ["submit",
+                                                    "retire"]
+    assert tl.events("r1") == []
+    assert len(tl) == 2
+    assert tl.events("r0")[1]["attrs"]["tokens"] == 3
+    json.dumps(tl.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# serving integration: live histograms + timeline on a tiny wave
+# ---------------------------------------------------------------------------
+
+import jax
+from hpx_tpu.models import transformer as tfm
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                            head_dim=8, n_layers=2, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_server_histograms_and_timeline(params):
+    from hpx_tpu.models.serving import ContinuousServer
+    srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    rids = [srv.submit([1, 2, 3, 4], max_new=4) for _ in range(3)]
+    srv.run()
+    assert srv.hist["ttft"].count == 3
+    assert srv.hist["e2e"].count == 3
+    assert srv.hist["queue_wait"].count == 3
+    for rid in rids:
+        names = [e["name"] for e in srv.timeline.events(rid)]
+        assert names[0] == "submit" and names[-1] == "retire"
+        assert "first_token" in names
+    srv.shutdown()
+
+
+def test_router_merged_hist_and_timeline(params):
+    from hpx_tpu.models.disagg import DisaggRouter
+    r = DisaggRouter(params, CFG, prefill_workers=1,
+                     decode_workers=2, slots=2, smax=64)
+    for i in range(4):
+        r.submit([1 + i, 2, 3, 4, 5, 6], max_new=3)
+    out = r.run()
+    r.close()
+    assert len(out) == 4
+    merged = r.merged_hist()
+    assert merged["ttft"].count == 4
+    assert merged["e2e"].count == 4
+    assert merged["queue_wait"].count == 4
+    # fleet-wide == fold of per-worker (the acceptance identity)
+    refold = latency_histograms()
+    for per in r.whist.values():
+        for k in refold:
+            refold[k] = refold[k].merge(per[k])
+    for k in refold:
+        assert refold[k].snapshot() == merged[k].snapshot()
+    names = [e["name"] for e in r.timeline.events("r0")]
+    assert names[0] == "submit"
+    assert "place" in names and "retire" in names
+    st = r.stats()
+    assert st["latency"]["ttft"]["p99"] == pytest.approx(
+        merged["ttft"].quantile(0.99))
+
+
+# ---------------------------------------------------------------------------
+# cross-worker trace stitching on a live 2-decode-worker fleet
+# ---------------------------------------------------------------------------
+
+
+def test_merge_traces_stitches_fleet(params):
+    from hpx_tpu.svc.fleet import FleetRouter
+    from hpx_tpu.svc.trace_export import (merge_traces,
+                                          to_chrome_trace,
+                                          validate_chrome_trace)
+    tracer = tracing.start_tracing(sample_counters=False)
+    try:
+        r = FleetRouter(params, CFG, prefill_workers=1,
+                        decode_workers=2, slots=2, smax=64)
+        for i in range(4):
+            r.submit([1 + i, 2, 3, 4, 5, 6], max_new=3)
+        out = r.run()
+        worker_docs = r.worker_trace_docs()
+        r.close()
+    finally:
+        tracing.stop_tracing()
+    assert len(out) == 4
+    assert len(worker_docs) >= 2          # 1 prefill + >=1 decode ring
+    router_doc = to_chrome_trace(
+        tracer.snapshot(), tracer.thread_names(), tracer.t0,
+        tracer.dropped, t0_wall=tracer.t0_wall)
+    doc = merge_traces([("router", router_doc)] + worker_docs)
+    assert validate_chrome_trace(doc) == []
+    evs = doc["traceEvents"]
+    assert len({e["pid"] for e in evs}) >= 3
+    # >=1 placed request's flow arrows cross worker pid rows
+    flows = [e for e in evs if e.get("cat") == "rid"]
+    starts = {e["id"]: e for e in flows if e["ph"] == "s"}
+    crossing = [e for e in flows if e["ph"] == "f"
+                and e["pid"] != starts[e["id"]]["pid"]]
+    assert crossing, "no rid flow arrow crosses a worker pid row"
+    assert doc["otherData"]["stitched_rids"] >= 4
+    assert doc["otherData"]["processes"][0] == "router"
+    # per-process clock alignment kept ts monotone overall (metadata
+    # M rows carry no ts)
+    ts = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# serving_bench --metrics-out artifact schema
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_artifact_schema(tmp_path):
+    import serving_bench
+    h = _fill(21, 50)
+    doc = serving_bench.metrics_artifact(
+        {"wave/ttft": h}, counters={"/x{locality#0/total}/y": 1.0})
+    assert doc["schema"] == serving_bench.METRICS_SCHEMA == \
+        "hpx_tpu.metrics.v1"
+    ent = doc["histograms"]["wave/ttft"]
+    assert ent["quantiles"]["p99"] == pytest.approx(h.quantile(0.99))
+    assert ent["relative_error_bound"] == pytest.approx(
+        h.relative_error_bound())
+    back = HistogramCounter.from_snapshot(ent["snapshot"])
+    assert back.count == h.count
+    path = tmp_path / "m.json"
+    serving_bench.write_metrics_artifact(str(path), doc)
+    assert json.load(open(path)) == json.loads(json.dumps(doc))
