@@ -726,6 +726,30 @@ _TRACE_ENV = "_HPX_BENCH_TRACE_OUT"
 _METRICS_ENV = "_HPX_BENCH_METRICS_OUT"
 
 
+def _run_slo_gate(baseline: str) -> None:
+    """--baseline: gate this round's --metrics-out artifact against a
+    previous round's with benchmarks/slo_gate.py (bounded-error
+    quantile comparison). Verdicts go to stderr — stdout stays a pure
+    metric stream with the headline last — and a regression exits 1."""
+    cand = os.environ.get(_METRICS_ENV)
+    if not cand or not os.path.exists(cand):
+        print("# --baseline given but no --metrics-out artifact to "
+              "gate; skipped", file=sys.stderr)
+        return
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import slo_gate
+    try:
+        verdicts = slo_gate.compare(slo_gate.load_artifact(baseline),
+                                    slo_gate.load_artifact(cand))
+    except (OSError, ValueError) as e:
+        print(f"# slo gate unreadable input: {e}", file=sys.stderr)
+        return
+    print(slo_gate.render_text(verdicts), file=sys.stderr)
+    if slo_gate.regressions(verdicts):
+        sys.exit(1)
+
+
 def main() -> None:
     # parsed in the PARENT and forwarded via env — the bounded child is
     # spawned without argv
@@ -735,6 +759,9 @@ def main() -> None:
     if "--metrics-out" in sys.argv:
         os.environ[_METRICS_ENV] = os.path.abspath(
             sys.argv[sys.argv.index("--metrics-out") + 1])
+    baseline = os.path.abspath(
+        sys.argv[sys.argv.index("--baseline") + 1]) \
+        if "--baseline" in sys.argv else None
     if os.environ.get(_CHILD_ENV) == "1":
         return _bench_main()
 
@@ -849,6 +876,8 @@ def main() -> None:
                 # lines just pushed it off the last stdout line (the one
                 # the driver parses) — re-emit it so fresh data wins
                 print(live_headline[0], flush=True)
+        if baseline:
+            _run_slo_gate(baseline)
         return
     # child died or hung mid-run: fill the gaps from the last good run,
     # keeping the original emission order (headline last). The marker

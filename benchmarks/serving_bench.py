@@ -200,6 +200,13 @@ def main() -> int:
 
     metrics_out = sys.argv[sys.argv.index("--metrics-out") + 1] \
         if "--metrics-out" in sys.argv else None
+    # --metrics-out implies the per-program profiler: the artifact's
+    # "programs" section is the roofline/compile-time table ROADMAP
+    # items 3/4 consume
+    profiler = None
+    if metrics_out:
+        from hpx_tpu.svc import progprof
+        profiler = progprof.start_profiling()
     # live HistogramCounters the waves hand to finish() for the
     # --metrics-out artifact, keyed "<bench>/<metric>"
     collected_hists = {}
@@ -884,11 +891,18 @@ def main() -> int:
             reg = svc_metrics.registry_snapshot("*")
             doc = metrics_artifact(collected_hists,
                                    counters=reg["counters"])
+            if profiler is not None:
+                from hpx_tpu.svc import progprof
+                doc["programs"] = profiler.profile_table()
+                progprof.stop_profiling()
             write_metrics_artifact(metrics_out, doc)
             print(json.dumps({
                 "metrics": os.path.abspath(metrics_out),
                 "schema": doc["schema"],
                 "histograms": len(doc["histograms"]),
+                "programs": len(doc.get("programs", {})
+                                .get("programs", []))
+                if profiler is not None else 0,
             }), flush=True)
         return 0
 

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""SLO regression gate over two ``hpx_tpu.metrics.v1`` artifacts.
+
+"p99 regressed" from eyeballing two JSON files is neither typed nor
+sound: the histograms behind the artifacts answer quantiles with a
+KNOWN relative error bound (``gamma**0.5 - 1``, ~4.4% at the default
+8 subbuckets/octave), so two estimates within their combined bounds
+are indistinguishable, not a regression.  This gate compares the two
+artifacts quantile-by-quantile and flags a regression only when the
+candidate's most-favorable true value still exceeds the baseline's
+least-favorable one::
+
+    cand_q / (1 + eb_cand)  >  base_q * (1 + eb_base)
+
+Histograms are rebuilt from their mergeable snapshots (both the
+serving_bench shape — snapshot + quantiles + relative_error_bound —
+and bench.py's snapshot-only child shape load), so quantiles are
+recomputed consistently even across artifacts written by different
+quantile sets.
+
+Usage::
+
+    python benchmarks/slo_gate.py BASELINE CANDIDATE \
+        [--quantiles 0.5,0.95,0.99] [--format text|json]
+
+Exit status: 0 = no regression, 1 = at least one regression, 2 = bad
+input.  ``bench.py --baseline PREV`` runs this automatically against
+the round's ``--metrics-out`` artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from hpx_tpu.svc.metrics import HistogramCounter  # noqa: E402
+
+METRICS_SCHEMA = "hpx_tpu.metrics.v1"
+DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
+
+# verdict kinds, worst first (report ordering)
+KIND_REGRESSED = "regressed"
+KIND_OK = "ok"
+KIND_IMPROVED = "improved"
+KIND_INCOMPARABLE = "incomparable"
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One (histogram, quantile) comparison — a typed, bounded-error
+    statement, not a raw diff."""
+
+    name: str
+    quantile: str               # "p99"
+    kind: str                   # regressed | ok | improved | incomparable
+    baseline: float
+    candidate: float
+    error_bound: float          # combined relative bound used
+    margin: float               # cand/base - 1 (0 when incomparable)
+    note: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("schema") != METRICS_SCHEMA:
+        raise ValueError(
+            f"{path}: schema {doc.get('schema')!r} != {METRICS_SCHEMA!r}")
+    if not isinstance(doc.get("histograms"), dict):
+        raise ValueError(f"{path}: no histograms section")
+    return doc
+
+
+def _rebuild(entry: Dict[str, Any]) -> Optional[HistogramCounter]:
+    snap = entry.get("snapshot") if isinstance(entry, dict) else None
+    if not isinstance(snap, dict):
+        return None
+    try:
+        return HistogramCounter.from_snapshot(snap)
+    except Exception:  # noqa: BLE001 — malformed entry → incomparable
+        return None
+
+
+def _qlabel(q: float) -> str:
+    return f"p{round(q * 100.0, 4):g}"
+
+
+def compare(base_doc: Dict[str, Any], cand_doc: Dict[str, Any],
+            quantiles: Tuple[float, ...] = DEFAULT_QUANTILES
+            ) -> List[Verdict]:
+    """Quantile-by-quantile verdicts over the union of histogram
+    names.  Names present on only one side are ``incomparable`` info
+    rows, never regressions (a renamed wave must not masquerade as a
+    perf win)."""
+    base_h = base_doc["histograms"]
+    cand_h = cand_doc["histograms"]
+    verdicts: List[Verdict] = []
+    for name in sorted(set(base_h) | set(cand_h)):
+        if name not in base_h or name not in cand_h:
+            side = "baseline" if name not in cand_h else "candidate"
+            verdicts.append(Verdict(
+                name=name, quantile="*", kind=KIND_INCOMPARABLE,
+                baseline=0.0, candidate=0.0, error_bound=0.0,
+                margin=0.0, note=f"only in {side}"))
+            continue
+        hb = _rebuild(base_h[name])
+        hc = _rebuild(cand_h[name])
+        if hb is None or hc is None:
+            verdicts.append(Verdict(
+                name=name, quantile="*", kind=KIND_INCOMPARABLE,
+                baseline=0.0, candidate=0.0, error_bound=0.0,
+                margin=0.0, note="unreadable snapshot"))
+            continue
+        if not hb.count or not hc.count:
+            verdicts.append(Verdict(
+                name=name, quantile="*", kind=KIND_INCOMPARABLE,
+                baseline=float(hb.count), candidate=float(hc.count),
+                error_bound=0.0, margin=0.0,
+                note="empty histogram"))
+            continue
+        eb = hb.relative_error_bound()
+        ec = hc.relative_error_bound()
+        for q in quantiles:
+            vb = hb.quantile(q)
+            vc = hc.quantile(q)
+            margin = (vc / vb - 1.0) if vb > 0.0 else 0.0
+            if vb > 0.0 and vc / (1.0 + ec) > vb * (1.0 + eb):
+                kind = KIND_REGRESSED
+            elif vb > 0.0 and vc * (1.0 + ec) < vb / (1.0 + eb):
+                kind = KIND_IMPROVED
+            else:
+                kind = KIND_OK
+            verdicts.append(Verdict(
+                name=name, quantile=_qlabel(q), kind=kind,
+                baseline=vb, candidate=vc,
+                error_bound=(1.0 + eb) * (1.0 + ec) - 1.0,
+                margin=margin))
+    return verdicts
+
+
+def regressions(verdicts: List[Verdict]) -> List[Verdict]:
+    return [v for v in verdicts if v.kind == KIND_REGRESSED]
+
+
+def render_text(verdicts: List[Verdict]) -> str:
+    order = {KIND_REGRESSED: 0, KIND_IMPROVED: 1, KIND_OK: 2,
+             KIND_INCOMPARABLE: 3}
+    lines = []
+    for v in sorted(verdicts, key=lambda v: (order.get(v.kind, 9),
+                                             v.name, v.quantile)):
+        if v.kind == KIND_INCOMPARABLE:
+            lines.append(f"?  {v.name} {v.quantile}: {v.note}")
+        else:
+            mark = {"regressed": "✗", "improved": "✓", "ok": "="}[v.kind]
+            lines.append(
+                f"{mark}  {v.name} {v.quantile}: "
+                f"{v.baseline:.6g} -> {v.candidate:.6g} "
+                f"({v.margin:+.1%}, bound ±{v.error_bound:.1%}) "
+                f"{v.kind}")
+    n_reg = len(regressions(verdicts))
+    lines.append(f"regressions: {n_reg}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bounded-error SLO regression gate over two "
+                    "hpx_tpu.metrics.v1 artifacts")
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument("--quantiles", default=None,
+                    help="csv quantiles (default 0.5,0.95,0.99)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    args = ap.parse_args(argv)
+    try:
+        base = load_artifact(args.baseline)
+        cand = load_artifact(args.candidate)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"slo_gate: {e}", file=sys.stderr)
+        return 2
+    qs = DEFAULT_QUANTILES
+    if args.quantiles:
+        qs = tuple(float(p) for p in args.quantiles.split(",") if p)
+    verdicts = compare(base, cand, qs)
+    if args.format == "json":
+        print(json.dumps({
+            "baseline": args.baseline,
+            "candidate": args.candidate,
+            "regressions": len(regressions(verdicts)),
+            "verdicts": [v.to_dict() for v in verdicts],
+        }, indent=1))
+    else:
+        print(render_text(verdicts))
+    return 1 if regressions(verdicts) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

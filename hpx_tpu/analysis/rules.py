@@ -978,3 +978,89 @@ class CounterNameDiscipline(Rule):
                             "registry grammar /object{locality#N/"
                             "instance}/counter — it raises at first "
                             "query, not at registration")
+
+
+@register
+class ProgramCacheBypassRule(Rule):
+    """HPX017: raw ``jax.jit`` in a models/ops hot path outside the
+    profiled program-cache funnel.
+
+    Every jit-program the serving stack builds flows through
+    ``core.programs.cached_program`` (via a module's
+    ``_cached_program`` / ``self._program`` wrapper) — the single
+    funnel where the per-program profiler (``svc/progprof``)
+    interposes to account compile wall time, per-call latency, and
+    roofline fraction.  A raw ``jax.jit(...)`` (or ``@jax.jit``
+    decorator) in ``models/`` or ``ops/`` builds a program the
+    profiler and the ``/programs{...}`` counters can never see — its
+    compiles and calls vanish from the --metrics-out artifact and
+    every flight bundle.  Fix: build the program inside a builder
+    handed to ``cached_program()`` (or the module's wrapper); truly
+    one-shot or demo programs get a baseline entry with justification.
+    """
+
+    id = "HPX017"
+    name = "program-cache-bypass"
+    severity = "warning"
+
+    _SCOPE = ("hpx_tpu/models/", "hpx_tpu/ops/")
+    _JITS = ("jax.jit", "jax.pjit")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_subpath(*self._SCOPE):
+            return
+
+        # builders sanctioned by being handed to a program-cache
+        # callee: lambdas passed directly in the argument list, plus
+        # local functions referenced there by name
+        sanctioned_lambdas: Set[int] = set()
+        sanctioned_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = (fn.attr if isinstance(fn, ast.Attribute)
+                      else fn.id if isinstance(fn, ast.Name) else "")
+            if callee not in _PROGRAM_CACHE_CALLEES:
+                continue
+            for arg in list(node.args) + \
+                    [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    sanctioned_lambdas.add(id(arg))
+                elif isinstance(arg, ast.Name):
+                    sanctioned_names.add(arg.id)
+
+        def is_jit(node: ast.AST) -> bool:
+            return isinstance(node, (ast.Name, ast.Attribute)) and \
+                ctx.resolve_call(node) in self._JITS
+
+        out: List[Finding] = []
+
+        def hit(node: ast.AST, scope: str) -> None:
+            out.append(self.finding(
+                ctx, node,
+                f"raw jax.jit in {scope}() bypasses the profiled "
+                "program cache — svc/progprof never sees its compile "
+                "time or per-call cost; build it inside a "
+                "core.programs.cached_program() builder, or baseline "
+                "a genuinely one-shot program with a justification"))
+
+        def walk(node: ast.AST, scope: str, ok: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_scope, child_ok = scope, ok
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_scope = child.name
+                    child_ok = ok or child.name in sanctioned_names
+                    for dec in child.decorator_list:
+                        if not child_ok and is_jit(dec):
+                            hit(dec, child.name)
+                elif isinstance(child, ast.Lambda):
+                    child_ok = ok or id(child) in sanctioned_lambdas
+                if isinstance(child, ast.Call) and not child_ok \
+                        and is_jit(child.func):
+                    hit(child, child_scope)
+                walk(child, child_scope, child_ok)
+
+        walk(ctx.tree, "<module>", False)
+        yield from out

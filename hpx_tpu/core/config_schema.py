@@ -306,7 +306,7 @@ declare("hpx.trace.buffer_events", "int", "65536",
         "ring capacity (drop-oldest)")
 declare("hpx.trace.counter_interval", "float", "0.05",
         "s between counter samples")
-declare("hpx.trace.counters", "str", "/serving*,/cache*,/threads*",
+declare("hpx.trace.counters", "str", "/serving*,/cache*,/threads*,/programs*",
         "csv counter patterns sampled into the trace")
 
 # -- metrics (svc/metrics histograms + timelines) ---------------------------
@@ -322,6 +322,28 @@ declare("hpx.metrics.quantiles", "str", "0.5,0.95,0.99",
         "csv quantiles derived as .../pNN counters per histogram")
 declare("hpx.metrics.timeline_capacity", "int", "1024",
         "rids retained per RequestTimeline (drop-oldest)")
+
+# -- program profiler (svc/progprof) ----------------------------------------
+declare("hpx.prof.programs", "bool", "0",
+        "per-program continuous profiler: wrap every cached_program() "
+        "build in a timing/cost-accounting proxy")
+declare("hpx.prof.cost_analysis", "bool", "1",
+        "query XLA cost analysis (FLOPs / bytes accessed) on first call "
+        "of each profiled program")
+declare("hpx.prof.peak_gflops", "float", "0",
+        "roofline denominator in GFLOP/s (0 = infer from device kind; "
+        "unknown kinds report roofline fraction 0)")
+
+# -- flight recorder (svc/flight) -------------------------------------------
+declare("hpx.flight.enabled", "bool", "1",
+        "fault flight recorder master switch (lazy: allocates nothing "
+        "until a fault capture fires)")
+declare("hpx.flight.dir", "str", "auto",
+        "directory for flight bundles (auto = <tmpdir>/hpx_tpu_flight)")
+declare("hpx.flight.max_bundles", "int", "8",
+        "bundles retained on disk (oldest pruned first)")
+declare("hpx.flight.spans", "int", "256",
+        "last-N trace spans captured into each bundle")
 
 # -- checkpoint / resiliency / exec -----------------------------------------
 declare("hpx.checkpoint.dir", "str", "./checkpoints",

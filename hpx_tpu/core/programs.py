@@ -11,12 +11,33 @@ collide across subsystems.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
+
+# Installed by svc/progprof when the per-program profiler is active.
+# None keeps the hot path identical to the unprofiled memo: cache hits
+# never see the hook (the wrapped program is what got stored), and a
+# miss pays one extra None-check.
+_profile_hook: Optional[Callable[[Any, Callable[[], Any]], Any]] = None
+
+
+def set_profile_hook(
+        hook: Optional[Callable[[Any, Callable[[], Any]], Any]]) -> None:
+    """Install (or clear, with None) the build-interposer the program
+    profiler uses to time compiles and wrap programs for per-call
+    accounting. The hook receives ``(key, build)`` and must return the
+    value to cache — normally a callable proxy around ``build()``."""
+    global _profile_hook
+    _profile_hook = hook
+
+
+def profile_hook() -> Optional[Callable[[Any, Callable[[], Any]], Any]]:
+    return _profile_hook
 
 
 def cached_program(cache: Dict[Any, Any], key: Any,
                    build: Callable[[], Any]) -> Any:
     prog = cache.get(key)
     if prog is None:
-        prog = cache[key] = build()
+        hook = _profile_hook
+        prog = cache[key] = build() if hook is None else hook(key, build)
     return prog

@@ -65,7 +65,7 @@ from ..cache.transfer import (KVSegment, TransferCorruptError,
                               TransferReceiver, make_segment)
 from ..core.errors import (Error, FutureError, HpxError, LocalityLost,
                            NetworkError)
-from ..svc import faultinject, tracing
+from ..svc import faultinject, flight, tracing
 from ..svc import metrics as _metrics
 from ..svc.resiliency import sync_replay
 from .serving import (ContinuousServer, RequestShedError,
@@ -650,8 +650,11 @@ class DisaggRouter:
     def _shed(self, req: _RouterReq, reason: str) -> None:
         req.state = "failed"
         req.segments = []
-        self.failed[req.rid] = RequestShedError(req.rid, reason)
+        err = RequestShedError(req.rid, reason)
+        self.failed[req.rid] = err
         self.shed += 1
+        flight.record_fault("shed", site="disagg", rid=req.grid,
+                            error=err, timeline=self.timeline)
 
     # -- the step loop ----------------------------------------------------
 
@@ -938,6 +941,7 @@ class DisaggRouter:
         if h.alive:
             h.alive = False
         self.failovers[h.role] += 1
+        flight.record_fault("failover", site=h.role, error=cause)
         if not self._alive(self._prefill) \
                 or not self._alive(self._decode):
             self._degrade()
@@ -995,6 +999,7 @@ class DisaggRouter:
         if self._degraded:
             return
         self._degraded = True
+        flight.record_fault("degrade", site="disagg")
         self._local = ContinuousServer(
             self.params, self.cfg, slots=self.slots, smax=self.smax,
             paged=True, **self._srv_kwargs)

@@ -85,7 +85,7 @@ from ..cache.ngram import propose as _ngram_propose
 from ..cache.page_table import PageTable, materialize, occupancy
 from ..cache.radix import RadixCache
 from ..core.errors import Error, HpxError
-from ..svc import faultinject, tracing
+from ..svc import faultinject, flight, tracing
 from ..svc.resiliency import sync_replay
 from ..ops.attention_pallas import resolve_paged_block
 from ..ops.paged_attention import (
@@ -861,6 +861,9 @@ class ContinuousServer:
         self._flt_restored = 0
         self._flt_shed = 0
         self._flt_degraded = 0
+        # True while a bulk shed (retry exhaustion) records ONE
+        # aggregate flight bundle instead of one per shed request
+        self._flight_mute = False
         self._restored_by_site: Dict[str, int] = {}
         # SLO latency distributions (svc/metrics): live log-bucketed
         # histograms, one per family, registered (with derived pNN
@@ -2426,6 +2429,9 @@ class ContinuousServer:
             self.failed[req.rid] = err
             self._admit_defers.pop(req.rid, None)
             self._flt_shed += 1
+        if not self._flight_mute:
+            flight.record_fault("shed", site="serving",
+                                rid=req.rid, error=err)
 
     def _shed_expired(self) -> None:
         """Deadline policy: a queued or still-prefilling request whose
@@ -2460,21 +2466,30 @@ class ContinuousServer:
         spinning on a fault that recovery could not clear."""
         self._flush()
         reason = f"step retries exhausted ({exc})"
-        for s in range(self.slots):
-            req = self._slot_req[s]
-            if req is None:
-                continue
-            self._slot_req[s] = None
-            self._drop_ckpt(s)
-            if self.paged:
-                self._release_slot(s, req)
-            self._shed_req(req, RequestShedError(req.rid, reason))
-        for s in list(self._pending):
-            p = self._drop_pending(s)
-            self._shed_req(p.req, RequestShedError(p.req.rid, reason))
-        while self._queue:
-            q = self._queue.popleft()
-            self._shed_req(q, RequestShedError(q.rid, reason))
+        # sync_replay already black-boxed this exhaustion (one
+        # "retry-exhausted" bundle at the pre-unwind moment); mute the
+        # per-request shed captures below so a bulk shed stays ONE
+        # bundle, not one per request
+        self._flight_mute = True
+        try:
+            for s in range(self.slots):
+                req = self._slot_req[s]
+                if req is None:
+                    continue
+                self._slot_req[s] = None
+                self._drop_ckpt(s)
+                if self.paged:
+                    self._release_slot(s, req)
+                self._shed_req(req, RequestShedError(req.rid, reason))
+            for s in list(self._pending):
+                p = self._drop_pending(s)
+                self._shed_req(p.req,
+                               RequestShedError(p.req.rid, reason))
+            while self._queue:
+                q = self._queue.popleft()
+                self._shed_req(q, RequestShedError(q.rid, reason))
+        finally:
+            self._flight_mute = False
         self._cur_dev = None
         self._temp_dev = None
         self._keys_dev = None

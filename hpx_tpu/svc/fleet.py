@@ -74,7 +74,7 @@ from ..cache.transfer import make_segment
 from ..models.disagg import (DisaggRouter, InProcHandle, WorkerHandle,
                              _WorkerDown)
 from ..synchronization import Mutex
-from . import tracing
+from . import flight, tracing
 
 __all__ = ["FleetRouter"]
 
@@ -370,6 +370,8 @@ class FleetRouter(DisaggRouter):
         if not others:
             h.draining = False      # nowhere to hand off: drain aborts
             return
+        flight.record_fault("autoscale-drain", site="fleet",
+                            timeline=self.timeline)
         if h.alive:
             affected = sorted(
                 (r for r in self._reqs.values()

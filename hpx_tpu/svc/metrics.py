@@ -55,6 +55,8 @@ __all__ = [
     "configured_quantiles",
     "render_prometheus",
     "registry_snapshot",
+    "timeline_dropped_entries",
+    "reset_timeline_dropped",
 ]
 
 # the latency families threaded through ContinuousServer / DisaggRouter
@@ -358,9 +360,11 @@ class RequestTimeline:
             ev["attrs"] = attrs
         lst = self._rids.get(rid)
         if lst is None:
+            global _timeline_dropped
             while len(self._rids) >= self.capacity:
                 self._rids.popitem(last=False)
                 self.dropped += 1
+                _timeline_dropped += 1
             lst = self._rids[rid] = []
         else:
             self._rids.move_to_end(rid)
@@ -374,6 +378,22 @@ class RequestTimeline:
 
     def snapshot(self) -> Dict[Any, List[Dict[str, Any]]]:
         return {rid: list(evs) for rid, evs in self._rids.items()}
+
+
+# process-wide LRU-eviction total across every RequestTimeline, read by
+# the /runtime{...}/timeline/dropped-entries builtin (parallel to
+# trace/dropped-spans) — per-instance counts stay on each timeline's
+# ``dropped``.  GIL-atomic int bump, same discipline as Tracer.dropped.
+_timeline_dropped = 0
+
+
+def timeline_dropped_entries() -> int:
+    return _timeline_dropped
+
+
+def reset_timeline_dropped() -> None:
+    global _timeline_dropped
+    _timeline_dropped = 0
 
 
 # ---------------------------------------------------------------------------

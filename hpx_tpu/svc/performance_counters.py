@@ -492,6 +492,22 @@ def _register_builtins() -> None:
     put("runtime", "trace/dropped-spans",
         CallbackCounter(_dropped_spans))
 
+    # timeline health: whole per-rid timelines LRU-evicted across every
+    # RequestTimeline in the process (svc/metrics module aggregate —
+    # parallel to trace/dropped-spans).  Nonzero means post-mortems for
+    # those rids are gone — raise hpx.metrics.timeline_capacity.
+    # Import lazily: metrics imports this module at its top level.
+    def _timeline_dropped() -> float:
+        from . import metrics as _metrics
+        return float(_metrics.timeline_dropped_entries())
+
+    def _timeline_dropped_reset() -> None:
+        from . import metrics as _metrics
+        _metrics.reset_timeline_dropped()
+    put("runtime", "timeline/dropped-entries",
+        CallbackCounter(_timeline_dropped,
+                        reset_fn=_timeline_dropped_reset))
+
     # parcel layer (only once the distributed runtime is up). Read the
     # CURRENT runtime at query time: closing over the runtime object
     # alive at first registration would report frozen values (and pin a

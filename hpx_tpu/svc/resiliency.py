@@ -161,6 +161,11 @@ def sync_replay(n: Optional[int], fn: Callable[..., Any], *args: Any,
             raise
         except retry_on as e:
             last_exc = e
+    # replay budget exhausted: the caller's recovery could not clear
+    # the fault — black-box the moment before the raise unwinds state
+    from . import flight
+    flight.record_fault("retry-exhausted", site="sync_replay",
+                        error=last_exc)
     raise last_exc
 
 

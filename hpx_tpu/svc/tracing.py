@@ -36,7 +36,7 @@ Config (``core/config.py`` DEFAULTS, all under ``hpx.trace.*``)::
     hpx.trace.enabled          0        start_if_configured() gate
     hpx.trace.buffer_events    65536    ring capacity (drop-oldest)
     hpx.trace.counter_interval 0.05     seconds between counter samples
-    hpx.trace.counters         /serving*,/cache*,/threads*   patterns
+    hpx.trace.counters         /serving*,/cache*,/threads*,/programs*
 """
 
 from __future__ import annotations
@@ -437,7 +437,7 @@ def start_tracing(capacity: Optional[int] = None,
                                         0.05)
     if counter_patterns is None:
         raw = rc.get("hpx.trace.counters",
-                     "/serving*,/cache*,/threads*") or ""
+                     "/serving*,/cache*,/threads*,/programs*") or ""
         counter_patterns = [p.strip() for p in raw.split(",")
                             if p.strip()]
     tr = Tracer(capacity=capacity, counter_interval=counter_interval,

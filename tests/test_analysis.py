@@ -808,12 +808,91 @@ def test_hpx016_skips_tests():
                     path="tests/test_fixture.py") == []
 
 
+# ---------------------------------------------------------------------------
+# HPX017 — raw jit outside the profiled program-cache funnel
+# ---------------------------------------------------------------------------
+
+HPX017_BAD = """\
+import jax
+
+def decode_step(params, tok):
+    prog = jax.jit(lambda p, t: p @ t)
+    return prog(params, tok)
+"""
+
+HPX017_BAD_DECORATOR = """\
+import jax
+
+@jax.jit
+def decode_step(params, tok):
+    return params @ tok
+"""
+
+HPX017_GOOD = """\
+import jax
+from hpx_tpu.core.programs import cached_program
+
+_PROGRAMS = {}
+
+def _cached_program(key, build):
+    return cached_program(_PROGRAMS, key, build)
+
+def decode_step_lambda(params, tok):
+    prog = _cached_program(("step", 128),
+                           lambda: jax.jit(lambda p, t: p @ t))
+    return prog(params, tok)
+
+def decode_step_named(params, tok):
+    def build():
+        def step(p, t):
+            return p @ t
+        return jax.jit(step, donate_argnums=(0,))
+    prog = cached_program(_PROGRAMS, ("step2",), build)
+    return prog(params, tok)
+"""
+
+
+def test_hpx017_raw_jit_call():
+    fs = findings(HPX017_BAD, path="hpx_tpu/models/fixture.py")
+    assert rules_of(fs) == ["HPX017"]
+    assert "decode_step" in fs[0].message
+
+
+def test_hpx017_raw_jit_decorator():
+    fs = findings(HPX017_BAD_DECORATOR,
+                  path="hpx_tpu/models/fixture.py")
+    assert rules_of(fs) == ["HPX017"]
+
+
+def test_hpx017_silent_through_cache_funnel():
+    assert findings(HPX017_GOOD,
+                    path="hpx_tpu/models/fixture.py") == []
+
+
+def test_hpx017_scoped_to_models_and_ops():
+    # same source outside models//ops/ is silent — the funnel is a
+    # serving-hot-path discipline, not a repo-wide jit ban
+    assert findings(HPX017_BAD, path="hpx_tpu/svc/fixture.py") == []
+    fs = findings(HPX017_BAD, path="hpx_tpu/ops/fixture.py")
+    assert rules_of(fs) == ["HPX017"]
+
+
+def test_hpx017_github_gate_on_real_tree(capsys):
+    # the tier-1 gate invocation CI uses: the shipped tree must be
+    # clean under the baseline with --format=github (annotations would
+    # otherwise land on the PR)
+    assert cli_main([os.path.join(REPO, "hpx_tpu"),
+                     "--format=github"]) == 0
+    assert capsys.readouterr().out == ""
+
+
 def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
                    "HPX005", "HPX006", "HPX007", "HPX008",
                    "HPX009", "HPX010", "HPX011", "HPX012",
-                   "HPX013", "HPX014", "HPX015", "HPX016"]
+                   "HPX013", "HPX014", "HPX015", "HPX016",
+                   "HPX017"]
 
 
 def test_rule_registry_completeness(capsys):

@@ -1,0 +1,283 @@
+"""Fault flight recorder (svc/flight): schema-validated bundles on
+injected faults through the real serving shed path, zero-cost when
+disarmed (capture-count accounting, compile-guard style), bundle
+pruning, the never-raises contract, and the dump CLI.
+"""
+
+import contextlib
+import json
+import os
+
+import jax
+import pytest
+
+from hpx_tpu.core.config import runtime_config
+from hpx_tpu.models import transformer as tfm
+from hpx_tpu.models.serving import ContinuousServer, RequestShedError
+from hpx_tpu.svc import faultinject, flight, metrics, progprof, tracing
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=2, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture()
+def flight_dir(tmp_path):
+    """Point hpx.flight.dir at a per-test directory, reset capture
+    accounting, and restore both afterwards."""
+    cfg = runtime_config()
+    old = cfg.get("hpx.flight.dir", "auto")
+    cfg.set("hpx.flight.dir", str(tmp_path))
+    flight.reset_counts()
+    try:
+        yield str(tmp_path)
+    finally:
+        cfg.set("hpx.flight.dir", old)
+        flight.reset_counts()
+
+
+def _bundles(d):
+    return sorted(n for n in os.listdir(d)
+                  if n.startswith("flight-") and n.endswith(".json"))
+
+
+def _load(d, name):
+    with open(os.path.join(d, name)) as f:
+        return json.load(f)
+
+
+@contextlib.contextmanager
+def _inject(**kw):
+    fi = faultinject.install(faultinject.FaultInjector(**kw))
+    try:
+        yield fi
+    finally:
+        faultinject.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# direct capture: every section present and schema-valid
+# ---------------------------------------------------------------------------
+
+
+def test_record_fault_full_bundle(flight_dir):
+    tl = metrics.RequestTimeline(capacity=16)
+    tl.event("r7", "submit")
+    tl.event("r7", "shed", reason="test")
+    tracing.start_tracing(capacity=64, sample_counters=False)
+    prof = progprof.start_profiling(sample_memory=False)
+    try:
+        with tracing.span("warmup", "test"):
+            pass
+        path = flight.record_fault(
+            "shed", site="test", rid="r7",
+            error=RequestShedError("r7", "oom"), timeline=tl)
+    finally:
+        progprof.stop_profiling()
+        tracing.stop_tracing()
+    assert path is not None and os.path.dirname(path) == flight_dir
+    doc = _load(flight_dir, os.path.basename(path))
+    assert flight.validate_bundle(doc) == []
+    assert doc["schema"] == flight.FLIGHT_SCHEMA
+    trig = doc["trigger"]
+    assert trig["kind"] == "shed" and trig["site"] == "test"
+    assert trig["rid"] == "r7"
+    assert trig["error_type"] == "RequestShedError"
+    assert any(ev["name"] == "warmup" for ev in doc["spans"])
+    assert isinstance(doc["counters"]["histograms"], dict)
+    assert doc["counters"]["counters"]          # live registry folded
+    assert doc["config"]["hpx.flight.enabled"] == "1"
+    assert doc["programs"]["schema"] == progprof.PROFILE_SCHEMA
+    assert [e["name"] for e in doc["timeline"]] == ["submit", "shed"]
+    assert flight.capture_count() == 1
+    assert "shed" in os.path.basename(path)     # kind in the filename
+
+
+def test_bundle_with_counter_sample_events(flight_dir):
+    # "C" events carry a bare float where span events carry an args
+    # dict — the span decoder must not choke on them (regression:
+    # captures under a live counter sampler silently dropped)
+    tr = tracing.start_tracing(capacity=64, sample_counters=False)
+    try:
+        with tracing.span("work", "test"):
+            pass
+        tr.counter("/x{locality#0/total}/y", 42.0)
+        path = flight.record_fault("shed", site="test")
+    finally:
+        tracing.stop_tracing()
+    assert path is not None, "capture dropped"
+    assert flight.dropped_count() == 0
+    doc = _load(flight_dir, os.path.basename(path))
+    assert flight.validate_bundle(doc) == []
+    (c,) = [ev for ev in doc["spans"] if ev["ph"] == "C"]
+    assert c["args"] == 42.0
+
+
+def test_bundle_without_optionals_still_valid(flight_dir):
+    # no tracer, no profiler, no timeline: sections degrade to
+    # empty/null but the bundle stays schema-valid
+    path = flight.record_fault("degrade", site="disagg")
+    doc = _load(flight_dir, os.path.basename(path))
+    assert flight.validate_bundle(doc) == []
+    assert doc["spans"] == [] and doc["timeline"] == []
+    assert doc["programs"] is None
+
+
+def test_validate_bundle_catches_damage(flight_dir):
+    path = flight.record_fault("shed", site="test")
+    doc = _load(flight_dir, os.path.basename(path))
+    assert flight.validate_bundle(doc) == []
+    doc.pop("counters")
+    doc["schema"] = "bogus"
+    problems = flight.validate_bundle(doc)
+    assert any("schema" in p for p in problems)
+    assert any("counters" in p for p in problems)
+    assert flight.validate_bundle("nope") == ["bundle is not an object"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: an injected serving fault persists a valid bundle
+# ---------------------------------------------------------------------------
+
+
+def test_injected_shed_writes_valid_bundle(params, flight_dir):
+    # the admit-OOM ladder exhausts and sheds typed; the shed path
+    # must leave a post-mortem bundle behind
+    srv = ContinuousServer(params, CFG, slots=2, smax=64, paged=True,
+                           block_size=8, num_blocks=64,
+                           prefix_reuse=False)
+    rid = srv.submit([3, 1, 4], max_new=4)
+    with _inject(rate=1.0, sites=["alloc"], seed=1):
+        out = srv.run()
+    assert out == {}
+    assert isinstance(srv.failed[rid], RequestShedError)
+    assert flight.capture_count() >= 1
+    names = _bundles(flight_dir)
+    assert names
+    doc = _load(flight_dir, names[-1])
+    assert flight.validate_bundle(doc) == []
+    assert doc["trigger"]["kind"] == "shed"
+    assert doc["trigger"]["site"] == "serving"
+    assert doc["trigger"]["error_type"] == "RequestShedError"
+
+
+def test_retry_exhaustion_one_aggregate_bundle(params, flight_dir):
+    # _shed_everything sheds EVERY in-flight request but must record
+    # ONE aggregate retry-exhausted bundle, not one per request
+    srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    rids = [srv.submit([3, 1, 4], max_new=4),
+            srv.submit([2, 7], max_new=4),
+            srv.submit([5, 5, 5], max_new=4)]
+    with _inject(rate=1.0, sites=["decode"], seed=3):
+        out = srv.run()
+    assert out == {}
+    assert all(isinstance(srv.failed[r], RequestShedError)
+               for r in rids)
+    names = _bundles(flight_dir)
+    kinds = [_load(flight_dir, n)["trigger"]["kind"] for n in names]
+    assert kinds.count("retry-exhausted") == 1
+    assert "shed" not in kinds               # per-request sheds muted
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when disarmed
+# ---------------------------------------------------------------------------
+
+
+def test_fault_free_run_captures_nothing(params, flight_dir):
+    # compile-guard-style accounting: a clean serving run must not
+    # touch the recorder at all — zero captures, zero files
+    srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    srv.submit([3, 1, 4, 1, 5], max_new=6)
+    srv.submit([2, 7], max_new=4)
+    out = srv.run()
+    assert len(out) == 2 and srv.failed == {}
+    assert flight.capture_count() == 0
+    assert flight.dropped_count() == 0
+    assert _bundles(flight_dir) == []
+
+
+def test_disabled_records_nothing(flight_dir):
+    cfg = runtime_config()
+    cfg.set("hpx.flight.enabled", "0")
+    try:
+        assert flight.record_fault("shed", site="test") is None
+    finally:
+        cfg.set("hpx.flight.enabled", "1")
+    assert flight.capture_count() == 0
+    assert _bundles(flight_dir) == []
+
+
+# ---------------------------------------------------------------------------
+# robustness: pruning + the never-raises contract
+# ---------------------------------------------------------------------------
+
+
+def test_prune_keeps_max_bundles(flight_dir):
+    cfg = runtime_config()
+    cfg.set("hpx.flight.max_bundles", "2")
+    try:
+        paths = [flight.record_fault("shed", site="t")
+                 for _ in range(5)]
+    finally:
+        cfg.set("hpx.flight.max_bundles", "8")
+    assert all(p is not None for p in paths)
+    names = _bundles(flight_dir)
+    assert len(names) == 2
+    # the survivors are the newest captures
+    assert os.path.basename(paths[-1]) in names
+
+
+def test_broken_dir_never_raises(tmp_path):
+    cfg = runtime_config()
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("")
+    old = cfg.get("hpx.flight.dir", "auto")
+    cfg.set("hpx.flight.dir", str(blocker))
+    flight.reset_counts()
+    try:
+        assert flight.record_fault("shed", site="t") is None
+        assert flight.dropped_count() == 1
+        assert flight.capture_count() == 0
+    finally:
+        cfg.set("hpx.flight.dir", old)
+        flight.reset_counts()
+
+
+def test_auto_dir_resolves_to_tmpdir():
+    import tempfile
+    cfg = runtime_config()
+    assert cfg.get("hpx.flight.dir", "auto") == "auto"
+    assert flight.flight_dir() == os.path.join(
+        tempfile.gettempdir(), "hpx_tpu_flight")
+
+
+# ---------------------------------------------------------------------------
+# dump CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_dump_to_out(flight_dir, tmp_path, capsys):
+    out = tmp_path / "manual.json"
+    rc = flight.main(["dump", "--out", str(out)])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == str(out)
+    with open(out) as f:
+        doc = json.load(f)
+    assert flight.validate_bundle(doc) == []
+    assert doc["trigger"] == {"kind": "manual", "site": "cli",
+                              "rid": None, "error_type": None,
+                              "error": None}
+
+
+def test_cli_dump_default_dir(flight_dir, capsys):
+    rc = flight.main(["dump"])
+    assert rc == 0
+    path = capsys.readouterr().out.strip()
+    assert os.path.dirname(path) == flight_dir
+    assert flight.validate_bundle(_load(
+        flight_dir, os.path.basename(path))) == []

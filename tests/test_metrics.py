@@ -394,3 +394,159 @@ def test_metrics_artifact_schema(tmp_path):
     path = tmp_path / "m.json"
     serving_bench.write_metrics_artifact(str(path), doc)
     assert json.load(open(path)) == json.loads(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition edge cases (exact text-format contract)
+# ---------------------------------------------------------------------------
+
+
+def _prom_register(h, instance):
+    name = pc.counter_name("test", "prom/edge-s", instance)
+    pc.register_counter(name, h)
+    return name
+
+
+def test_prometheus_empty_registry_renders_empty():
+    # no matches: empty string, no stray trailing newline
+    assert metrics.render_prometheus("/no-such{locality#0/x}/*") == ""
+
+
+def test_prometheus_empty_histogram_exact_text():
+    # zero samples still expose the full histogram family — TYPE, the
+    # unconditional +Inf bucket, _sum and _count, all zero — so a
+    # scrape can tell "registered but idle" from "absent"
+    name = _prom_register(HistogramCounter(), "pe0")
+    try:
+        text = metrics.render_prometheus(name)
+    finally:
+        pc.unregister_counter(name)
+    m = "hpx_test_prom_edge_s"
+    lab = '{locality="0",instance="pe0"}'
+    assert text == (
+        f"# TYPE {m} histogram\n"
+        f'{m}_bucket{{le="+Inf",locality="0",instance="pe0"}} 0\n'
+        f"{m}_sum{lab} 0\n"
+        f"{m}_count{lab} 0\n")
+
+
+def test_prometheus_single_sample_exact_text():
+    h = HistogramCounter()
+    h.record(0.25)
+    (idx,) = [i for i, n in enumerate(h.counts) if n]
+    le = h.bucket_upper(idx)
+    name = _prom_register(h, "pe1")
+    try:
+        text = metrics.render_prometheus(name)
+    finally:
+        pc.unregister_counter(name)
+    m = "hpx_test_prom_edge_s"
+    lab = '{locality="0",instance="pe1"}'
+    assert text == (
+        f"# TYPE {m} histogram\n"
+        f'{m}_bucket{{le="{le:.9g}",locality="0",instance="pe1"}} 1\n'
+        f'{m}_bucket{{le="+Inf",locality="0",instance="pe1"}} 1\n'
+        f"{m}_sum{lab} 0.25\n"
+        f"{m}_count{lab} 1\n")
+
+
+def test_prometheus_inf_bucket_cumulative():
+    # bucket rows are cumulative and the +Inf row always equals the
+    # total count — even though the overflow bucket itself is empty
+    h = HistogramCounter()
+    for v in (0.001, 0.001, 1.0, 100.0):
+        h.record(v)
+    name = _prom_register(h, "pe2")
+    try:
+        text = metrics.render_prometheus(name)
+    finally:
+        pc.unregister_counter(name)
+    rows = [ln for ln in text.splitlines() if "_bucket{" in ln]
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in rows]
+    assert cums == sorted(cums)                  # cumulative
+    assert cums == [2, 3, 4, 4]                  # 3 occupied + +Inf
+    assert rows[-1].startswith(
+        'hpx_test_prom_edge_s_bucket{le="+Inf"')
+    # exactly one TYPE line, declared before any sample row
+    assert text.splitlines()[0] == "# TYPE hpx_test_prom_edge_s " \
+                                   "histogram"
+    assert text.count("# TYPE") == 1
+    assert text.endswith("\n")
+
+
+# ---------------------------------------------------------------------------
+# timeline LRU eviction counter
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_dropped_entries_counter():
+    metrics.reset_timeline_dropped()
+    tl = RequestTimeline(capacity=2)
+    for i in range(5):
+        tl.event(f"rid{i}", "submit")
+    assert tl.dropped == 3
+    name = "/runtime{locality#0/total}/timeline/dropped-entries"
+    assert pc.query_counter(name).value == 3.0
+    # a second timeline adds to the same process-wide counter
+    tl2 = RequestTimeline(capacity=1)
+    tl2.event("a", "submit")
+    tl2.event("b", "submit")
+    assert pc.query_counter(name).value == 4.0
+    # surfaced by registry_snapshot for artifacts/bundles
+    snap = metrics.registry_snapshot(
+        "/runtime{locality#0/total}/timeline/*")
+    assert snap["counters"][name] == 4.0
+    # reset=True routes to reset_timeline_dropped
+    assert pc.query_counter(name, reset=True).value == 4.0
+    assert pc.query_counter(name).value == 0.0
+    assert metrics.timeline_dropped_entries() == 0
+
+
+# ---------------------------------------------------------------------------
+# TaskTimer.top() under concurrent mutation (regression)
+# ---------------------------------------------------------------------------
+
+
+def test_task_timer_top_concurrent_mutation():
+    # top() must snapshot under the timer's lock: iterating stats
+    # while on_stop() inserts new names from worker threads would
+    # raise "dictionary changed size during iteration" (and could
+    # tear a [count, total] pair mid-update)
+    import threading
+    from hpx_tpu.svc.profiling import TaskTimer
+
+    t = TaskTimer()
+    stop = threading.Event()
+    errs = []
+
+    def writer(wid):
+        i = 0
+        while not stop.is_set():
+            def fn():
+                pass
+            fn.__qualname__ = f"task_{wid}_{i % 997}"
+            t.on_stop(fn, 0.001)
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(300):
+            try:
+                rows = t.top(k=5)
+            except Exception as e:  # noqa: BLE001 — the regression
+                errs.append(e)
+                break
+            assert len(rows) <= 5
+            for _name, count, total in rows:
+                assert count >= 1 and total > 0.0
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=5.0)
+    assert errs == []
+    # totals stay consistent once quiescent: count * 1ms == total
+    for _name, count, total in t.top(k=10**9):
+        assert total == pytest.approx(count * 0.001)

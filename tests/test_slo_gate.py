@@ -1,0 +1,227 @@
+"""SLO regression gate (benchmarks/slo_gate.py): self-comparison is
+regression-free by construction, a perturbation beyond the combined
+quantile error bound is flagged, one within it is not, and the CLI's
+exit codes + bench.py's --baseline wiring hold.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hpx_tpu.svc.metrics import HistogramCounter
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import slo_gate  # noqa: E402
+
+
+def _artifact(scales=(1.0,), names=("ttft",), n=400, seed=7):
+    """A minimal hpx_tpu.metrics.v1 artifact: deterministic lognormal
+    latencies per named histogram, scaled."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    doc = {"schema": slo_gate.METRICS_SCHEMA, "histograms": {}}
+    for name, scale in zip(names, list(scales) * len(names)):
+        h = HistogramCounter()
+        for x in rng.lognormal(mean=-3.0, sigma=0.5, size=n):
+            h.record(float(x) * scale)
+        doc["histograms"][name] = {
+            "snapshot": h.snapshot(),
+            "relative_error_bound": h.relative_error_bound(),
+        }
+    return doc
+
+
+def _kinds(verdicts):
+    return {(v.name, v.quantile): v.kind for v in verdicts}
+
+
+# ---------------------------------------------------------------------------
+# compare() semantics
+# ---------------------------------------------------------------------------
+
+
+def test_self_compare_zero_regressions():
+    doc = _artifact(names=("ttft", "decode_step", "e2e"))
+    verdicts = slo_gate.compare(doc, copy.deepcopy(doc))
+    assert verdicts                          # 3 names x 3 quantiles
+    assert slo_gate.regressions(verdicts) == []
+    assert all(v.kind == slo_gate.KIND_OK for v in verdicts)
+    assert all(v.margin == 0.0 for v in verdicts)
+
+
+def test_perturbed_p99_flagged():
+    base = _artifact()
+    # scale far beyond the combined bound ((1+e)^2-1 ~ 9% at default
+    # resolution): every quantile regresses, p99 included
+    cand = _artifact(scales=(1.5,))
+    verdicts = slo_gate.compare(base, cand)
+    kinds = _kinds(verdicts)
+    assert kinds[("ttft", "p99")] == slo_gate.KIND_REGRESSED
+    assert kinds[("ttft", "p50")] == slo_gate.KIND_REGRESSED
+    reg = slo_gate.regressions(verdicts)
+    assert reg and all(v.margin > 0.09 for v in reg)
+
+
+def test_within_bound_shift_not_flagged():
+    base = _artifact()
+    h = HistogramCounter()
+    bound = h.relative_error_bound()
+    # a shift inside ONE histogram's bound can never clear the
+    # combined two-sided bound — indistinguishable, so "ok"
+    cand = _artifact(scales=(1.0 + bound * 0.9,))
+    verdicts = slo_gate.compare(base, cand)
+    assert slo_gate.regressions(verdicts) == []
+
+
+def test_improvement_detected_not_a_regression():
+    verdicts = slo_gate.compare(_artifact(), _artifact(scales=(0.5,)))
+    assert slo_gate.regressions(verdicts) == []
+    assert any(v.kind == slo_gate.KIND_IMPROVED for v in verdicts)
+
+
+def test_one_sided_names_incomparable_never_regressed():
+    base = _artifact(names=("ttft", "old_only"))
+    cand = _artifact(names=("ttft", "new_only"), scales=(3.0,))
+    verdicts = slo_gate.compare(base, cand)
+    kinds = _kinds(verdicts)
+    assert kinds[("old_only", "*")] == slo_gate.KIND_INCOMPARABLE
+    assert kinds[("new_only", "*")] == slo_gate.KIND_INCOMPARABLE
+    notes = {v.name: v.note for v in verdicts
+             if v.kind == slo_gate.KIND_INCOMPARABLE}
+    assert notes == {"old_only": "only in baseline",
+                     "new_only": "only in candidate"}
+    # the renamed-but-3x-slower "new_only" must not count as ok/win
+    assert ("ttft", "p99") in kinds
+
+
+def test_empty_and_malformed_histograms_incomparable():
+    base = _artifact()
+    cand = copy.deepcopy(base)
+    empty = HistogramCounter()
+    cand["histograms"]["ttft"] = {
+        "snapshot": empty.snapshot(),
+        "relative_error_bound": empty.relative_error_bound()}
+    verdicts = slo_gate.compare(base, cand)
+    assert _kinds(verdicts)[("ttft", "*")] == slo_gate.KIND_INCOMPARABLE
+    cand["histograms"]["ttft"] = {"snapshot": "garbage"}
+    verdicts = slo_gate.compare(base, cand)
+    (v,) = verdicts
+    assert v.kind == slo_gate.KIND_INCOMPARABLE
+    assert v.note == "unreadable snapshot"
+    assert slo_gate.regressions(verdicts) == []
+
+
+def test_error_bound_is_combined_two_sided():
+    doc = _artifact()
+    (v, *_) = slo_gate.compare(doc, copy.deepcopy(doc))
+    e = HistogramCounter().relative_error_bound()
+    assert v.error_bound == pytest.approx((1 + e) * (1 + e) - 1)
+
+
+def test_custom_quantiles():
+    doc = _artifact()
+    verdicts = slo_gate.compare(doc, copy.deepcopy(doc),
+                                quantiles=(0.9,))
+    assert [v.quantile for v in verdicts] == ["p90"]
+
+
+# ---------------------------------------------------------------------------
+# rendering + CLI exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_render_text_summary_line():
+    verdicts = slo_gate.compare(_artifact(), _artifact(scales=(1.5,)))
+    txt = slo_gate.render_text(verdicts)
+    assert txt.splitlines()[-1] == f"regressions: {len(verdicts)}"
+    assert txt.splitlines()[0].startswith("✗")
+    ok = slo_gate.render_text(
+        slo_gate.compare(_artifact(), _artifact()))
+    assert ok.splitlines()[-1] == "regressions: 0"
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _artifact())
+    same = _write(tmp_path, "same.json", _artifact())
+    slow = _write(tmp_path, "slow.json", _artifact(scales=(2.0,)))
+    bad = _write(tmp_path, "bad.json", {"schema": "nope"})
+    assert slo_gate.main([base, same]) == 0
+    assert slo_gate.main([base, slow]) == 1
+    assert slo_gate.main([base, bad]) == 2
+    capsys.readouterr()
+    assert slo_gate.main([base, slow, "--format", "json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["regressions"] > 0
+    assert all({"name", "quantile", "kind"} <= set(v)
+               for v in out["verdicts"])
+
+
+def test_cli_subprocess_entrypoint(tmp_path):
+    # the gate must work as a standalone script too (CI usage)
+    base = _write(tmp_path, "base.json", _artifact())
+    slow = _write(tmp_path, "slow.json", _artifact(scales=(2.0,)))
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "slo_gate.py")
+    r = subprocess.run([sys.executable, script, base, slow],
+                       capture_output=True, text=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 1, r.stderr
+    assert "regressions:" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# bench.py wiring: --baseline gates the round's artifact
+# ---------------------------------------------------------------------------
+
+
+def _bench_module():
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_main", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_gate_exits_1_on_regression(tmp_path, monkeypatch,
+                                          capsys):
+    mod = _bench_module()
+    base = _write(tmp_path, "base.json", _artifact())
+    slow = _write(tmp_path, "slow.json", _artifact(scales=(2.0,)))
+    monkeypatch.setenv(mod._METRICS_ENV, slow)
+    with pytest.raises(SystemExit) as ei:
+        mod._run_slo_gate(base)
+    assert ei.value.code == 1
+    cap = capsys.readouterr()
+    # verdicts on stderr ONLY: stdout stays a pure metric stream
+    assert cap.out == ""
+    assert "regressions:" in cap.err
+
+
+def test_bench_gate_passes_and_skips_cleanly(tmp_path, monkeypatch,
+                                             capsys):
+    mod = _bench_module()
+    base = _write(tmp_path, "base.json", _artifact())
+    same = _write(tmp_path, "same.json", _artifact())
+    monkeypatch.setenv(mod._METRICS_ENV, same)
+    mod._run_slo_gate(base)                 # no regression: returns
+    assert "regressions: 0" in capsys.readouterr().err
+    # no --metrics-out artifact: gate skips with a note, never exits
+    monkeypatch.delenv(mod._METRICS_ENV)
+    mod._run_slo_gate(base)
+    assert "skipped" in capsys.readouterr().err

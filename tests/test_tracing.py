@@ -318,7 +318,7 @@ class TestCounters:
             tr = tracing.start_tracing(sample_counters=False)
             assert tr.capacity == 128
             assert tr.counter_patterns == ["/serving*", "/cache*",
-                                           "/threads*"]
+                                           "/threads*", "/programs*"]
         finally:
             tracing.stop_tracing()
             rc.set("hpx.trace.buffer_events", old)
