@@ -851,6 +851,168 @@ def main() -> int:
                 flush=True)
             raise SystemExit(2)
 
+    # 8. the closed-loop tuner wave (--autotune): the same serving
+    # mixes, each run twice — once with the hand-tuned settings the waves above
+    # use, once from schema defaults with the online tuner live
+    # (hpx.tune.enable=1, svc/autotune). Three gates per mix: output
+    # byte-identity (the tuner moves only output-invariant knobs —
+    # divergence exits 2), the tuner actually evaluated, and the
+    # reported band check (auto warm tok/s and stall p99 within 5% of
+    # hand-tuned). Stall histograms land in collected_hists so
+    # --metrics-out feeds slo_gate.py --baseline.
+    def autotune_bench():
+        import hashlib
+
+        from hpx_tpu.core.config import runtime_config
+        from hpx_tpu.svc.metrics import HistogramCounter
+        rc = runtime_config()
+
+        mreqs = [(rng.integers(
+                      1, 1000, int(rng.integers(5, 150))).tolist(),
+                  int(rng.integers(16, 96))) for _ in range(12)]
+        shared = rng.integers(1, 1000, 64).tolist()
+        preqs = [(shared + rng.integers(1, 1000, 8).tolist(),
+                  int(rng.integers(16, 33))) for _ in range(12)]
+        sreqs = [(([11, 23, 7, 42] * 12)[:40], 48) for _ in range(4)] \
+            + [(rng.integers(1, 1000, 24).tolist(),
+                int(rng.integers(24, 49))) for _ in range(4)]
+        mixes = [
+            ("mixed", mreqs, dict(slots=4, smax=256),
+             "12 reqs plen5-149 (unbucketed) new16-96 over 4 slots"),
+            ("prefix", preqs, dict(slots=4, smax=160, paged=True),
+             "12 reqs 64-tok shared prefix + 8-tok tail, paged"),
+            ("spec", sreqs,
+             dict(slots=4, smax=128, spec=True, spec_k=4),
+             "4 periodic + 4 random reqs, prompt-lookup spec"),
+        ]
+
+        def run(reqs, srv_kw, tune):
+            rc.set("hpx.tune.enable", "1" if tune else "0")
+            rc.set("hpx.tune.interval_ticks", "4")
+            try:
+                def once():
+                    srv = ContinuousServer(params, cfg, **srv_kw)
+                    for p, m in reqs:
+                        srv.submit(p, max_new=m)
+                    t0 = time.perf_counter()
+                    stalls = []
+                    alive = True
+                    while alive:
+                        s0 = time.perf_counter()
+                        alive = srv.step()
+                        stalls.append(time.perf_counter() - s0)
+                    secs = time.perf_counter() - t0
+                    out = dict(srv._done)
+                    srv._done.clear()
+                    return out, secs, stalls, srv
+
+                once()                                 # compile
+                return once()                          # warm
+            finally:
+                rc.set("hpx.tune.enable", "0")
+
+        def sha(out):
+            return hashlib.sha256(json.dumps(
+                [out[r] for r in sorted(out)]).encode()).hexdigest()
+
+        for name, reqs, srv_kw, mix in mixes:
+            total = sum(m for _, m in reqs)
+            h_out, h_secs, h_stalls, _ = run(reqs, srv_kw, False)
+            a_out, a_secs, a_stalls, a_srv = run(reqs, srv_kw, True)
+            t = a_srv._tuner
+            hh, ha = HistogramCounter(), HistogramCounter()
+            for s in h_stalls:
+                hh.record(s)
+            for s in a_stalls:
+                ha.record(s)
+            collected_hists[
+                f"serving_autotune_{name}/decode_stall_hand"] = hh
+            collected_hists[
+                f"serving_autotune_{name}/decode_stall_auto"] = ha
+            h_tps, a_tps = total / h_secs, total / a_secs
+            h_p99 = float(np.percentile(h_stalls, 99))
+            a_p99 = float(np.percentile(a_stalls, 99))
+            identical = sha(a_out) == sha(h_out)
+            within = (a_tps >= 0.95 * h_tps
+                      and a_p99 <= 1.05 * max(h_p99, 1e-4))
+            emit(f"serving_autotune_{name}", total, a_secs,
+                 mix=mix,
+                 hand_tokens_per_s=round(h_tps, 1),
+                 hand_stall_p99_ms=round(1e3 * h_p99, 2),
+                 auto_stall_p99_ms=round(1e3 * a_p99, 2),
+                 tuner_evals=t.evals, tuner_probes=t.probes,
+                 tuner_accepts=t.accepts, tuner_reverts=t.reverts,
+                 tuned_knobs=t.knob_values(),
+                 output_identical=identical,
+                 within_band=within)
+            if not identical:
+                print(json.dumps({
+                    "error": "autotuned output diverged",
+                    "wave": name,
+                    "hand_sha": sha(h_out)[:16],
+                    "auto_sha": sha(a_out)[:16]}), flush=True)
+                raise SystemExit(2)
+            if t.evals == 0:
+                print(json.dumps({
+                    "error": "tuner never evaluated",
+                    "wave": name}), flush=True)
+                raise SystemExit(2)
+
+        # disagg leg: the same contract through the router — every
+        # in-proc worker gets its own tuner, joined to the router's
+        # TuneArbiter for the shared budgets
+        from hpx_tpu.models.disagg import DisaggRouter
+        dreqs = [(rng.integers(
+                      1, 1000, int(rng.integers(8, 64))).tolist(),
+                  int(rng.integers(16, 49))) for _ in range(10)]
+        dtotal = sum(m for _, m in dreqs)
+
+        def run_disagg(tune):
+            rc.set("hpx.tune.enable", "1" if tune else "0")
+            rc.set("hpx.tune.interval_ticks", "4")
+            try:
+                def once():
+                    r = DisaggRouter(params, cfg, prefill_workers=2,
+                                     decode_workers=2, slots=4,
+                                     smax=128)
+                    for p, m in dreqs:
+                        r.submit(p, m)
+                    t0 = time.perf_counter()
+                    out = r.run()
+                    secs = time.perf_counter() - t0
+                    hist = r.merged_hist()["decode_stall"]
+                    r.close()
+                    return out, secs, hist
+                once()                                 # compile
+                return once()                          # warm
+            finally:
+                rc.set("hpx.tune.enable", "0")
+
+        h_out, h_secs, h_hist = run_disagg(False)
+        a_out, a_secs, a_hist = run_disagg(True)
+        collected_hists["serving_autotune_disagg/"
+                        "decode_stall_hand"] = h_hist
+        collected_hists["serving_autotune_disagg/"
+                        "decode_stall_auto"] = a_hist
+        h_tps, a_tps = dtotal / h_secs, dtotal / a_secs
+        h_p99, a_p99 = h_hist.quantile(0.99), a_hist.quantile(0.99)
+        identical = sha(a_out) == sha(h_out)
+        emit("serving_autotune_disagg", dtotal, a_secs,
+             mix="10 reqs plen8-63 new16-48, 2 prefill x 2 decode",
+             hand_tokens_per_s=round(h_tps, 1),
+             hand_stall_p99_ms=round(1e3 * h_p99, 2),
+             auto_stall_p99_ms=round(1e3 * a_p99, 2),
+             output_identical=identical,
+             within_band=(a_tps >= 0.95 * h_tps
+                          and a_p99 <= 1.05 * max(h_p99, 1e-4)))
+        if not identical:
+            print(json.dumps({
+                "error": "autotuned output diverged",
+                "wave": "disagg",
+                "hand_sha": sha(h_out)[:16],
+                "auto_sha": sha(a_out)[:16]}), flush=True)
+            raise SystemExit(2)
+
     def finish() -> int:
         if tracer is not None:
             from hpx_tpu.svc import tracing
@@ -928,6 +1090,10 @@ def main() -> int:
 
     if "--fleet" in sys.argv:
         fleet_bench()
+        return finish()
+
+    if "--autotune" in sys.argv:
+        autotune_bench()
         return finish()
 
     if "--chaos" in sys.argv:

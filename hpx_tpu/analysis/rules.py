@@ -1064,3 +1064,88 @@ class ProgramCacheBypassRule(Rule):
 
         walk(ctx.tree, "<module>", False)
         yield from out
+
+
+# instance attributes backed by declared-tunable config keys
+# (``tunable=`` markers in core/config_schema.py) — the knob map
+# svc/autotune.server_tuner binds.  Keyed attr -> backing config key
+# so the finding names both.
+_TUNABLE_KNOB_ATTRS = {
+    "prefill_chunk": "hpx.serving.prefill_chunk",
+    "_max_async": "hpx.serving.max_async_steps",
+    "_spec_k": "hpx.serving.spec.k",
+    "_ckpt_every": "hpx.serving.ckpt_every",
+    "budget_blocks": "hpx.cache.radix_budget_blocks",
+    "max_queue": "hpx.serving.disagg.max_queue",
+}
+
+# the config actuation path: construction reads the schema default,
+# _reload_knobs() applies operator config writes at the flush
+# boundary.  Everything else must go through the runtime config (or
+# the AdaptiveTuner, whose KnobBinding setters live in svc/autotune).
+_TUNE_SANCTIONED_FUNCS = {"__init__", "_reload_knobs"}
+
+
+@register
+class TunableKnobMutationRule(Rule):
+    """HPX018: direct mutation of an adaptive-tuner-owned knob
+    attribute outside the config actuation path.
+
+    The serving knobs the online tuner owns (``prefill_chunk``,
+    ``_max_async``, ``_spec_k``, ``_ckpt_every``, ``budget_blocks``,
+    ``max_queue`` — the attributes backing the ``tunable=`` keys in
+    ``core/config_schema``) change ONLY at the flush/admit boundary:
+    construction reads the schema default, ``_reload_knobs()`` applies
+    operator config writes, and ``svc/autotune``'s KnobBinding setters
+    actuate tuner probes.  A write anywhere else races the controller
+    — the tuner's next probe silently reverts it, its decision log no
+    longer explains the live value, and flight-bundle replay diverges
+    from what actually ran.  Fix: route the change through
+    ``runtime_config().set(...)`` (picked up at the next flush) or
+    declare the attribute's owner a tuner binding in svc/autotune.
+    """
+
+    id = "HPX018"
+    name = "tunable-knob-mutation"
+    severity = "warning"
+
+    _SCOPE = ("hpx_tpu/models/", "hpx_tpu/svc/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_subpath(*self._SCOPE):
+            return
+        # the tuner's KnobBinding setters ARE the actuation path
+        if ctx.display_path.endswith("svc/autotune.py"):
+            return
+        out: List[Finding] = []
+
+        def walk(node: ast.AST, scope: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_scope = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_scope = child.name
+                targets: List[ast.expr] = []
+                if isinstance(child, ast.Assign):
+                    targets = list(child.targets)
+                elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [child.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr in _TUNABLE_KNOB_ATTRS \
+                            and child_scope not in _TUNE_SANCTIONED_FUNCS:
+                        key = _TUNABLE_KNOB_ATTRS[t.attr]
+                        out.append(self.finding(
+                            ctx, child,
+                            f"direct write to tuner-owned knob "
+                            f"attribute `{t.attr}` (backing {key}) in "
+                            f"{child_scope}() bypasses the config "
+                            "actuation path — it races the adaptive "
+                            "tuner and breaks flight-bundle replay; "
+                            "route it through runtime_config().set() "
+                            "(applied by _reload_knobs at the next "
+                            "flush) or a svc/autotune KnobBinding"))
+                walk(child, child_scope)
+
+        walk(ctx.tree, "<module>")
+        yield from out

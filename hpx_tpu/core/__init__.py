@@ -9,5 +9,7 @@ from .errors import (  # noqa: F401
     HpxError,
     NetworkError,
     NotImplementedYet,
+    ReservedConfigKey,
+    UndeclaredConfigKey,
     throw_exception,
 )

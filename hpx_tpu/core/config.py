@@ -25,7 +25,7 @@ import threading
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
 
 from . import config_schema
-from .errors import BadParameter
+from .errors import BadParameter, ReservedConfigKey, UndeclaredConfigKey
 
 # Compiled-in defaults (HPX: generated defaults in runtime_configuration.cpp).
 # Sourced from the central key registry — every key, its type, default and
@@ -142,6 +142,11 @@ class Configuration:
             argv = list(argv)     # may be a generator; we scan it twice
         self._lock = threading.Lock()
         self._strict = bool(strict)
+        # monotonically bumped by every set(): long-lived readers (a
+        # live ContinuousServer) cache it and re-read their knobs at
+        # the next safe boundary when it moved — cheap change
+        # detection without re-reading every key every step
+        self._gen = 0
         self._data: Dict[str, str] = dict(DEFAULTS)
 
         # batch scheduler layer (above compiled defaults, below ini/env/
@@ -187,9 +192,28 @@ class Configuration:
     def _check_declared(self, key: str) -> None:
         if (self._strict and key.startswith("hpx.")
                 and not config_schema.is_declared(key)):
-            raise BadParameter(
+            raise UndeclaredConfigKey(
                 f"undeclared config key {key!r} (strict mode): declare it "
                 "in hpx_tpu/core/config_schema.py first", "config")
+
+    def _check_settable(self, key: str) -> None:
+        """Strict mode: a ``set()`` of a declared-but-reserved key
+        fails with a RESERVED-specific type — the key exists only for
+        HPX interface parity (no reader), so the write would be
+        silently ignored; that is a different mistake from a typo'd
+        key and gets a different error. Reserved keys still flow in
+        from ini/CLI layers (reference invocations keep working) —
+        only runtime set() is policed."""
+        if not (self._strict and key.startswith("hpx.")):
+            return
+        entry = config_schema.lookup(key)
+        if entry is not None and entry.reserved:
+            raise ReservedConfigKey(
+                f"config key {key!r} is declared reserved=True (HPX "
+                "parity, no runtime reader): a set() would be silently "
+                "ignored. Wire a reader and drop the reserved flag in "
+                "hpx_tpu/core/config_schema.py to make it settable",
+                "config")
 
     def _check_value(self, key: str, value: str) -> None:
         """Strict mode: enumerated str knobs (declared with choices=)
@@ -232,9 +256,18 @@ class Configuration:
 
     def set(self, key: str, value: Any) -> None:
         self._check_declared(str(key))
+        self._check_settable(str(key))
         self._check_value(str(key), str(value))
         with self._lock:
             self._data[str(key)] = str(value)
+            self._gen += 1
+
+    def generation(self) -> int:
+        """Change counter: bumped by every set(). A live server caches
+        this and re-reads its tunable knobs at the next flush boundary
+        when it moved (see ContinuousServer._reload_knobs)."""
+        with self._lock:
+            return self._gen
 
     def section(self, prefix: str) -> Dict[str, str]:
         """All keys under `prefix.` with the prefix stripped."""

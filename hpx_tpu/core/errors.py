@@ -119,6 +119,22 @@ class BadParameter(HpxError):
         super().__init__(Error.bad_parameter, message, function)
 
 
+class UndeclaredConfigKey(BadParameter):
+    """Strict-mode config contract: an ``hpx.``-prefixed key that is
+    not in the config_schema registry at all — a typo, or a knob that
+    was never declared. Fix: declare it in config_schema.py first."""
+
+
+class ReservedConfigKey(BadParameter):
+    """Strict-mode config contract: the key IS declared, but as
+    ``reserved=True`` (HPX interface parity — accepted from ini/CLI so
+    reference invocations keep working, but nothing in this runtime
+    reads it). A runtime ``set()`` would be silently ignored, so
+    strict mode fails it with THIS type — distinct from
+    :class:`UndeclaredConfigKey` so callers can tell "typo" from
+    "knob without a reader"."""
+
+
 class NotImplementedYet(HpxError):
     def __init__(self, message: str = "", function: str = ""):
         super().__init__(Error.not_implemented, message, function)

@@ -111,6 +111,19 @@ from .transformer import (
 __all__ = ["ContinuousServer", "DeadlineExceededError",
            "RequestShedError", "ServerClosedError", "SlotCheckpoint"]
 
+# the knob subset a LIVE server re-reads from the runtime config at
+# flush boundaries (_reload_knobs). Only keys whose raw config value
+# actually CHANGED since construction are applied — a constructor
+# argument (e.g. a DecodeWorker's explicit prefill_chunk) must not be
+# clobbered by an unrelated config write bumping the generation.
+_RELOADABLE_KNOBS = (
+    "hpx.serving.prefill_chunk",
+    "hpx.serving.max_async_steps",
+    "hpx.serving.ckpt_every",
+    "hpx.serving.spec.k",
+    "hpx.cache.radix_budget_blocks",
+)
+
 
 class ServerClosedError(HpxError):
     """submit() after shutdown(). Typed (invalid_status) so a client
@@ -877,6 +890,19 @@ class ContinuousServer:
         self.timeline = _metrics.RequestTimeline()
         self._last_step_t: Optional[float] = None
         self._stall_live = False
+        # closed-loop adaptive tuning (svc/autotune): tick at flush
+        # boundaries only — the one point where no step is in flight,
+        # so a knob write cannot tear a dispatched program. Config
+        # writes from OUTSIDE (operator set()) propagate through the
+        # same boundary via _reload_knobs, keyed on the config
+        # generation counter.
+        self._cfg_gen = rc.generation()
+        self._knob_raw = {k: rc.get(k) for k in _RELOADABLE_KNOBS}
+        self._tune_stall_prev = None    # decode_stall snapshot at tick
+        self._tuner = None
+        if rc.get_bool("hpx.tune.enable", False):
+            from ..svc.autotune import server_tuner
+            self._tuner = server_tuner(self)
         from ..cache.counters import register_server
         self.counter_instance = register_server(self)
 
@@ -2534,7 +2560,9 @@ class ContinuousServer:
     def _flush(self) -> None:
         """Materialize every buffered step's token vector and replay
         the per-slot bookkeeping in dispatch order — the ONLY
-        device->host read in the decode loop."""
+        device->host read in the decode loop. Also the knob actuation
+        boundary: external config writes land (_reload_knobs) and the
+        adaptive tuner ticks HERE, never mid-step."""
         while self._buf:
             nxt, lanes = self._buf.popleft()
             vals = np.asarray(nxt)
@@ -2547,6 +2575,70 @@ class ContinuousServer:
                 if hit_eos or len(req.tokens) >= req.max_new:
                     self._finalize(s, req, hit_eos)
         self._ckpt_sweep()
+        self._reload_knobs()
+        if self._tuner is not None:
+            self._tuner.maybe_tick(self._tune_signals)
+
+    def _reload_knobs(self) -> None:
+        """Propagate runtime config writes into the live server at
+        the flush boundary. Cheap in the steady state: one generation
+        read; the per-key compare only runs after a set() somewhere
+        bumped the generation, and only keys whose raw value CHANGED
+        are applied (constructor overrides survive unrelated writes).
+        Values clamp to the baked ladders — the bucket ladder and
+        smax are compile-time shape choices a live write cannot
+        change."""
+        from ..core.config import runtime_config
+        rc = runtime_config()
+        gen = rc.generation()
+        if gen == self._cfg_gen:
+            return
+        self._cfg_gen = gen
+        for key in _RELOADABLE_KNOBS:
+            raw = rc.get(key)
+            if raw == self._knob_raw[key]:
+                continue
+            self._knob_raw[key] = raw
+            if raw is None or raw == "auto":
+                continue
+            if key == "hpx.serving.prefill_chunk":
+                self.prefill_chunk = min(max(1, int(raw)),
+                                         self.prefill_buckets[-1])
+            elif key == "hpx.serving.max_async_steps":
+                self._max_async = max(1, int(raw))
+            elif key == "hpx.serving.ckpt_every":
+                self._ckpt_every = max(1, int(raw))
+            elif key == "hpx.serving.spec.k" and self._spec:
+                self._spec_k = min(max(1, int(raw)),
+                                   self.prefill_buckets[-1] - 1)
+            elif key == "hpx.cache.radix_budget_blocks" and self.paged:
+                self._radix.budget_blocks = max(1, int(raw))
+
+    def _tune_signals(self):
+        """One TuneSignals sample for the tuner: decayed tokens/s,
+        the decode-stall p99 over the window SINCE the last sample
+        (histogram delta, not lifetime), queue depth, and progprof's
+        cumulative compile seconds (None freezes compile-minting
+        knobs). Host-only reads — no device sync."""
+        from ..svc import progprof
+        from ..svc.autotune import TuneSignals
+        from ..svc.metrics import HistogramCounter
+        h = self.hist["decode_stall"]
+        prev, self._tune_stall_prev = self._tune_stall_prev, \
+            h.snapshot()
+        if prev is None:
+            p99 = h.quantile(0.99)
+        else:
+            p99 = HistogramCounter.from_snapshot(
+                h.delta(prev)).quantile(0.99)
+        comp = None
+        prof = progprof.active_profiler()
+        if prof is not None:
+            comp = sum(float(r.compile_s) for r in prof.records())
+        return TuneSignals(
+            tok_rate=self._rate.rate(), stall_p99=p99,
+            queue_depth=float(len(self._queue)),
+            compile_s_total=comp)
 
     def step(self) -> bool:
         """Admit + one prefill chunk + one decode step for every live

@@ -148,6 +148,13 @@ def build_bundle(kind: str, site: Optional[str] = None,
                      if timeline is not None and rid is not None
                      else []),
     }
+    # adaptive-tuner black box: every live tuner's decision log +
+    # signal history, so a post-incident dump answers "what did the
+    # tuner do leading up to this shed/failover" (and replays it —
+    # autotune.replay). {} when no tuner is live; only runs inside a
+    # bundle capture, so the zero-cost discipline holds.
+    from . import autotune
+    doc["tune"] = autotune.flight_snapshot()
     if extra:
         doc["extra"] = dict(extra)
     return doc
@@ -249,6 +256,9 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
         errs.append("programs must be null or a profile table")
     if not isinstance(doc.get("timeline"), list):
         errs.append("timeline must be a list")
+    tune = doc.get("tune")
+    if tune is not None and not isinstance(tune, dict):
+        errs.append("tune must be absent or an object")
     return errs
 
 

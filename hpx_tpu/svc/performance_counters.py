@@ -149,7 +149,16 @@ class RateCounter(Counter):
     is the event total landed inside the trailing `window_s` seconds
     divided by the window. Serving uses it for tokens/sec — a
     cumulative GaugeCounter can't answer "how fast NOW", and an
-    AverageCounter's mean-of-samples isn't a rate at all."""
+    AverageCounter's mean-of-samples isn't a rate at all.
+
+    `get_value()` is a step function of the event times: a burst holds
+    its full rate until the instant its events age past the window,
+    then cliffs to 0. Fine for dashboards; wrong for a CONTROLLER —
+    across an idle gap the tuner would read ghost throughput and tune
+    against work that stopped seconds ago. `rate()` is the
+    controller-facing read: the same pruned total, decayed linearly
+    against the wall-clock gap since the NEWEST event, so an idle
+    window drains smoothly to 0 instead of holding stale."""
 
     def __init__(self, window_s: float = 10.0) -> None:
         if window_s <= 0:
@@ -179,6 +188,22 @@ class RateCounter(Counter):
                 self._events.clear()
         return CounterValue(total / self._window, time.time(),
                             max(count, 1))
+
+    def rate(self) -> float:
+        """Wall-clock-decayed events/sec for controllers: the pruned
+        in-window total over the window, scaled by how recently the
+        NEWEST event landed — full weight at gap 0, linearly down to 0
+        after one idle window. Marking anything restores full weight,
+        so an active stream reads identically to get_value()."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune(now)
+            if not self._events:
+                return 0.0
+            total = sum(n for _, n in self._events)
+            gap = now - self._events[-1][0]
+        decay = max(0.0, 1.0 - gap / self._window)
+        return (total / self._window) * decay
 
 
 class AverageCounter(Counter):

@@ -877,6 +877,69 @@ def test_hpx017_scoped_to_models_and_ops():
     assert rules_of(fs) == ["HPX017"]
 
 
+# ---------------------------------------------------------------------------
+# HPX018 — tuner-owned knob mutated outside the config actuation path
+# ---------------------------------------------------------------------------
+
+HPX018_BAD = """\
+class Server:
+    def __init__(self):
+        self.prefill_chunk = 64
+
+    def go_faster(self):
+        self.prefill_chunk = 512
+        self._spec_k += 1
+"""
+
+HPX018_GOOD = """\
+class Server:
+    def __init__(self):
+        self.prefill_chunk = 64
+        self._spec_k = 4
+
+    def _reload_knobs(self):
+        self.prefill_chunk = 512
+        self._spec_k = 5
+
+    def go_faster(self, rc):
+        rc.set("hpx.serving.prefill_chunk", "512")
+"""
+
+
+def test_hpx018_fires_on_unsanctioned_write():
+    fs = findings(HPX018_BAD, path="hpx_tpu/models/fixture.py")
+    assert rules_of(fs) == ["HPX018", "HPX018"]
+    assert "prefill_chunk" in fs[0].message
+    assert "hpx.serving.prefill_chunk" in fs[0].message
+    assert "go_faster" in fs[0].message
+    assert "_spec_k" in fs[1].message
+
+
+def test_hpx018_silent_on_actuation_path():
+    assert findings(HPX018_GOOD,
+                    path="hpx_tpu/models/fixture.py") == []
+    assert findings(HPX018_GOOD, path="hpx_tpu/svc/fixture.py") == []
+
+
+def test_hpx018_scope_and_autotune_exemption():
+    # svc/ is in scope; the tuner's own KnobBinding setters are the
+    # actuation path and stay exempt; layers outside models//svc/
+    # (e.g. cache/radix's budget_blocks __init__) are out of scope
+    fs = findings(HPX018_BAD, path="hpx_tpu/svc/fixture.py")
+    assert rules_of(fs) == ["HPX018", "HPX018"]
+    assert findings(HPX018_BAD, path="hpx_tpu/svc/autotune.py") == []
+    assert findings(HPX018_BAD, path="hpx_tpu/cache/fixture.py") == []
+
+
+def test_hpx018_real_tree_is_clean():
+    # ground truth for the rule shipping with an empty baseline: the
+    # only in-tree writes to tunable-backed attrs are construction and
+    # _reload_knobs
+    res = lint_paths([os.path.join(REPO, "hpx_tpu")],
+                     rules=all_rules(["HPX018"]))
+    assert [f.rule for f in res.findings] == []
+
+
 def test_hpx017_github_gate_on_real_tree(capsys):
     # the tier-1 gate invocation CI uses: the shipped tree must be
     # clean under the baseline with --format=github (annotations would
@@ -892,7 +955,7 @@ def test_all_rules_registry():
                    "HPX005", "HPX006", "HPX007", "HPX008",
                    "HPX009", "HPX010", "HPX011", "HPX012",
                    "HPX013", "HPX014", "HPX015", "HPX016",
-                   "HPX017"]
+                   "HPX017", "HPX018"]
 
 
 def test_rule_registry_completeness(capsys):

@@ -1,0 +1,511 @@
+"""Closed-loop adaptive executor (svc/autotune.AdaptiveTuner).
+
+Two layers under test.  The CONTROLLER layer runs the tuner against
+synthetic signal streams (a pure-python response surface standing in
+for the serving loop) and pins convergence, bounds, hysteresis,
+compile-cost charging, arbiter exclusivity, and replay determinism.
+The INTEGRATION layer runs a real ContinuousServer with
+``hpx.tune.enable=1`` and pins the differential contract: the tuner
+may move throughput knobs, never tokens — tuned output is byte-equal
+to the untuned server, and a no-op tuner (freeze="*") leaves the
+program-cache counters identical to tune-off.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from hpx_tpu.core.config import runtime_config
+from hpx_tpu.core.config_schema import Tunable
+from hpx_tpu.models import transformer as tfm
+from hpx_tpu.models.serving import ContinuousServer
+from hpx_tpu.svc.autotune import (
+    AdaptiveTuner,
+    KnobBinding,
+    TuneArbiter,
+    TuneSignals,
+    replay,
+)
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=2, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# controller harness: a synthetic response surface
+# ---------------------------------------------------------------------------
+
+def _knob(cell, name="k", lo=1, hi=256, step=2, geometric=True,
+          compiles=False):
+    return KnobBinding(
+        name, Tunable(lo=lo, hi=hi, step=step, geometric=geometric,
+                      compiles=compiles),
+        lambda: cell[name], lambda v: cell.__setitem__(name, v))
+
+
+def _drive(tuner, cell, surface, evals, settle=True):
+    """Run ``evals`` evaluations, sampling the synthetic response
+    surface (a function of the CURRENT knob values) before each; then
+    settle any in-flight probe so assertions see an accepted value,
+    not a half-finished experiment (interval_ticks=1 harnesses)."""
+    for _ in range(evals):
+        tuner.maybe_tick(lambda: surface(cell))
+    if settle and tuner._phase == "probe":
+        tuner.maybe_tick(lambda: surface(cell))
+
+
+def test_converges_to_peak_and_holds():
+    """Unimodal surface peaked at k=64: the tuner climbs to the peak
+    and then oscillation is bounded to probe/revert pairs around it —
+    the value between evaluations never leaves {peak, one step}."""
+    cell = {"k": 4}
+    t = AdaptiveTuner([_knob(cell)], interval_ticks=1,
+                      hysteresis_pct=1.0, cooldown_ticks=1)
+
+    def surface(c):
+        # peak 100 at k=64, falling off in log-distance
+        k = c["k"]
+        return TuneSignals(
+            tok_rate=100.0 - 25.0 * abs(np.log2(k / 64.0)),
+            stall_p99=0.0, queue_depth=0.0)
+
+    _drive(t, cell, surface, 40)
+    assert cell["k"] in (32, 64, 128)    # at/next to the peak
+    assert t.accepts >= 4                # climbed 4 -> 64
+    # late-phase: reverts happen (probes off the peak fail) but the
+    # accepted value keeps returning to the peak
+    settled = [d for d in t.decisions() if d["action"] == "revert"]
+    assert settled, "expected failed probes around the optimum"
+    for d in settled:
+        assert cell["k"] >= 1
+
+
+def test_step_change_retracks():
+    """The optimum moves mid-run (64 -> 8): the controller walks back
+    down after the phase change without a reset."""
+    cell = {"k": 64}
+    t = AdaptiveTuner([_knob(cell)], interval_ticks=1,
+                      hysteresis_pct=1.0, cooldown_ticks=0)
+    phase = {"peak": 64.0}
+
+    def surface(c):
+        return TuneSignals(
+            tok_rate=100.0 - 25.0 * abs(np.log2(c["k"] / phase["peak"])),
+            stall_p99=0.0, queue_depth=0.0)
+
+    _drive(t, cell, surface, 6)
+    assert cell["k"] in (32, 64, 128)
+    phase["peak"] = 8.0
+    _drive(t, cell, surface, 40)
+    assert cell["k"] in (4, 8, 16), cell["k"]
+
+
+def test_overload_backs_off_on_stall():
+    """Stall p99 grows superlinearly with the knob (the overload
+    regime): the stall term dominates the objective and the tuner
+    walks the knob DOWN."""
+    cell = {"k": 128}
+    t = AdaptiveTuner([_knob(cell)], interval_ticks=1,
+                      hysteresis_pct=1.0, cooldown_ticks=0)
+
+    def surface(c):
+        k = c["k"]
+        return TuneSignals(tok_rate=10.0 + k * 0.01,
+                           stall_p99=(k / 64.0) ** 2,
+                           queue_depth=float(k))
+
+    _drive(t, cell, surface, 30)
+    assert cell["k"] <= 16, cell["k"]
+
+
+def test_bounds_are_hard():
+    """A monotone surface pushes the knob to a bound; every value the
+    controller ever applied stays inside [lo, hi]."""
+    cell = {"k": 16}
+    t = AdaptiveTuner([_knob(cell, lo=4, hi=64)], interval_ticks=1,
+                      hysteresis_pct=1.0, cooldown_ticks=0)
+    _drive(t, cell, lambda c: TuneSignals(
+        tok_rate=float(c["k"]), stall_p99=0.0, queue_depth=0.0), 30)
+    for d in t.decisions():
+        if d["new"] is not None:
+            assert 4 <= d["new"] <= 64
+    assert cell["k"] == 64
+    # and the other direction
+    cell2 = {"k": 16}
+    t2 = AdaptiveTuner([_knob(cell2, lo=4, hi=64)], interval_ticks=1,
+                       hysteresis_pct=1.0, cooldown_ticks=0)
+    _drive(t2, cell2, lambda c: TuneSignals(
+        tok_rate=-float(c["k"]), stall_p99=0.0, queue_depth=0.0), 30)
+    assert cell2["k"] == 4
+    for d in t2.decisions():
+        if d["new"] is not None:
+            assert 4 <= d["new"] <= 64
+
+
+def test_hysteresis_rejects_sub_band_gains():
+    """An oscillating surface whose swing stays under the hysteresis
+    band: every probe reverts (no-thrash), and the knob always returns
+    to its starting value between probe pairs."""
+    cell = {"k": 32}
+    t = AdaptiveTuner([_knob(cell)], interval_ticks=1,
+                      hysteresis_pct=10.0, cooldown_ticks=0)
+    flip = {"s": 1.0}
+
+    def surface(c):
+        flip["s"] = -flip["s"]          # +-1% oscillation, band is 10%
+        return TuneSignals(tok_rate=100.0 + flip["s"],
+                           stall_p99=0.0, queue_depth=0.0)
+
+    _drive(t, cell, surface, 30)
+    assert t.accepts == 0
+    assert t.reverts >= 5
+    assert cell["k"] in (16, 32, 64)    # never drifted past one step
+    # every revert restored the pre-probe value
+    for d in t.decisions():
+        if d["action"] == "revert":
+            assert d["old"] == 32
+
+
+def test_cooldown_spaces_probes_per_knob():
+    """After a revert the knob sits out cooldown_ticks evaluations —
+    with one knob and cooldown=2 the action stream shows holds
+    between probe pairs."""
+    cell = {"k": 32}
+    t = AdaptiveTuner([_knob(cell)], interval_ticks=1,
+                      hysteresis_pct=50.0, cooldown_ticks=2)
+    _drive(t, cell, lambda c: TuneSignals(
+        tok_rate=100.0, stall_p99=0.0, queue_depth=0.0), 12)
+    acts = [d["action"] for d in t.decisions()]
+    i = acts.index("revert")
+    assert acts[i + 1] == "hold" and acts[i + 2] == "hold"
+
+
+def test_compile_cost_inflates_accept_threshold():
+    """A compiles=True knob whose probe mints measured compile time:
+    the gain must clear hysteresis + 100*charged/amortize.  A 20%
+    gain with 15s charged against a 30s horizon (50% surcharge)
+    reverts; the same gain with 0.6s charged (2%) accepts."""
+    def run(compile_cost_s):
+        cell = {"k": 32}
+        t = AdaptiveTuner([_knob(cell, compiles=True)],
+                          interval_ticks=1, hysteresis_pct=5.0,
+                          cooldown_ticks=0, compile_amortize_s=30.0)
+        comp = {"s": 1.0}
+        probed = {"done": False}
+
+        def surface(c):
+            if c["k"] != 32 and not probed["done"]:
+                probed["done"] = True
+                comp["s"] += compile_cost_s    # the probe minted a program
+            return TuneSignals(
+                tok_rate=120.0 if c["k"] != 32 else 100.0,
+                stall_p99=0.0, queue_depth=0.0,
+                compile_s_total=comp["s"])
+
+        _drive(t, cell, surface, 2)            # probe + settle
+        return t
+
+    assert run(15.0).reverts == 1              # 20% < 5% + 50%
+    assert run(0.6).accepts == 1               # 20% >= 5% + 2%
+
+
+def test_compile_knob_frozen_without_profiler():
+    """compile_s_total=None (no profiler): a compiles=True knob is
+    never probed — an unmeasurable compile cost cannot be charged."""
+    cell = {"k": 32}
+    t = AdaptiveTuner([_knob(cell, compiles=True)], interval_ticks=1,
+                      hysteresis_pct=1.0)
+    _drive(t, cell, lambda c: TuneSignals(
+        tok_rate=float(c["k"]), stall_p99=0.0, queue_depth=0.0), 10)
+    assert t.probes == 0 and cell["k"] == 32
+    assert all(d["action"] == "hold" for d in t.decisions())
+
+
+def test_freeze_list_and_wildcard():
+    cell = {"a": 32, "b": 32}
+    ka, kb = _knob(cell, "a"), _knob(cell, "b")
+    t = AdaptiveTuner([ka, kb], interval_ticks=1, hysteresis_pct=1.0,
+                      freeze="a")
+    _drive(t, cell, lambda c: TuneSignals(
+        tok_rate=float(c["a"] + c["b"]), stall_p99=0.0,
+        queue_depth=0.0), 10)
+    assert cell["a"] == 32 and cell["b"] > 32
+    cell2 = {"a": 32, "b": 32}
+    t2 = AdaptiveTuner([_knob(cell2, "a"), _knob(cell2, "b")],
+                       interval_ticks=1, freeze="*")
+    _drive(t2, cell2, lambda c: TuneSignals(
+        tok_rate=1.0, stall_p99=0.0, queue_depth=0.0), 10)
+    assert t2.probes == 0 and cell2 == {"a": 32, "b": 32}
+
+
+def test_seed_rotates_probe_order_deterministically():
+    def first_probe(seed):
+        cell = {"a": 32, "b": 32, "c": 32}
+        t = AdaptiveTuner([_knob(cell, n) for n in ("a", "b", "c")],
+                          interval_ticks=1, seed=seed)
+        t.maybe_tick(lambda: TuneSignals(
+            tok_rate=1.0, stall_p99=0.0, queue_depth=0.0))
+        return t.decisions()[0]["knob"]
+
+    assert first_probe(0) == "a"
+    assert first_probe(1) == "b"
+    assert first_probe(2) == "c"
+    assert first_probe(0) == first_probe(3)
+
+
+def test_arbiter_grants_shared_budget_exclusively():
+    """Two tuners share an arbiter over a SHARED_BUDGET knob: while
+    one holds the probe, the other's attempt is denied (a hold), and
+    the denial is recorded into its signal history for replay."""
+    arb = TuneArbiter()
+    shared = "hpx.cache.radix_budget_blocks"
+    ca, cb = {shared: 64}, {shared: 64}
+    ta = AdaptiveTuner([_knob(ca, shared, lo=8, hi=1 << 20)],
+                       name="decode#0", interval_ticks=1,
+                       hysteresis_pct=1.0, arbiter=arb)
+    tb = AdaptiveTuner([_knob(cb, shared, lo=8, hi=1 << 20)],
+                       name="decode#1", interval_ticks=1,
+                       hysteresis_pct=1.0, arbiter=arb)
+    sig = TuneSignals(tok_rate=1.0, stall_p99=0.0, queue_depth=0.0)
+    ta.maybe_tick(lambda: sig)          # ta probes: holds the grant
+    tb.maybe_tick(lambda: sig)          # tb denied -> hold
+    assert ta.probes == 1
+    assert tb.probes == 0 and tb.holds == 1
+    assert tb.signal_history()[0]["denied"] == [shared]
+    ta.maybe_tick(lambda: sig)          # ta settles: releases
+    tb.maybe_tick(lambda: sig)          # now tb can probe
+    assert tb.probes == 1
+    # both histories replay exactly, including the denied round
+    assert replay(ta.flight_state()) == ta.decisions()
+    assert replay(tb.flight_state()) == tb.decisions()
+
+
+def test_replay_reproduces_decisions():
+    """The flight-recorder contract: rebuild from flight_state, feed
+    the recorded signals, get the identical decision log — across
+    accepts, reverts, holds, and interval_ticks > 1."""
+    for interval in (1, 4):
+        cell = {"k": 4}
+        t = AdaptiveTuner([_knob(cell)], interval_ticks=interval,
+                          hysteresis_pct=1.0, cooldown_ticks=1)
+
+        def surface(c):
+            k = c["k"]
+            return TuneSignals(
+                tok_rate=100.0 - 25.0 * abs(np.log2(k / 64.0)),
+                stall_p99=0.0, queue_depth=0.0)
+
+        _drive(t, cell, surface, 30 * interval, settle=False)
+        assert t.evals == 30
+        assert replay(t.flight_state()) == t.decisions()
+
+
+def test_interval_gates_evaluations():
+    cell = {"k": 32}
+    t = AdaptiveTuner([_knob(cell)], interval_ticks=8)
+    calls = {"n": 0}
+
+    def collect():
+        calls["n"] += 1
+        return TuneSignals(tok_rate=1.0, stall_p99=0.0, queue_depth=0.0)
+
+    for _ in range(17):
+        t.maybe_tick(collect)
+    assert t.ticks == 17 and t.evals == 2 and calls["n"] == 2
+
+
+def test_validates_interval():
+    with pytest.raises(ValueError):
+        AdaptiveTuner([], interval_ticks=0)
+
+
+# ---------------------------------------------------------------------------
+# integration: real server, differential contract
+# ---------------------------------------------------------------------------
+
+_REQS = [dict(prompt=[3, 1, 4], max_new=9),
+         dict(prompt=[2, 7], max_new=5),
+         dict(prompt=[5, 6, 7, 8, 9], max_new=12),
+         dict(prompt=[1], max_new=7),
+         dict(prompt=[9, 9, 2, 1], max_new=3),
+         dict(prompt=[4, 4], max_new=10)]
+
+
+def _serve(params, *, tune, sampled=False, interval="2", freeze=None,
+           **srv_kw):
+    """One serving run; returns ({req index: tokens}, server)."""
+    rc = runtime_config()
+    saved = {k: rc.get(k) for k in
+             ("hpx.tune.enable", "hpx.tune.interval_ticks",
+              "hpx.tune.hysteresis_pct", "hpx.tune.freeze")}
+    rc.set("hpx.tune.enable", "1" if tune else "0")
+    rc.set("hpx.tune.interval_ticks", interval)
+    rc.set("hpx.tune.hysteresis_pct", "1")
+    if freeze is not None:
+        rc.set("hpx.tune.freeze", freeze)
+    try:
+        srv = ContinuousServer(params, CFG, slots=3, smax=64, **srv_kw)
+        rids = {}
+        for i, r in enumerate(_REQS):
+            kw = dict(r)
+            if sampled and i % 2 == 0:
+                kw.update(temperature=0.8, key=jax.random.PRNGKey(i))
+            rids[srv.submit(**kw)] = i
+        out = srv.run()
+        return {rids[r]: v for r, v in out.items()}, srv
+    finally:
+        for k, v in saved.items():
+            rc.set(k, v if v is not None else "")
+
+
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["dense", "paged"])
+def test_tuned_output_sha_identical(params, sampled, paged):
+    """The heart of the differential contract: with the tuner live and
+    probing every 2 flushes, every request's tokens are byte-equal to
+    the untuned run — the tuner moves only output-invariant knobs."""
+    kw = dict(paged=True) if paged else {}
+    base, _ = _serve(params, tune=False, sampled=sampled, **kw)
+    tuned, srv = _serve(params, tune=True, sampled=sampled, **kw)
+    assert srv._tuner is not None and srv._tuner.evals > 0
+    assert tuned == base
+
+
+def test_noop_tuner_counter_identical_to_disabled(params):
+    """freeze="*" (the tuner ticks but never probes) against
+    hpx.tune.enable=0: identical program-cache traffic and step
+    counts — the tick path is observation-only."""
+    base, s0 = _serve(params, tune=False)
+    noop, s1 = _serve(params, tune=True, freeze="*")
+    assert noop == base
+    assert s1._tuner.probes == 0 and s1._tuner.evals > 0
+    assert s1._prog_misses == s0._prog_misses
+    assert s1._prog_hits == s0._prog_hits
+
+
+def test_compile_guard_no_extra_programs(params):
+    """With no profiler active the tuner cannot charge compile moves,
+    so a live probing tuner mints ZERO extra programs over the
+    untuned run (prefill_chunk stays frozen; the moved knobs are
+    shape-invariant)."""
+    _, s0 = _serve(params, tune=False)
+    _, s1 = _serve(params, tune=True)
+    assert s1._tuner.probes > 0
+    assert s1._prog_misses == s0._prog_misses
+
+
+def test_tune_counters_registered_and_advance(params):
+    from hpx_tpu.svc import performance_counters as pc
+    _, srv = _serve(params, tune=True)
+    inst = srv.counter_instance
+    names = pc.discover_counters(
+        f"/serving{{locality#*/{inst}}}/tune/*")
+    assert any(n.endswith("/tune/ticks") for n in names)
+    got = {n.rsplit("/", 1)[-1]:
+           pc.query_counter(n).value for n in names}
+    assert got["ticks"] == srv._tuner.ticks > 0
+    assert got["evals"] == srv._tuner.evals > 0
+    assert (got["accepts"] + got["reverts"] + got["holds"]
+            + srv._tuner.probes - got["probes"]) >= 0
+    # tune-off servers register no tune counters
+    _, s0 = _serve(params, tune=False)
+    assert s0._tuner is None
+
+
+def test_reload_knobs_applies_config_writes_at_flush(params):
+    """The operator path: a runtime_config().set() of a tunable key is
+    picked up by _reload_knobs (generation-gated), clamped to the
+    server's ladders; constructor overrides survive unrelated
+    writes."""
+    rc = runtime_config()
+    srv = ContinuousServer(params, CFG, slots=2, smax=64,
+                           prefill_chunk=8)
+    assert srv.prefill_chunk == 8
+    saved = rc.get("hpx.serving.ckpt_every")
+    try:
+        # unrelated write: bumps the generation, must NOT clobber the
+        # prefill_chunk=8 constructor override back to the default
+        rc.set("hpx.serving.ckpt_every", "128")
+        srv._reload_knobs()
+        assert srv.prefill_chunk == 8
+        assert srv._ckpt_every == 128
+        # a write to the key itself IS applied, clamped to the ladder
+        saved_pc = rc.get("hpx.serving.prefill_chunk")
+        try:
+            rc.set("hpx.serving.prefill_chunk", "1000000")
+            srv._reload_knobs()
+            assert srv.prefill_chunk == srv.prefill_buckets[-1]
+        finally:
+            rc.set("hpx.serving.prefill_chunk",
+                   saved_pc if saved_pc is not None else "auto")
+    finally:
+        rc.set("hpx.serving.ckpt_every", saved if saved is not None
+               else "16")
+
+
+def test_disagg_workers_get_tuners_and_shared_arbiter(params):
+    """Under a DisaggRouter with tuning on, every in-proc worker's
+    embedded server carries its own tuner, all joined to ONE
+    router-level arbiter with per-role names — and the routed output
+    still matches the untuned router byte for byte."""
+    from hpx_tpu.models.disagg import DisaggRouter
+    rc = runtime_config()
+
+    def run(tune):
+        rc.set("hpx.tune.enable", "1" if tune else "0")
+        rc.set("hpx.tune.interval_ticks", "2")
+        try:
+            r = DisaggRouter(params, CFG, prefill_workers=1,
+                             decode_workers=2, slots=3, smax=64)
+            for req in _REQS:
+                r.submit(req["prompt"], req["max_new"])
+            out = r.run()
+            r.close()
+            return out, r
+        finally:
+            rc.set("hpx.tune.enable", "0")
+
+    base, _ = run(False)
+    tuned, router = run(True)
+    assert tuned == base
+    tuners = []
+    for h in router._decode + router._prefill:
+        worker = getattr(h, "worker", None)
+        srv = getattr(worker, "srv", None) or getattr(
+            worker, "_eng", None)
+        if getattr(srv, "_tuner", None) is not None:
+            tuners.append(srv._tuner)
+    assert len(tuners) == 3
+    arbs = {id(t.arbiter) for t in tuners}
+    assert arbs == {id(router._tune_arbiter)}
+    names = {t.name for t in tuners}
+    assert names == {"decode#0", "decode#1", "prefill#0"}
+
+
+def test_flight_bundle_embeds_and_replays_tuner(params):
+    """A flight bundle captured during a tuned run carries the tuner's
+    decision log in its ``tune`` section, and that section replays to
+    the identical decisions — the post-incident debugging loop."""
+    import gc
+
+    from hpx_tpu.svc import flight
+    _, srv = _serve(params, tune=True)
+    assert srv._tuner.evals > 0
+    gc.collect()        # drop tuners of servers earlier tests freed
+    doc = flight.build_bundle("manual", site="test")
+    assert flight.validate_bundle(doc) == []
+    assert any(k == "serving" or k.startswith("serving#")
+               for k in doc["tune"])
+    # other live servers in this test session also snapshot under
+    # "serving[#N]" — find OUR tuner's slice by its decision log
+    ours = [st for st in doc["tune"].values()
+            if st["decisions"] == srv._tuner.decisions()]
+    assert len(ours) == 1
+    assert replay(ours[0]) == srv._tuner.decisions()

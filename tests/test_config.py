@@ -96,9 +96,45 @@ def test_strict_mode_enforces_declared_choices():
     with pytest.raises(BadParameter, match="fused_online"):
         cfg.set("hpx.serving.paged_kernel", "online")
     # free-form str keys stay free-form under strict
-    cfg.set("hpx.queuing", "whatever-scheduler")
+    cfg.set("hpx.logging.destination", "wherever.log")
     # lax mode: choices are documentation, not enforcement
     Configuration(environ={}).set("hpx.cache.kv_dtype", "fp8_e5m2")
+
+
+def test_strict_mode_reserved_vs_unknown_are_distinct_errors():
+    """A typo'd key and a declared-but-reserved key are different
+    mistakes: the first needs a schema declaration, the second has no
+    runtime reader so the write would be silently ignored. Strict
+    set() raises a DISTINCT type for each so callers can tell them
+    apart."""
+    from hpx_tpu.core.errors import (ReservedConfigKey,
+                                     UndeclaredConfigKey)
+    cfg = Configuration(environ={}, strict=True)
+    with pytest.raises(UndeclaredConfigKey, match="undeclared"):
+        cfg.set("hpx.serving.prefil_chunk", "64")    # typo
+    with pytest.raises(ReservedConfigKey, match="reserved"):
+        cfg.set("hpx.queuing", "static")             # parity-only key
+    # both are BadParameter subclasses: existing catch-alls still work
+    assert issubclass(UndeclaredConfigKey, BadParameter)
+    assert issubclass(ReservedConfigKey, BadParameter)
+    # reserved keys still ARRIVE through the ini/CLI layers (reference
+    # invocations keep working); only runtime set() is policed
+    via_cli = Configuration(argv=["--hpx:queuing=static"], environ={},
+                            strict=True)
+    assert via_cli.get("hpx.queuing") == "static"
+    # lax mode: unchanged (reserved set() stays a no-op-by-convention)
+    Configuration(environ={}).set("hpx.queuing", "static")
+
+
+def test_set_bumps_generation():
+    """Every set() bumps the change counter a live server polls to
+    re-read its tunable knobs at the next flush boundary."""
+    cfg = Configuration(environ={})
+    g0 = cfg.generation()
+    cfg.set("hpx.serving.prefill_chunk", "64")
+    assert cfg.generation() == g0 + 1
+    cfg.set("hpx.serving.max_async_steps", "8")
+    assert cfg.generation() == g0 + 2
 
 
 def test_declare_validates_choices():
@@ -112,3 +148,23 @@ def test_declare_validates_choices():
     assert key.choices == ("bf16", "int8", "fp8")
     assert config_schema.lookup("hpx.serving.paged_kernel").choices == \
         ("auto", "gather", "fused", "fused_online")
+
+
+def test_tunable_registry():
+    """The tunable subset is the closed set of knobs the adaptive
+    tuner may move; each carries bounds and a compile-cost flag."""
+    from hpx_tpu.core import config_schema
+    tk = config_schema.tunable_keys()
+    assert "hpx.serving.prefill_chunk" in tk
+    assert "hpx.serving.max_async_steps" in tk
+    assert "hpx.serving.spec.k" in tk
+    assert "hpx.cache.radix_budget_blocks" in tk
+    spec = tk["hpx.serving.prefill_chunk"].tunable
+    assert spec.compiles and spec.geometric and spec.lo <= 128 <= spec.hi
+    assert not tk["hpx.serving.max_async_steps"].tunable.compiles
+    # bool/float knobs have no bounded-step semantics
+    with pytest.raises(ValueError, match="tunable"):
+        config_schema.declare("hpx.test.bogus_tunable", "bool", "0",
+                              "no step semantics",
+                              tunable=config_schema.Tunable(lo=0, hi=1))
+    assert not config_schema.is_declared("hpx.test.bogus_tunable")

@@ -342,6 +342,35 @@ def test_rate_counter_events_expire():
     assert rc.get_value().value == 0.0   # aged out of the window
 
 
+def test_rate_counter_rate_decays_across_idle_gap():
+    """rate(): the controller-facing read decays linearly with the gap
+    since the newest event instead of holding the last windowed value
+    for a full window_s — a tuner reading a just-idled stream must see
+    the rate falling, not a step function (satellite fix: stale rate
+    across idle gaps)."""
+    rc = pc.RateCounter(window_s=0.4)
+    assert rc.rate() == 0.0              # empty window
+    rc.mark(40.0)
+    r0 = rc.rate()
+    assert r0 > 0.0
+    time.sleep(0.1)                      # idle: no further marks
+    r1 = rc.rate()
+    assert r1 < r0                       # decayed, NOT the step function
+    # get_value() keeps the legacy step semantics (dashboards pin it)
+    assert rc.get_value().value == pytest.approx(100.0)
+    deadline = time.time() + 5
+    while time.time() < deadline and rc.rate() > 0:
+        time.sleep(0.02)
+    assert rc.rate() == 0.0              # fully decayed / expired
+
+
+def test_rate_counter_rate_matches_get_value_when_fresh():
+    rc = pc.RateCounter(window_s=10.0)
+    rc.mark(20.0)
+    # immediately after a mark the gap is ~0: both reads agree
+    assert rc.rate() == pytest.approx(rc.get_value().value, rel=0.05)
+
+
 def test_rate_counter_reset_clears_window():
     rc = pc.RateCounter(window_s=60.0)
     rc.mark(30.0)
