@@ -274,7 +274,6 @@ def mismatch(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
     def run():
         import numpy as np
         neq = np.flatnonzero(a != b)
-        # hpxlint: disable-next=HPX002 — host path: neq is numpy
         # (via to_numpy_view), no device sync happens here
         return int(neq[0]) if neq.size else -1
 
@@ -340,7 +339,6 @@ def is_sorted_until(policy: ExecutionPolicy, rng: Any) -> Any:
         if len(arr) <= 1:
             return len(arr)
         bad = np.flatnonzero(arr[1:] < arr[:-1])
-        # hpxlint: disable-next=HPX002 — host path: bad is numpy
         return int(bad[0]) + 1 if bad.size else len(arr)
 
     return finish(policy, run)
@@ -414,7 +412,6 @@ def lexicographical_compare(policy: ExecutionPolicy, rng: Any,
         if n:
             ne = np.flatnonzero(a[:n] != b[:n])
             if ne.size:
-                # hpxlint: disable-next=HPX002 — host path: ne is numpy
                 i = int(ne[0])
                 return bool(a[i] < b[i])
         return len(a) < len(b)
@@ -446,7 +443,6 @@ def find_first_of(policy: ExecutionPolicy, rng: Any, rng2: Any) -> Any:
         if len(a) == 0 or len(b) == 0:
             return -1
         hits = np.flatnonzero(np.isin(a, b))
-        # hpxlint: disable-next=HPX002 — host path: hits is numpy
         return int(hits[0]) if hits.size else -1
 
     return finish(policy, run)

@@ -37,7 +37,6 @@ def _rewrap(result: Any, src: PartitionedVector) -> Any:
     holds regardless of which execution path the policy selected.
     """
     shape = getattr(result, "shape", None)
-    # hpxlint: disable-next=HPX002 — shape metadata is host-side
     # already; int() never touches device data
     if shape is not None and len(shape) == 1 and int(shape[0]) == src.size:
         return PartitionedVector.from_array(result, src.layout)

@@ -733,7 +733,6 @@ def is_heap_until(policy: ExecutionPolicy, rng: Any) -> Any:
             return n
         i = np.arange(1, n)
         bad = np.flatnonzero(arr[(i - 1) // 2] < arr[i])
-        # hpxlint: disable-next=HPX002 — host path: bad is numpy
         # (via to_numpy_view), no device sync happens here
         return int(bad[0]) + 1 if bad.size else n
 

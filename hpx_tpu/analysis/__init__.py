@@ -4,7 +4,7 @@ The dynamic VERIFY_LOCKS analog (`hpx_tpu.synchronization`) only fires
 on the paths a test happens to execute; this package is its static
 complement.  A small stdlib-`ast` framework (rule registry, per-rule
 severity, file/line findings, inline ``# hpxlint: disable=RULE``
-suppressions, committed baseline) runs two tiers of rules:
+suppressions, committed baseline) runs three tiers of rules:
 
 Per-file tier (rules.py) — each rule sees one parsed file:
 
@@ -49,9 +49,27 @@ it:
   decref/unpin on every exit path (static twin of
   ``BlockAllocator.leaked_blocks()``), in ``cache/`` and ``models/``.
 
+Dataflow tier (dataflow.py) — per-function reaching-definitions /
+def-use chains over the same parsed trees, plus one-level
+interprocedural summaries from the call graph:
+
+* HPX019 unguarded-shared-state  — a ``self.attr`` mutated bare while
+  a strict majority of its mutation sites hold the same lock (the
+  inferred guarded-by contract), in svc/, models/, cache/, dist/.
+* HPX020 donation-use-after-donate — a binding passed at a
+  ``donate_argnums`` position of a jitted call and used again after.
+* HPX021 mesh-axis-consistency  — collective axis names and
+  PartitionSpec fragments inside ``shard_map`` bodies that the
+  enclosing mesh/specs never declare.
+* HPX022 flow-sensitive-host-sync — a device-origin value (on every
+  reaching definition) flowing into ``float()/int()/bool()/np.array``
+  in hot-path code; the def-use re-founding of HPX002.
+
 Run it: ``python -m hpx_tpu.analysis [paths...]`` or the installed
 ``hpxlint`` script (defaults to ``hpx_tpu/``; run from the repo root so
-baseline paths line up).
+baseline paths line up).  ``--changed`` lints only git-dirty files and
+``--only HPX0NN`` restricts the rule set — the ~1s pre-commit path;
+``tools/lint.py`` is the full three-tier CI gate.
 """
 
 from .engine import (

@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .engine import (
     DEFAULT_BASELINE,
@@ -33,6 +35,31 @@ def _list_rules() -> str:
         lines.append(f"{rule.id}  {rule.name:<20} [{rule.severity}]  "
                      f"{head}")
     return "\n".join(lines)
+
+
+def _changed_py_files() -> List[str]:
+    """Python files touched per git: unstaged + staged diffs against
+    HEAD plus untracked files.  Paths come back repo-root-relative;
+    returns only files that still exist (deletions drop out)."""
+    def run(*argv: str) -> List[str]:
+        out = subprocess.run(["git", *argv], capture_output=True,
+                             text=True, check=True)
+        return [ln.strip() for ln in out.stdout.splitlines()
+                if ln.strip()]
+
+    root = run("rev-parse", "--show-toplevel")[0]
+    names = set(run("diff", "--name-only", "HEAD", "--"))
+    names.update(run("ls-files", "--others", "--exclude-standard"))
+    return sorted(os.path.join(root, n) for n in names
+                  if n.endswith(".py")
+                  and os.path.isfile(os.path.join(root, n)))
+
+
+def _by_rule(findings) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return dict(sorted(counts.items()))
 
 
 def _github_line(f) -> str:
@@ -65,6 +92,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids/names to run "
                          "(default: all)")
+    ap.add_argument("--only", default="", metavar="HPX0NN[,..]",
+                    help="run only these rule ids (merged with "
+                         "--select); the pre-commit fast path")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only Python files git reports as "
+                         "changed (staged, unstaged, or untracked) "
+                         "instead of the given paths; stale-baseline "
+                         "checking is skipped for this partial scan")
     ap.add_argument("--format", choices=("text", "json", "github"),
                     default="text")
     ap.add_argument("--list-rules", action="store_true")
@@ -75,9 +110,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     select = [s.strip() for s in args.select.split(",") if s.strip()]
+    select += [s.strip() for s in args.only.split(",") if s.strip()]
+    paths = args.paths
+    if args.changed:
+        try:
+            paths = _changed_py_files()
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"hpxlint: --changed needs a git checkout: {e}",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            print("hpxlint: no changed Python files")
+            return 0
     try:
         rules = all_rules(select or None)
-        result = lint_paths(args.paths, rules)
+        result = lint_paths(paths, rules)
     except (FileNotFoundError, ValueError) as e:
         print(f"hpxlint: {e}", file=sys.stderr)
         return 2
@@ -97,12 +144,21 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     budget = {} if args.no_baseline else load_baseline(args.baseline)
     new, baselined = apply_baseline(result.findings, budget)
-    stale = stale_entries(result.findings, budget)
+    # a partial scan (changed files only, or a rule subset) cannot
+    # tell stale from simply-not-scanned — skip the burn-down check
+    partial = args.changed or bool(select)
+    stale = ({} if partial
+             else stale_entries(result.findings, budget))
 
     if args.format == "json":
+        new_ids = {id(f) for f in new}
+        absorbed = [f for f in result.findings if id(f) not in new_ids]
         print(json.dumps({
             "findings": [vars(f) for f in new],
             "baselined": baselined, "suppressed": result.suppressed,
+            "suppressed_by_rule": dict(sorted(
+                result.suppressed_by_rule.items())),
+            "baselined_by_rule": _by_rule(absorbed),
             "stale_baseline_entries": [
                 {"path": p, "rule": r, "message": m, "count": c}
                 for (p, r, m), c in sorted(stale.items())],

@@ -112,12 +112,29 @@ class ProjectRule(Rule):
                        message=message)
 
 
+class DataflowRule(ProjectRule):
+    """Tier-3 rule: runs once per lint over the shared
+    :class:`~.dataflow.DataflowIndex` (the ProjectIndex plus def-use
+    chains and one-level interprocedural summaries, still one parse
+    per file).  Subclasses implement check_dataflow()."""
+
+    scope: str = "dataflow"
+
+    def check_project(self, index) -> Iterable[Finding]:
+        return ()
+
+    def check_dataflow(self, dfx) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
 def all_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
     """Instances of every registered rule (or the selected subset, by
     id or name), in id order."""
     from . import rules as _rules  # noqa: F401  (registers on import)
 
     from . import project as _project  # noqa: F401  (registers on import)
+
+    from . import dataflow as _dataflow  # noqa: F401  (registers on import)
 
     chosen = []
     for rid in sorted(_REGISTRY):
@@ -203,6 +220,13 @@ def _statement_header_lines(tree: ast.Module) -> Dict[int, set]:
     for node in ast.walk(tree):
         if not isinstance(node, ast.stmt):
             continue
+        # a decorated def/class anchors findings on the `def` line but
+        # readers put the directive next to the decorator — let every
+        # decorator line reach the def-line findings (and vice versa)
+        for dec in getattr(node, "decorator_list", []) or []:
+            dec_end = getattr(dec, "end_lineno", dec.lineno) or dec.lineno
+            for ln in range(dec.lineno, dec_end + 1):
+                out.setdefault(node.lineno, set()).add(ln)
         end = getattr(node, "end_lineno", node.lineno) or node.lineno
         body = getattr(node, "body", None)
         if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
@@ -315,28 +339,47 @@ class LintResult:
     findings: List[Finding]
     suppressed: int = 0
     checked_files: int = 0
+    # inline-suppression tallies per rule id (justified silences)
+    suppressed_by_rule: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
 
 def lint_sources(sources: Dict[str, str],
                  rules: Optional[Sequence[Rule]] = None) -> LintResult:
-    """The core two-tier runner over in-memory sources
+    """The core three-tier runner over in-memory sources
     ({display_path: source}).
 
     Tier 1 runs every file-scope rule per file; tier 2 builds one
     :class:`~.project.ProjectIndex` from the SAME parsed trees (no
-    re-parse) and runs the project-scope rules across them.  Inline
-    suppressions apply to both tiers, matched in the file a finding
-    is reported in.
+    re-parse) and runs the project-scope rules across them; tier 3
+    wraps that index in a :class:`~.dataflow.DataflowIndex` (def-use
+    chains, still the same trees) for the dataflow-scope rules.
+    Inline suppressions apply to every tier, matched in the file a
+    finding is reported in.
     """
     rules = list(rules) if rules is not None else all_rules()
     file_rules = [r for r in rules if r.scope == "file"]
     project_rules = [r for r in rules if r.scope == "project"]
+    dataflow_rules = [r for r in rules if r.scope == "dataflow"]
 
     kept: List[Finding] = []
     n_sup = 0
+    sup_by_rule: Dict[str, int] = {}
     contexts: Dict[str, FileContext] = {}
     sups: Dict[str, Suppressions] = {}
     n_files = 0
+
+    def suppress(f: Finding) -> bool:
+        nonlocal n_sup
+        ctx = contexts.get(f.path)
+        sup = sups.get(f.path)
+        if sup is not None and sup.suppresses(
+                f, ctx.suppression_lines(f.line) if ctx else None):
+            n_sup += 1
+            sup_by_rule[f.rule] = sup_by_rule.get(f.rule, 0) + 1
+            return True
+        return False
+
     for display_path, source in sources.items():
         n_files += 1
         display = display_path.replace(os.sep, "/")
@@ -348,32 +391,32 @@ def lint_sources(sources: Dict[str, str],
                 line=e.lineno or 1, col=(e.offset or 0) or 1,
                 message=f"syntax error: {e.msg}"))
             continue
-        sup = Suppressions(source)
         contexts[display] = ctx
-        sups[display] = sup
+        sups[display] = Suppressions(source)
         for rule in file_rules:
             for f in rule.check(ctx):
-                if sup.suppresses(f, ctx.suppression_lines(f.line)):
-                    n_sup += 1
-                else:
+                if not suppress(f):
                     kept.append(f)
 
-    if project_rules and contexts:
+    if (project_rules or dataflow_rules) and contexts:
         from .project import ProjectIndex
         index = ProjectIndex(list(contexts.values()))
         for rule in project_rules:
             for f in rule.check_project(index):
-                ctx = contexts.get(f.path)
-                sup = sups.get(f.path)
-                if sup is not None and sup.suppresses(
-                        f, ctx.suppression_lines(f.line) if ctx else None):
-                    n_sup += 1
-                else:
+                if not suppress(f):
                     kept.append(f)
+        if dataflow_rules:
+            from .dataflow import DataflowIndex
+            dfx = DataflowIndex(index)
+            for rule in dataflow_rules:
+                for f in rule.check_dataflow(dfx):
+                    if not suppress(f):
+                        kept.append(f)
 
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return LintResult(findings=kept, suppressed=n_sup,
-                      checked_files=n_files)
+                      checked_files=n_files,
+                      suppressed_by_rule=sup_by_rule)
 
 
 def lint_source(source: str, display_path: str,
@@ -476,8 +519,11 @@ def write_baseline(findings: Sequence[Finding], path: str,
         json.dump({"comment": "hpxlint baseline — pre-existing findings "
                    "accepted with justification; new findings beyond "
                    "these counts fail the gate. near_line is advisory "
-                   "only (matching ignores it).",
-                   "entries": entries}, f, indent=1)
+                   "only (matching ignores it). Entries are emitted in "
+                   "stable (path, rule, message) order so diffs stay "
+                   "reviewable.",
+                   "entries": entries}, f, indent=1,
+                  ensure_ascii=False)
         f.write("\n")
 
 
@@ -532,8 +578,11 @@ def update_baseline_file(findings: Sequence[Finding], path: str,
         json.dump({"comment": "hpxlint baseline — pre-existing findings "
                    "accepted with justification; new findings beyond "
                    "these counts fail the gate. near_line is advisory "
-                   "only (matching ignores it).",
-                   "entries": entries}, f, indent=1)
+                   "only (matching ignores it). Entries are emitted in "
+                   "stable (path, rule, message) order so diffs stay "
+                   "reviewable.",
+                   "entries": entries}, f, indent=1,
+                  ensure_ascii=False)
         f.write("\n")
     pruned = len(old_keys - set(counts))
     return len(entries), pruned

@@ -32,6 +32,19 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 from .engine import FileContext, Finding, ProjectRule, register
 
 _LOCK_TYPES = {"Mutex", "Spinlock", "SharedMutex"}
+# raw threading primitives register as lock identities for the
+# dataflow tier's guarded-by inference (HPX019) but are EXCLUDED from
+# HPX013 ordering — the runtime's own Mutex family is the ordering
+# contract, raw locks guard leaf state
+_RAW_LOCK_TYPES = {"Lock", "RLock"}
+
+# container methods that mutate their receiver in place — a
+# ``self.attr.append(...)`` is a write to the shared structure for
+# guarded-by purposes even though the binding never changes
+_MUTATING_METHODS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "clear", "update", "setdefault",
+    "add", "rotate", "sort", "reverse"}
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -84,7 +97,7 @@ class FunctionInfo:
     identity, lexical `with` nesting)."""
 
     __slots__ = ("qname", "module", "cls", "node", "path",
-                 "acquires", "calls", "reads")
+                 "acquires", "calls", "reads", "attr_ops")
 
     def __init__(self, qname: str, module: str, cls: Optional[str],
                  node: ast.AST, path: str) -> None:
@@ -99,6 +112,12 @@ class FunctionInfo:
         self.calls: List[Tuple[tuple, ast.AST, Tuple[str, ...]]] = []
         # (getter, key, node) config reads
         self.reads: List[Tuple[str, str, ast.AST]] = []
+        # ("write"|"read", attr, node, held_tuple) — every self.attr
+        # access with the locks held at that point (HPX019's input);
+        # subscript stores, aug-assigns and mutating container-method
+        # calls on the attribute all count as writes
+        self.attr_ops: List[Tuple[str, str, ast.AST,
+                                  Tuple[str, ...]]] = []
 
 
 _GETTERS = {"get": None, "get_int": "int",
@@ -116,6 +135,7 @@ class ProjectIndex:
         self.functions: Dict[str, FunctionInfo] = {}
         self.classes: Dict[Tuple[str, str], ast.ClassDef] = {}
         self.locks: Set[str] = set()
+        self.raw_locks: Set[str] = set()  # threading.Lock/RLock subset
         # (module, cls) -> {attr -> (type_module, type_class)}
         self.attr_types: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
         self.aliases: Dict[str, Dict[str, str]] = {}
@@ -135,38 +155,46 @@ class ProjectIndex:
     # -- pass 1: classes, lock identities, attribute types ------------------
 
     def _collect_symbols(self, ctx: FileContext, mod: str) -> None:
+        def record(lid: str, raw: bool) -> None:
+            self.locks.add(lid)
+            if raw:
+                self.raw_locks.add(lid)
+
         for stmt in ctx.tree.body:
             if isinstance(stmt, ast.ClassDef):
                 self.classes[(mod, stmt.name)] = stmt
             elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
-                for name in self._lock_targets(stmt, want_self=False):
-                    self.locks.add(f"{mod}.{name}")
+                for name, raw in self._lock_targets(stmt,
+                                                    want_self=False):
+                    record(f"{mod}.{name}", raw)
         for (m, cname), cdef in list(self.classes.items()):
             if m != mod:
                 continue
             for stmt in cdef.body:
                 if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
-                    for name in self._lock_targets(stmt, want_self=False):
-                        self.locks.add(f"{mod}.{cname}.{name}")
+                    for name, raw in self._lock_targets(
+                            stmt, want_self=False):
+                        record(f"{mod}.{cname}.{name}", raw)
             for meth in cdef.body:
                 if not isinstance(meth, _FUNC_NODES):
                     continue
                 for node in ast.walk(meth):
                     if isinstance(node, (ast.Assign, ast.AnnAssign)):
-                        for name in self._lock_targets(node,
-                                                       want_self=True):
-                            self.locks.add(f"{mod}.{cname}.{name}")
+                        for name, raw in self._lock_targets(
+                                node, want_self=True):
+                            record(f"{mod}.{cname}.{name}", raw)
 
     def _lock_targets(self, stmt: ast.AST,
-                      want_self: bool) -> Iterable[str]:
+                      want_self: bool) -> Iterable[Tuple[str, bool]]:
         value = getattr(stmt, "value", None)
         if not (isinstance(value, ast.Call)
                 and isinstance(value.func, (ast.Name, ast.Attribute))):
             return
         callee = (value.func.id if isinstance(value.func, ast.Name)
                   else value.func.attr)
-        if callee not in _LOCK_TYPES:
+        if callee not in _LOCK_TYPES and callee not in _RAW_LOCK_TYPES:
             return
+        raw = callee in _RAW_LOCK_TYPES
         targets = stmt.targets if isinstance(stmt, ast.Assign) \
             else [stmt.target]
         for t in targets:
@@ -174,9 +202,9 @@ class ProjectIndex:
                 if (isinstance(t, ast.Attribute)
                         and isinstance(t.value, ast.Name)
                         and t.value.id == "self"):
-                    yield t.attr
+                    yield t.attr, raw
             elif isinstance(t, ast.Name):
-                yield t.id
+                yield t.id, raw
 
     # -- pass 2: per-function acquire/call/read collection ------------------
 
@@ -317,17 +345,56 @@ class ProjectIndex:
         for g, key, node in info.reads:
             self.config_reads.append((g, key, node, ctx.display_path))
 
+    @staticmethod
+    def _self_attr(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
     def _scan_exprs(self, info: FunctionInfo, exprs: Sequence[ast.AST],
                     mod: str, held: Tuple[str, ...]) -> None:
-        """Collect calls + config reads from expression trees (never
-        descends into nested statement bodies — exprs carry none)."""
+        """Collect calls + config reads + self-attribute accesses from
+        expression trees (never descends into nested statement bodies
+        — exprs carry none)."""
         for expr in exprs:
+            # ast.walk is parent-before-child, so a mutation parent
+            # (subscript store, mutating method call, attribute-store
+            # base) claims its base attribute before the base itself
+            # is visited as a plain load
+            consumed: set = set()
             for node in ast.walk(expr):
                 if isinstance(node, ast.Lambda):
+                    continue
+                if isinstance(node, ast.Subscript) \
+                        and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                        and self._self_attr(node.value):
+                    info.attr_ops.append(
+                        ("write", node.value.attr, node, held))
+                    consumed.add(id(node.value))
+                    continue
+                if isinstance(node, ast.Attribute):
+                    if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                            and self._self_attr(node.value):
+                        # self.obj.field = v mutates self.obj's referent
+                        info.attr_ops.append(
+                            ("write", node.value.attr, node, held))
+                        consumed.add(id(node.value))
+                    if self._self_attr(node) \
+                            and id(node) not in consumed:
+                        kind = "write" if isinstance(
+                            node.ctx, (ast.Store, ast.Del)) else "read"
+                        info.attr_ops.append(
+                            (kind, node.attr, node, held))
                     continue
                 if not isinstance(node, ast.Call):
                     continue
                 func = node.func
+                if isinstance(func, ast.Attribute) \
+                        and func.attr in _MUTATING_METHODS \
+                        and self._self_attr(func.value):
+                    info.attr_ops.append(
+                        ("write", func.value.attr, func.value, held))
+                    consumed.add(id(func.value))
                 if isinstance(func, ast.Attribute):
                     if func.attr in _GETTERS and node.args \
                             and isinstance(node.args[0], ast.Constant) \
@@ -402,7 +469,15 @@ class LockOrderInversion(ProjectRule):
     severity = "error"
 
     def check_project(self, index: ProjectIndex) -> Iterable[Finding]:
-        # transitive locks-acquired per function, with witness chains
+        # transitive locks-acquired per function, with witness chains.
+        # raw threading.Lock/RLock identities exist for HPX019's
+        # guarded-by inference only — the ordering contract is between
+        # the runtime's registered Mutex family, so drop raw locks here
+        raw = index.raw_locks
+
+        def no_raw(held: Tuple[str, ...]) -> Tuple[str, ...]:
+            return tuple(h for h in held if h not in raw)
+
         via: Dict[str, Dict[str, Tuple[str, ...]]] = {
             q: {} for q in index.functions}
         resolved: Dict[str, List[Tuple[List[str], ast.AST,
@@ -410,8 +485,9 @@ class LockOrderInversion(ProjectRule):
         for q in sorted(index.functions):
             info = index.functions[q]
             for lid, _node, _held in info.acquires:
-                via[q].setdefault(lid, (q,))
-            resolved[q] = [(index.resolve_call(info, d), n, h)
+                if lid not in raw:
+                    via[q].setdefault(lid, (q,))
+            resolved[q] = [(index.resolve_call(info, d), n, no_raw(h))
                            for d, n, h in info.calls]
         changed = True
         while changed:
@@ -430,7 +506,9 @@ class LockOrderInversion(ProjectRule):
         for q in sorted(index.functions):
             info = index.functions[q]
             for lid, node, held in info.acquires:
-                for b in held:
+                if lid in raw:
+                    continue
+                for b in no_raw(held):
                     if b != lid and (b, lid) not in edges:
                         edges[(b, lid)] = ((q,), node, info.path)
             for callees, node, held in resolved[q]:

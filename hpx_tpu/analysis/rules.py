@@ -195,6 +195,12 @@ class HostSyncHotPathRule(Rule):
                     and node.func.id in ("float", "int") \
                     and len(node.args) == 1 \
                     and isinstance(node.args[0], ast.Subscript):
+                # dataflow prover: int(np.flatnonzero(...)[0]) and
+                # friends never touch the device — skip sinks whose
+                # every reaching definition is host data
+                from .dataflow import provably_host
+                if provably_host(node.args[0], ctx):
+                    continue
                 yield self.finding(
                     ctx, node, f"{node.func.id}(x[...]) materializes a "
                     "device element on the host in hot-path code — "

@@ -184,6 +184,9 @@ class TpuExecutor(BaseExecutor):
         if eager is None:
             eager = runtime_config().get_bool("hpx.tpu.eager_futures", True)
         self.eager = eager
+        # donated positions alias into the outputs: callers must not
+        # touch those bindings after dispatch (hpxlint HPX020 flags
+        # use-after-donate through def-use chains)
         self._donate = donate_argnums
 
     # -- compilation --------------------------------------------------------

@@ -261,7 +261,8 @@ class DecodeWorker(_WorkerRing):
                  smax: int = 512, mesh=None, **server_kwargs) -> None:
         # `mesh=` mirrors ContinuousServer(mesh=...) exactly: None is
         # the single-device paged server, a (dp, tp) Mesh runs decode
-        # + verify under shard_map (PR 10's sharded paged serving) —
+        # + verify under shard_map (PR 10's sharded paged serving;
+        # axis names in those bodies are hpxlint-HPX021-checked) —
         # one constructor for both, so a fleet mixes them freely
         self.srv = ContinuousServer(params, cfg, slots=slots,
                                     smax=smax, paged=True, mesh=mesh,

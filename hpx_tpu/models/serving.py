@@ -1054,7 +1054,10 @@ class ContinuousServer:
     def _program(self, ck, build):
         """All program lookups go through here so the compile-cache
         hit/miss counters see every build (the /serving programs/*
-        counters; the compile-count guard test reads them too)."""
+        counters; the compile-count guard test reads them too).
+        Builders that donate (donate_argnums) rely on callers
+        rebinding the result over the donated binding — hpxlint
+        HPX020 flags any other use after the donating call."""
         from .transformer import _PROGRAMS
         if ck in _PROGRAMS:
             self._prog_hits += 1

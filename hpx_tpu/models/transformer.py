@@ -12,6 +12,8 @@ Parallelism layout over a Mesh(("dp","sp","tp")):
        sharded), everything else is token-local
   tp — Megatron-style: attention heads and MLP hidden dim sharded;
        wo/w2 contractions end in a psum over tp
+  (collective axis names inside shard_map bodies are machine-checked
+  against the mesh declaration by hpxlint HPX021)
 
 Everything (forward, loss, backward through the ring, optimizer) runs
 inside ONE shard_map-jitted program — the whole training step is a

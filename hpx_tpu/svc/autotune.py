@@ -169,7 +169,15 @@ class _KnobState:
 class AdaptiveTuner:
     """The controller. Construct via :func:`server_tuner` for a live
     ``ContinuousServer``, or directly with synthetic knobs (the
-    convergence tests do)."""
+    convergence tests do).
+
+    Threading: single-threaded by contract — every mutation happens on
+    the server flush thread via :meth:`maybe_tick`/:meth:`evaluate`
+    (the one safe host boundary, see the module docstring), so none of
+    the counters here take a lock. The only cross-thread surface is
+    the :class:`TuneArbiter` grant table, which is mutex-guarded;
+    hpxlint HPX019 checks the arbiter side and the real-tree analysis
+    test pins this justification."""
 
     def __init__(self, knobs: List[KnobBinding], *,
                  name: str = "serving",

@@ -230,8 +230,9 @@ def _sharded_payload(tree: Any) -> dict:
     caller, by design: a training loop with donated buffers
     (jit(donate_argnums=...)) invalidates the old state the moment the
     next step runs, so a deferred pull would race and read deleted
-    arrays. The snapshot is synchronous; serialization still runs as a
-    task."""
+    arrays (the same class of bug hpxlint HPX020 catches statically
+    inside one function). The snapshot is synchronous; serialization
+    still runs as a task."""
     import jax
     import numpy as np
     from jax.sharding import NamedSharding

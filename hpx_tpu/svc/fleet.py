@@ -85,7 +85,14 @@ class FleetRouter(DisaggRouter):
     the zero-leak close contract are all inherited from
     :class:`DisaggRouter`; this subclass swaps the placement policy,
     seeds prefills from placed workers' caches, and runs the
-    autoscaler inside the step loop."""
+    autoscaler inside the step loop.
+
+    Threading: the ``_fl_lock``-guarded counters (placement tallies,
+    prefill-savings, autoscale/retire bookkeeping, the digest table)
+    are mutated with the lock held at every site — hpxlint HPX019
+    infers that guarded-by contract from the real mutation sites and
+    the real-tree test pins it; per-placement loop state is
+    deliberately bare (step-loop-local, never shared)."""
 
     def __init__(self, params, cfg,
                  prefill_workers: Optional[int] = None,

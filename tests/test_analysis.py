@@ -955,7 +955,8 @@ def test_all_rules_registry():
                    "HPX005", "HPX006", "HPX007", "HPX008",
                    "HPX009", "HPX010", "HPX011", "HPX012",
                    "HPX013", "HPX014", "HPX015", "HPX016",
-                   "HPX017", "HPX018"]
+                   "HPX017", "HPX018", "HPX019", "HPX020",
+                   "HPX021", "HPX022"]
 
 
 def test_rule_registry_completeness(capsys):
@@ -973,6 +974,8 @@ def test_rule_registry_completeness(capsys):
         assert rule.id in listed
     project_ids = {r.id for r in all_rules() if r.scope == "project"}
     assert project_ids == {"HPX013", "HPX014", "HPX015"}
+    dataflow_ids = {r.id for r in all_rules() if r.scope == "dataflow"}
+    assert dataflow_ids == {"HPX019", "HPX020", "HPX021", "HPX022"}
 
 
 # ---------------------------------------------------------------------------
@@ -1388,10 +1391,11 @@ def test_cli_gate_on_real_tree():
 
 
 def test_full_run_parses_once_and_stays_fast():
-    # the project tier shares the per-file tier's parsed trees: a full
-    # two-tier run over N files costs exactly N ast.parse calls, and
-    # the whole pass (all 15 rules, cross-module index included) must
-    # stay inside the tier-1 perf budget
+    # the project and dataflow tiers share the per-file tier's parsed
+    # trees: a full three-tier run over N files costs exactly N
+    # ast.parse calls, and the whole pass (all 22 rules, cross-module
+    # index and def-use chains included) must stay inside the tier-1
+    # perf budget
     import time
     before = parse_count()
     t0 = time.monotonic()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""The hpxlint CI gate: one full three-tier sweep of the tree.
+
+Runs every registered rule (file, project, and dataflow tiers) over
+``hpx_tpu/`` with ``--format=github`` so findings render as inline PR
+annotations, and exits non-zero on any unjustified finding OR any
+stale baseline entry — the baseline only burns down, it never rots.
+
+Invoked by the tier-1 test battery (``tests/test_dataflow.py``) and
+usable standalone::
+
+    python tools/lint.py            # gate: github annotations, exit 1 on dirt
+    python tools/lint.py --text     # same gate, human-readable output
+
+Always scans from the repo root so the committed baseline's relative
+paths match regardless of the caller's cwd.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fmt = "text" if "--text" in argv else "github"
+    os.chdir(REPO_ROOT)
+    sys.path.insert(0, REPO_ROOT)
+    from hpx_tpu.analysis.cli import main as hpxlint
+    return hpxlint(["--format", fmt, "hpx_tpu"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
