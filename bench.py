@@ -104,6 +104,12 @@ def emit(metric, value, unit, vs_baseline, **extra):
     line = {"metric": metric, "value": round(value, 3), "unit": unit,
             "vs_baseline": round(vs_baseline, 3)}
     line.update(extra)
+    # EVERY live row carries provenance, not just fallbacks: a reader
+    # (and the merge below) must be able to tell an on-chip
+    # measurement from a builder-session re-emission without guessing
+    # from which keys happen to be present
+    line.setdefault("provenance", "on-chip")
+    line.setdefault("onchip", True)
     _EMITTED.append(line)
     print(json.dumps(line), flush=True)
     # save after EVERY metric: on a tunnel that wedges mid-run (observed
@@ -129,6 +135,13 @@ def _save_fallback() -> None:
         ts = ln.get("measured_at", "unknown")
         return "" if ts == "unknown" else ts
 
+    def _onchip(ln):
+        # explicit onchip flag wins; legacy lines with no provenance
+        # stamp predate builder-session labeling and are on-chip
+        if "onchip" in ln:
+            return bool(ln["onchip"])
+        return ln.get("provenance") != "builder-session"
+
     merged = {}
     for path in (_FALLBACK_SEED, _FALLBACK_LOCAL):
         try:
@@ -140,9 +153,16 @@ def _save_fallback() -> None:
             ln = dict(line)
             ln.setdefault("measured_at", rec.get("measured_at", "unknown"))
             prev = merged.get(ln.get("metric"))
-            # freshest wins regardless of which file it came from (a
-            # re-curated seed must beat a stale local record)
-            if prev is None or _stamp(ln) >= _stamp(prev):
+            # a builder-session re-emission must NEVER displace an
+            # on-chip measurement, whatever its timestamp says — the
+            # BENCH_r05 silent-re-emission failure mode. Between rows
+            # of equal provenance class, freshest wins regardless of
+            # which file it came from (a re-curated seed must beat a
+            # stale local record).
+            if prev is not None and _onchip(prev) and not _onchip(ln):
+                continue
+            if prev is None or _stamp(ln) >= _stamp(prev) \
+                    or (_onchip(ln) and not _onchip(prev)):
                 merged[ln.get("metric")] = ln
     for line in _EMITTED:
         ln = dict(line)

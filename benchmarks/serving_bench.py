@@ -118,10 +118,22 @@ PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
                            saving strictly more prefill tokens than
                            least-loaded, and zero leaked KV blocks.
 
+ 11. serving_tier       — the tiered-KV wave (--tier): one
+                           prefix-heavy greedy+sampled mix, radix
+                           budget deliberately smaller than the shared
+                           chain so the tail demotes to the host-RAM
+                           tier and later admissions promote it back
+                           (crossover-gated restore). Runs tier-off
+                           and tier-on and GATES on: sha-identical
+                           outputs, tier-on saving strictly more
+                           prefill tokens, zero leaked device blocks
+                           and zero leaked host buffers at drain.
+
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
                                           [--paged-decode-only] [--mesh]
                                           [--chaos] [--disagg] [--fleet]
+                                          [--tier]
                                           [--trace-out PATH]
                                           [--metrics-out PATH]
 
@@ -264,6 +276,112 @@ def main() -> int:
              prefill_tokens_saved=saved,
              prefill_tokens_computed=computed,
              prefill_saved_frac=round(saved / (saved + computed), 3))
+
+    # 4b. the tiered-KV wave (--tier): two 96-token shared prefixes
+    # ALTERNATE over one slot under a 4-block radix budget — each
+    # retire's budget sweep evicts the other (reader-free) chain
+    # wholesale, so the next admission of that prefix is restorable
+    # ONLY from the host tier. Tier-off this mix saves zero prefill
+    # tokens (every chain dies before its reuse); tier-on the
+    # crossover gate promotes the full prefix back each time.
+    # Identity is gated against a HOT-RETENTION ORACLE (tier off,
+    # UNBOUNDED radix budget: every reuse is a plain hot radix match):
+    # a promoted block must be byte-for-byte what hot retention would
+    # have served, so sha(tier-on) == sha(oracle) exactly. The
+    # budget-constrained tier-off wave is NOT the identity baseline —
+    # it never matches, and at fp8 a matched admission reads
+    # dequantized (quantizer-roundtripped) prefix rows while a
+    # recomputed one reads full-precision rows, a pre-existing
+    # prefix-reuse asymmetry independent of the tier (bf16/int8 are
+    # unaffected). That wave instead gates the strict-increase clause:
+    # tier-on must save STRICTLY more prefill tokens than tier-off
+    # with promotions actually observed, and zero leaked device blocks
+    # AND zero leaked host buffers once the radix drains — in all
+    # three waves.
+    def tier_bench() -> None:
+        import hashlib
+        from hpx_tpu.core.config import runtime_config
+        rc = runtime_config()
+        prefixes = [rng.integers(1, 1000, 96).tolist(),
+                    rng.integers(1, 1000, 96).tolist()]
+        treqs = [(prefixes[i % 2] + rng.integers(1, 1000, 8).tolist(),
+                  int(rng.integers(12, 25))) for i in range(8)]
+        ttotal = sum(m for _, m in treqs)
+
+        def run_wave(tier_on, budget=4):
+            rc.set("hpx.cache.tier.enable", "1" if tier_on else "0")
+            try:
+                srv = ContinuousServer(params, cfg, slots=1, smax=160,
+                                       paged=True, block_size=16,
+                                       kv_dtype="fp8",
+                                       radix_budget_blocks=budget)
+                free0 = srv._alloc.stats()["free"]
+                for i, (p, m) in enumerate(treqs):
+                    if i % 3 == 2:
+                        # sampled rows reuse per-index keys across the
+                        # two runs — identity must hold beyond greedy
+                        srv.submit(p, max_new=m, temperature=0.8,
+                                   key=jax.random.PRNGKey(1000 + i))
+                    else:
+                        srv.submit(p, max_new=m)
+                t0 = time.perf_counter()
+                out = srv.run()
+                secs = time.perf_counter() - t0
+                st = srv.cache_stats()
+                while sum(srv._radix.evict(1)):
+                    pass                        # drain the tree
+                dev_leak = free0 - srv._alloc.stats()["free"]
+                host_leak = (srv._tier.leaked_buffers()
+                             if srv._tier is not None else 0)
+                sha = hashlib.sha256(json.dumps(
+                    [out[r] for r in sorted(out)]).encode()).hexdigest()
+                return secs, st, sha, dev_leak, host_leak
+            finally:
+                rc.set("hpx.cache.tier.enable", "0")
+
+        run_wave(False)                        # compile
+        run_wave(True)                         # compile (restore prog)
+        off_secs, off_st, off_sha, off_dev, off_host = run_wave(False)
+        (_, hot_st, hot_sha,
+         hot_dev, hot_host) = run_wave(False, budget=None)  # oracle
+        secs, st, sha, dev_leak, host_leak = run_wave(True)
+        emit("serving_tier", ttotal, secs,
+             mix="8 reqs alternating two 96-tok shared prefixes + "
+                 "8-tok tails over 1 slot, radix budget 4 blocks, "
+                 "fp8 KV",
+             prefill_tokens_saved={
+                 "off": off_st["prefill_tokens_saved"],
+                 "on": st["prefill_tokens_saved"]},
+             tier_demoted=st.get("tier_demoted", 0),
+             tier_promoted=st.get("tier_promoted", 0),
+             tier_declined=st.get("tier_declined", 0),
+             baseline_tokens_per_s=round(ttotal / off_secs, 1),
+             kv_blocks_leaked={"off": off_dev, "hot": hot_dev,
+                               "on": dev_leak},
+             host_buffers_leaked=host_leak + off_host + hot_host,
+             output_sha=sha[:16],
+             output_identical_to_hot_oracle=(sha == hot_sha))
+        if (sha != hot_sha
+                or st["prefill_tokens_saved"]
+                <= off_st["prefill_tokens_saved"]
+                or st["prefill_tokens_saved"]
+                != hot_st["prefill_tokens_saved"]
+                or not st.get("tier_promoted")
+                or dev_leak or off_dev or hot_dev
+                or host_leak or off_host or hot_host):
+            print(json.dumps({
+                "error": "tier gate failed",
+                "hot_oracle_sha": hot_sha[:16], "on_sha": sha[:16],
+                "prefill_tokens_saved": {
+                    "off": off_st["prefill_tokens_saved"],
+                    "hot": hot_st["prefill_tokens_saved"],
+                    "on": st["prefill_tokens_saved"]},
+                "kv_blocks_leaked": {"off": off_dev, "hot": hot_dev,
+                                     "on": dev_leak},
+                "host_buffers_leaked": (host_leak + off_host
+                                        + hot_host)}),
+                flush=True)
+            raise SystemExit(2)
 
     # 5. the speculation wave: half the mix is repetitive (periodic
     # prompts whose continuations prompt-lookup nails), half is random
@@ -1070,6 +1188,10 @@ def main() -> int:
 
     if "--prefix-only" in sys.argv:
         paged_prefix_bench()
+        return finish()
+
+    if "--tier" in sys.argv:
+        tier_bench()
         return finish()
 
     if "--spec-only" in sys.argv:

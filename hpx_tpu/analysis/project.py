@@ -672,8 +672,13 @@ class ConfigKeySchema(ProjectRule):
 # HPX015 — refcount balance
 # ---------------------------------------------------------------------------
 
-_ACQ_OPS = {"incref": "decref", "pin": "unpin"}
-_REL_OPS = {"decref": "incref", "unpin": "pin"}
+_ACQ_OPS = {"incref": "decref", "pin": "unpin",
+            "checkout": "checkin"}
+# putback is the abort-path release of a checkout (cache/tier.py): the
+# entry returns to the tier instead of being consumed, but either way
+# the caller no longer owns it
+_REL_OPS = {"decref": "incref", "unpin": "pin",
+            "checkin": "checkout", "putback": "checkout"}
 _HPX015_SUBPATHS = ("hpx_tpu/cache/", "hpx_tpu/models/")
 _MAX_STATES = 64
 
@@ -863,14 +868,16 @@ class _RefcountWalker:
 
 @register
 class RefcountBalance(ProjectRule):
-    """HPX015: a block reference taken via incref()/pin() escapes on
-    some exit path without the matching decref()/unpin() — the static
-    twin of BlockAllocator.leaked_blocks(). Functions that only
-    acquire (ownership transfer to a tree/table, released elsewhere)
-    are exempt; the rule fires when the SAME function does release the
-    population on other paths but misses one. Fix: release in a
-    finally/except mirror of the acquire, or hand the reference to an
-    owner that retires it."""
+    """HPX015: a block reference taken via incref()/pin() — or a host
+    tier entry taken via checkout() — escapes on some exit path
+    without the matching decref()/unpin()/checkin() (putback counts as
+    the abort-path release of a checkout) — the static twin of
+    BlockAllocator.leaked_blocks() and HostTier.leaked_buffers().
+    Functions that only acquire (ownership transfer to a tree/table,
+    released elsewhere) are exempt; the rule fires when the SAME
+    function does release the population on other paths but misses
+    one. Fix: release in a finally/except mirror of the acquire, or
+    hand the reference to an owner that retires it."""
 
     id = "HPX015"
     name = "refcount-balance"

@@ -49,6 +49,18 @@ Paged servers additionally export the cache counters::
                                                           sidecars incl. — fp8 pools
                                                           report the ~0.25x ratio vs
                                                           an f32 compute dtype)
+
+Tiered servers (``hpx.cache.tier.enable``) add the host-tier feed::
+
+    /cache{locality#L/server#i}/tier/bytes-held         host bytes retained
+    /cache{locality#L/server#i}/tier/entries            demoted blocks held
+    /cache{locality#L/server#i}/tier/count/demoted      evictions the tier kept
+    /cache{locality#L/server#i}/tier/count/promoted     blocks restored to device
+    /cache{locality#L/server#i}/tier/count/dropped      LRU'd out of the tier
+    /cache{locality#L/server#i}/tier/count/declined     gate chose re-prefill
+    /cache{locality#L/server#i}/tier/hit-depth-blocks   cumulative promoted depth
+    /cache{locality#L/server#i}/tier/promote-latency-s  promotion histogram
+                                                        (+ derived pNN counters)
 """
 
 from __future__ import annotations
@@ -199,6 +211,35 @@ def register_server(srv) -> str:
         put("cache", "bytes/hbm-read-per-token",
             pc.CallbackCounter(_read(ref, lambda s: s.hbm_read_stats()
                                ["hbm_read_bytes_per_token"])))
+        if getattr(srv, "_tier", None) is not None:
+            # host-RAM demotion tier (cache/tier.py): occupancy,
+            # demote/promote/drop/decline totals, cumulative hit
+            # depth, and the promotion-latency histogram (with its
+            # derived pNN quantile counters) — /cache{...}/tier/*
+            put("cache", "tier/bytes-held",
+                pc.CallbackCounter(_read(
+                    ref, lambda s: s._tier.stats()["tier_bytes_held"])))
+            put("cache", "tier/entries",
+                pc.CallbackCounter(_read(
+                    ref, lambda s: s._tier.stats()["tier_entries"])))
+            put("cache", "tier/count/demoted",
+                pc.CallbackCounter(_read(
+                    ref, lambda s: s._tier.total_demoted)))
+            put("cache", "tier/count/promoted",
+                pc.CallbackCounter(_read(
+                    ref, lambda s: s._tier.total_promoted)))
+            put("cache", "tier/count/dropped",
+                pc.CallbackCounter(_read(
+                    ref, lambda s: s._tier.total_dropped)))
+            put("cache", "tier/count/declined",
+                pc.CallbackCounter(_read(
+                    ref, lambda s: s._tier.total_declined)))
+            put("cache", "tier/hit-depth-blocks",
+                pc.CallbackCounter(_read(
+                    ref, lambda s: s._tier.hit_depth_blocks)))
+            names.extend(register_histogram(
+                "cache", "tier/promote-latency-s", srv._tier_hist,
+                inst))
 
     with _lock:
         _servers[idx] = (ref, names)

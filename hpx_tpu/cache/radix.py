@@ -19,6 +19,14 @@ knob `hpx.cache.radix_budget_blocks`), plus on-demand via `evict(n)`
 when the allocator reports OOM (serving's OOM→evict→retry path). A
 logical clock orders recency — deterministic replay matters more here
 than wall time.
+
+Eviction is no longer unconditionally to oblivion: when a `demote_hook`
+is installed (the host tier in `cache/tier.py`), each victim block's
+raw rows are offered to the tier BEFORE the tree reference drops, and
+`evict` reports the `(demoted, dropped)` split. `match_tiered` is the
+two-tier read path: the hot walk of `match`, extended by consecutive
+host-tier probes keyed by the continuation chain hashes — the server
+decides per hit (crossover gate) whether to restore or re-prefill.
 """
 
 from __future__ import annotations
@@ -83,10 +91,18 @@ class RadixCache:
         self._clock = 0
         self._blocks_held = 0
         self._lock = Mutex()
+        # demotion tier hand-off: called as hook(chain_hash,
+        # parent_hash, token_chunk, block_id) BEFORE the tree
+        # reference drops; a True return counts the eviction as
+        # demoted rather than dropped. Hook failures never block
+        # eviction — the block is dropped as before.
+        self.demote_hook = None
         # cumulative stats (cache/counters.py reads these)
         self.tokens_requested = 0
         self.tokens_matched = 0
         self.total_evictions = 0
+        self.total_demoted = 0
+        self.total_dropped = 0
         self.total_inserts = 0
 
     # -- helpers ----------------------------------------------------------
@@ -143,6 +159,56 @@ class RadixCache:
             tracing.instant("cache.match", "cache", matched=matched,
                             requested=len(tokens), blocks=len(bids))
         return matched, bids
+
+    def match_tiered(self, tokens: Sequence[int], tier
+                     ) -> Tuple[int, List[int],
+                                List[Tuple[int, Tuple[int, ...], int]]]:
+        """Two-tier match: the hot walk of :meth:`match`, then — where
+        the tree ran out — consecutive host-tier probes keyed by the
+        continuation chain hashes. Returns ``(matched_tokens,
+        block_ids, tier_ext)`` where ``tier_ext`` lists
+        ``(chain_hash, token_chunk, nbytes)`` for the whole-block
+        chunks the tier holds immediately past the hot match (stops at
+        the first cold miss — tier chains are only restorable as a
+        consecutive run). The caller holds NO tier references — it
+        checks entries out explicitly once the crossover gate decides
+        to promote."""
+        chunks = []
+        with self._lock:
+            self.tokens_requested += len(tokens)
+            node = self._root
+            bids: List[int] = []
+            parent = b""
+            chunks = list(self._chunks(tokens))
+            depth = 0
+            for chunk in chunks:
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                parent = _chain(parent, chunk)
+                self.allocator.incref(child.bid)
+                bids.append(child.bid)
+                self._touch(child)
+                node = child
+                depth += 1
+            matched = len(bids) * self.block_size
+            self.tokens_matched += matched
+        # tier probes OUTSIDE the tree lock: the tier has its own lock
+        # and a racing demotion only changes what probes hit, never
+        # tree consistency
+        ext: List[Tuple[int, Tuple[int, ...], int]] = []
+        for chunk in chunks[depth:]:
+            parent = _chain(parent, chunk)
+            h = int.from_bytes(parent, "little")
+            nb = tier.probe(h, chunk)
+            if nb is None:
+                break
+            ext.append((h, chunk, int(nb)))
+        if tracing.active_tracer() is not None:
+            tracing.instant("cache.match", "cache", matched=matched,
+                            requested=len(tokens), blocks=len(bids),
+                            tier_blocks=len(ext))
+        return matched, bids, ext
 
     def peek(self, tokens: Sequence[int], k: int) -> List[int]:
         """Read-only continuation probe for prompt-lookup drafting:
@@ -243,17 +309,33 @@ class RadixCache:
 
     # -- eviction ---------------------------------------------------------
 
-    def evict(self, n: int) -> int:
-        """Free up to `n` blocks by dropping idle leaf chains in LRU
+    def evict(self, n: int) -> Tuple[int, int]:
+        """Free up to `n` blocks by evicting idle leaf chains in LRU
         order. A leaf is evictable when the tree holds the ONLY
-        reference (no live request reads it). Returns blocks freed —
-        possibly 0 when everything retained is in use."""
+        reference (no live request reads it). Returns the
+        ``(demoted, dropped)`` split — demoted blocks were accepted by
+        the `demote_hook` tier before their device block freed,
+        dropped ones are gone. Both free a device block, so
+        ``sum(evict(n))`` is blocks freed — possibly 0 when everything
+        retained is in use."""
         with self._lock:
             return self._evict_locked(n)
 
-    def _evict_locked(self, n: int) -> int:
-        freed = 0
-        while freed < n:
+    def _chain_of(self, node: _Node) -> Tuple[bytes, bytes]:
+        """(parent_hash, chain_hash) of `node`, by folding root→node."""
+        keys: List[Tuple[int, ...]] = []
+        walk: Optional[_Node] = node
+        while walk is not None and walk is not self._root:
+            keys.append(walk.key)
+            walk = walk.parent
+        parent = b""
+        for k in reversed(keys[1:]):
+            parent = _chain(parent, k)
+        return parent, _chain(parent, node.key)
+
+    def _evict_locked(self, n: int) -> Tuple[int, int]:
+        demoted = dropped = 0
+        while demoted + dropped < n:
             victim: Optional[_Node] = None
             stack = [self._root]
             while stack:
@@ -267,16 +349,35 @@ class RadixCache:
                     victim = node
             if victim is None:
                 break
+            kept = False
+            hook = self.demote_hook
+            if hook is not None:
+                parent, chain = self._chain_of(victim)
+                try:
+                    # hook runs BEFORE the decref: the block is still
+                    # tree-owned, so its rows are stable while the
+                    # tier copies them out
+                    kept = bool(hook(int.from_bytes(chain, "little"),
+                                     int.from_bytes(parent, "little"),
+                                     victim.key, victim.bid))
+                except Exception:
+                    kept = False      # a failing tier never blocks OOM
             self.allocator.decref(victim.bid)
             assert victim.parent is not None
             del victim.parent.children[victim.key]
             self._blocks_held -= 1
             self.total_evictions += 1
-            freed += 1
-        if freed and tracing.active_tracer() is not None:
-            tracing.instant("cache.evict", "cache", freed=freed,
+            if kept:
+                demoted += 1
+                self.total_demoted += 1
+            else:
+                dropped += 1
+                self.total_dropped += 1
+        if (demoted or dropped) and tracing.active_tracer() is not None:
+            tracing.instant("cache.evict", "cache",
+                            freed=demoted + dropped, demoted=demoted,
                             requested=n, held=self._blocks_held)
-        return freed
+        return demoted, dropped
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
@@ -287,5 +388,7 @@ class RadixCache:
                 "tokens_matched": hit,
                 "hit_rate": (hit / req) if req else 0.0,
                 "total_evictions": self.total_evictions,
+                "total_demoted": self.total_demoted,
+                "total_dropped": self.total_dropped,
                 "total_inserts": self.total_inserts,
             }

@@ -7,13 +7,20 @@ checksummed, idempotent.
 
 Wire unit is the :class:`KVSegment`: a contiguous run of finished
 prefill rows for one request, framed with (rid, seq, start, ntok,
-total) and a sha256 over header+payload. The payload is the RAW
-compute-dtype scratch rows the prefill worker's chunk programs
-produced — the receiver splices them into its own pool through the
-server's `_paged_splice_prog`, which quantizes identically to the
-colocated path, so pool bytes on the decode worker equal what a
+total) and a sha256 over header+payload. For disaggregated prefill the
+payload is the RAW compute-dtype scratch rows the prefill worker's
+chunk programs produced — the receiver splices them into its own pool
+through the server's `_paged_splice_prog`, which quantizes identically
+to the colocated path, so pool bytes on the decode worker equal what a
 colocated prefill would have written. That identity is what lets
 decode failover replay from shipped blocks byte-exactly.
+
+The host-tier promotion path (`cache/tier.py`) rides the same framing
+with a different payload contract: RAW POOL-DTYPE block rows (int8 /
+fp8 quantized bytes, axis 2 in tokens) plus a second segment stream of
+f32 scale sidecars (axis 2 in blocks), spliced back dequantize-free at
+the promoted block ids. The checksum covers dtype+shape+bytes either
+way, so both contracts get the same corruption/idempotency guarantees.
 
 Delivery discipline (the robustness core):
 

@@ -259,6 +259,24 @@ declare("hpx.cache.kv_dtype", "str", "bf16",
         "integer blocks) | fp8 (e4m3 blocks, same f32 scale sidecars — "
         "~0.25x decode bytes/token vs an f32 compute dtype)",
         choices=("bf16", "int8", "fp8"))
+declare("hpx.cache.tier.enable", "bool", "0",
+        "host-RAM KV tier: radix evictions demote block rows (raw "
+        "quantized bytes + scale sidecars) to pooled host buffers "
+        "instead of dropping them")
+declare("hpx.cache.tier.host_budget_mb", "int", "256",
+        "host tier byte budget; LRU-to-oblivion past it",
+        tunable=Tunable(lo=1, hi=1 << 20, step=2, geometric=True))
+declare("hpx.cache.tier.min_speedup", "float", "1.0",
+        "promote only when estimated re-prefill time exceeds restore "
+        "time by this factor")
+declare("hpx.cache.tier.probe_mb", "int", "4",
+        "host->device bandwidth probe transfer size")
+declare("hpx.cache.tier.prefill_cost_us", "float", "50.0",
+        "fallback per-token prefill cost when progprof has no live "
+        "pg_chunk/cb_chunk samples yet")
+declare("hpx.cache.tier.restore_overhead_us", "float", "200.0",
+        "fixed per-promotion overhead added to the copy-time estimate "
+        "(framing, checksum, splice dispatch)")
 
 # -- serving ----------------------------------------------------------------
 declare("hpx.serving.paged_kernel", "str", "auto",
@@ -335,6 +353,9 @@ declare("hpx.serving.fleet.w_prefix", "float", "1.0",
         "fleet placement: score weight per digest-matched block")
 declare("hpx.serving.fleet.w_pressure", "float", "0.05",
         "fleet placement: score penalty per eviction/s of pressure")
+declare("hpx.serving.fleet.w_tier", "float", "0.25",
+        "fleet placement: discount on w_prefix for blocks a worker "
+        "holds only in its host tier (cold but restorable)")
 declare("hpx.serving.fleet.scale_high", "int", "8",
         "fleet autoscale: queue depth that spins a decode worker up")
 declare("hpx.serving.fleet.scale_low", "int", "0",

@@ -282,6 +282,11 @@ class DecodeWorker(_WorkerRing):
         srv = self.srv
         return {
             "hashes": srv._radix.prefix_digest(max_entries),
+            # cold mirror: chains held only in the host tier — the
+            # router scores these with the discounted w_tier weight
+            "tier_hashes": (srv._tier.digest(max_entries)
+                            if getattr(srv, "_tier", None) is not None
+                            else []),
             "evictions": int(srv._radix.total_evictions),
             "blocks_held": int(srv._radix.blocks_held),
             "blocks_free": int(srv._alloc.free_count),
@@ -355,7 +360,7 @@ class DecodeWorker(_WorkerRing):
         """Blocks still in use once the radix cache (a CACHE, not a
         reservation) is fully evicted — must be 0 after close().
         Excludes the server's one permanently resident trash block."""
-        while self.srv._radix.evict(1):
+        while sum(self.srv._radix.evict(1)):
             pass
         return int(self.srv._alloc.stats()["in_use"]) - 1
 
@@ -1079,7 +1084,7 @@ class DisaggRouter:
             except _WorkerDown:
                 continue
         if self._local is not None:
-            while self._local._radix.evict(1):
+            while sum(self._local._radix.evict(1)):
                 pass
             # minus the fallback server's resident trash block
             total += int(self._local._alloc.stats()["in_use"]) - 1

@@ -122,6 +122,7 @@ _RELOADABLE_KNOBS = (
     "hpx.serving.ckpt_every",
     "hpx.serving.spec.k",
     "hpx.cache.radix_budget_blocks",
+    "hpx.cache.tier.host_budget_mb",
 )
 
 
@@ -993,6 +994,26 @@ class ContinuousServer:
         # here, so masked decode lanes scatter into rows nothing reads
         self._trash = self._alloc.alloc()
         self._radix = RadixCache(self._alloc, radix_budget_blocks)
+        # host-RAM demotion tier (cache/tier.py): radix evictions
+        # demote raw block rows + scale sidecars into host buffers,
+        # and the two-tier match promotes them back through the
+        # KVSegment framing when the crossover gate says restore
+        # beats re-prefill
+        self._tier = None
+        self._tier_gate = None
+        self._tier_rx = None
+        self._tier_hist = None
+        if rc.get_bool("hpx.cache.tier.enable", False):
+            from ..cache.tier import HostTier, RestoreGate
+            from ..cache.transfer import TransferReceiver
+            from ..svc import metrics as _metrics
+            budget_mb = rc.get_int("hpx.cache.tier.host_budget_mb",
+                                   256)
+            self._tier = HostTier(budget_mb << 20, block_size=bs)
+            self._tier_gate = RestoreGate()
+            self._tier_rx = TransferReceiver()
+            self._tier_hist = _metrics.HistogramCounter()
+            self._radix.demote_hook = self._demote_block
         nkv, hd = cfg.kv_heads, cfg.head_dim
 
         # sharded paged serving: pools/scales shard their kv-head axis
@@ -1212,7 +1233,13 @@ class ContinuousServer:
         into a contiguous b=1 scratch cache the shared chunk/probe
         programs run over — int8 pools dequantize here, so the scratch
         (and every chunk program over it) stays in the compute dtype.
-        Keyed once per server shape."""
+        Rows at/past `valid` (the matched prefix length) zero out:
+        they gather from not-yet-written blocks and table padding, and
+        stale quantized garbage can dequantize to values large enough
+        to defeat additive attention masking (an fp8 byte times a
+        stale f32 scale is unbounded) — zeroing makes the scratch a
+        pure function of the matched content instead of allocation
+        history. Keyed once per server shape."""
         cfg = self.cfg
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_gather", cfg, self.smax, nb, bs, self._kv_dtype,
@@ -1220,14 +1247,24 @@ class ContinuousServer:
 
         def build():
             dt = cfg.dtype
+            rows = self._maxb * bs
 
-            def gather(pools, scales, trow):
+            def gather(pools, scales, trow, valid):
+                keep = (jnp.arange(rows) < valid)[None, :, None, None]
                 if scales is None:
-                    return [(gather_block_kv(kp, trow[None]),
-                             gather_block_kv(vp, trow[None]))
+                    return [(jnp.where(keep,
+                                       gather_block_kv(kp, trow[None]),
+                                       0),
+                             jnp.where(keep,
+                                       gather_block_kv(vp, trow[None]),
+                                       0))
                             for kp, vp in pools]
-                return [(gather_block_kv(kp, trow[None], ks, dt),
-                         gather_block_kv(vp, trow[None], vs, dt))
+                return [(jnp.where(keep,
+                                   gather_block_kv(kp, trow[None], ks,
+                                                   dt), 0),
+                         jnp.where(keep,
+                                   gather_block_kv(vp, trow[None], vs,
+                                                   dt), 0))
                         for (kp, vp), (ks, vs) in zip(pools, scales)]
             return jax.jit(gather)
         return self._program(ck, build)
@@ -1320,6 +1357,42 @@ class ContinuousServer:
                             scales, scale_sh)
                 return pools, scales
             return jax.jit(copy, donate_argnums=(0, 1))
+        return self._program(ck, build)
+
+    def _tier_restore_prog(self):
+        """Host-tier promotion splice: write ONE restored block's RAW
+        pool-dtype rows (and the f32 scale sidecars on quantized
+        pools) at its promoted block id. Dequantize-free by
+        construction — the bytes written are the bytes demoted, so a
+        promoted block dequantizes bit-identically to the block the
+        radix tree evicted (the sha-identity the crossover tests pin).
+        One block per dispatch keeps the program shape fixed — a
+        promotion chain costs N dispatches, never N compiles."""
+        nb, bs = self._alloc.num_blocks, self.block_size
+        ck = ("pg_tier_restore", self.cfg, self.smax, nb, bs,
+              self._kv_dtype, self.mesh, _tree_key(self.params))
+
+        def build():
+            pool_sh, scale_sh = self._pool_sh, self._scale_sh
+
+            def restore(pools, scales, bid, rows, scs):
+                pools = [(kp.at[bid].set(rows[li, 0].astype(kp.dtype)),
+                          vp.at[bid].set(rows[li, 1].astype(vp.dtype)))
+                         for li, (kp, vp) in enumerate(pools)]
+                if scales is not None:
+                    scales = [(ks.at[bid].set(scs[li, 0]),
+                               vs.at[bid].set(scs[li, 1]))
+                              for li, (ks, vs) in enumerate(scales)]
+                if pool_sh is not None:
+                    # dp-replicated block axis: the restored rows land
+                    # on every dp replica, same as a colocated write
+                    pools = jax.lax.with_sharding_constraint(
+                        pools, pool_sh)
+                    if scales is not None:
+                        scales = jax.lax.with_sharding_constraint(
+                            scales, scale_sh)
+                return pools, scales
+            return jax.jit(restore, donate_argnums=(0, 1))
         return self._program(ck, build)
 
     # -- speculative programs (verify windows + draft model) -------------
@@ -1445,7 +1518,7 @@ class ContinuousServer:
             injected = isinstance(e, faultinject.InjectedFault)
             if injected:
                 self._flt_injected += 1
-            if not self._radix.evict(1):
+            if not sum(self._radix.evict(1)):
                 raise
             if injected:
                 self._flt_retried += 1
@@ -1526,6 +1599,114 @@ class ContinuousServer:
             self._alloc.decref(bid)
         self._tables[slot] = None
 
+    # -- host tier (cache/tier.py): demotion + gated promotion -----------
+
+    def _demote_block(self, chain: int, parent: int, key, bid: int):
+        """RadixCache demote hook: copy one evicted block's RAW pool
+        rows (quantized bytes on int8/fp8 pools, plus the f32 scale
+        sidecars) to the host tier. Runs under the radix lock BEFORE
+        the tree reference drops, so the rows are stable; published
+        blocks are immutable (COW + trash-redirected splices), so the
+        snapshot is the block's final bytes. Returns the tier's
+        verdict — False (budget refuses) counts the eviction as
+        dropped, exactly the pre-tier behavior."""
+        tier = self._tier
+        if tier is None:
+            return False
+        layers = []
+        scl = [] if self._scales is not None else None
+        for li, (kp, vp) in enumerate(self._pools):
+            layers.append(np.stack((np.asarray(kp[bid]),
+                                    np.asarray(vp[bid]))))
+            if scl is not None:
+                ks, vs = self._scales[li]
+                scl.append(np.stack((np.asarray(ks[bid]),
+                                     np.asarray(vs[bid]))))
+        rows = np.stack(layers)             # [L, 2, bs, n_kv, hd]
+        scs = (np.stack(scl).astype(np.float32)
+               if scl is not None else None)    # [L, 2, n_kv]
+        return tier.demote(chain, parent, key, rows, scs)
+
+    def _promote_tier(self, req: "_Request", matched: int,
+                      mbids: List[int], ext) -> int:
+        """Crossover-gated promotion of a host-tier hit: when restore
+        beats re-prefill (RestoreGate), re-ship the tier entries'
+        raw rows through the KVSegment framing (checksums, idempotent
+        seq numbers — the disagg delivery discipline, exercised
+        in-process), splice them dequantize-free at freshly allocated
+        block ids, and republish the chain in the radix tree. Appends
+        the promoted ids to `mbids` and returns the extra whole-block
+        tokens restored (0 = gate declined or nothing could be held —
+        the caller re-prefills, entries stay in the tier)."""
+        from ..cache.transfer import make_segment
+        bs = self.block_size
+        promote, _est = self._tier_gate.should_promote(
+            len(ext) * bs, sum(nb for _, _, nb in ext))
+        if not promote:
+            self._tier.declined(len(ext))
+            return 0
+        t0 = time.perf_counter()
+        bids: List[int] = []
+        try:
+            for _ in ext:
+                bids.append(self._alloc_block())
+        except CacheOOM:
+            pass        # a partial chain prefix is still a win
+        if not bids:
+            self._tier.declined(len(ext))
+            return 0
+        entries = []
+        for h, _chunk, _nb in ext[:len(bids)]:
+            e = self._tier.checkout(h)
+            if e is None:
+                break   # raced out by a concurrent demotion wave
+            entries.append(e)
+        n = len(entries)
+        for bid in bids[n:]:
+            self._alloc.decref(bid)
+        bids = bids[:n]
+        if not n:
+            return 0
+        rid = f"tier:{req.rid}:{self._pf_seq}"
+        try:
+            for i, e in enumerate(entries):
+                self._tier_rx.ingest(make_segment(
+                    rid, i, i * bs, n * bs, e.rows))
+                if e.scales is not None:
+                    self._tier_rx.ingest(make_segment(
+                        "scale/" + rid, i, i, n,
+                        e.scales[:, :, None, :]))
+            rows = self._tier_rx.assemble(rid)
+            scs = (self._tier_rx.assemble("scale/" + rid)
+                   if entries[0].scales is not None else None)
+        except HpxError:
+            # corrupt/incomplete frame: keep the data (putback), free
+            # the blocks, fall back to re-prefill — never a leak
+            self._tier_rx.abort(rid)
+            self._tier_rx.abort("scale/" + rid)
+            for e in entries:
+                self._tier.putback(e)
+            for bid in bids:
+                self._alloc.decref(bid)
+            return 0
+        for i, bid in enumerate(bids):
+            blk = jnp.asarray(rows[:, :, i * bs:(i + 1) * bs])
+            sblk = (None if scs is None
+                    else jnp.asarray(scs[:, :, i]))
+            self._pools, self._scales = self._tier_restore_prog()(
+                self._pools, self._scales, jnp.int32(bid), blk, sblk)
+        # republish: the tree takes its reference on the promoted
+        # blocks (refcount 2 = tree + our lease, same as a hot match)
+        self._radix.insert(req.prompt[:matched + n * bs],
+                           list(mbids) + bids)
+        mbids.extend(bids)
+        for e in entries:
+            self._tier.checkin(e)
+        if self._tier_hist is not None:
+            jax.block_until_ready(self._pools)
+            self._tier_hist.record(time.perf_counter() - t0)
+        return n * bs
+
     def cache_stats(self) -> Dict[str, float]:
         """Paged-mode observability snapshot (the same numbers the
         /cache{...} performance counters export)."""
@@ -1533,6 +1714,8 @@ class ContinuousServer:
             raise ValueError("cache_stats() requires paged=True")
         st: Dict[str, float] = dict(self._alloc.stats())
         st.update(self._radix.stats())
+        if self._tier is not None:
+            st.update(self._tier.stats())
         st["prefill_tokens_saved"] = self._prefill_saved
         st["prefill_tokens_computed"] = self._prefill_computed
         st.update(self.hbm_read_stats())
@@ -1802,11 +1985,22 @@ class ContinuousServer:
     def _start_paged(self, req: "_Request",
                      slot: int) -> _PendingPrefill:
         plen = len(req.prompt)
-        matched, mbids = 0, []
+        matched, mbids, tier_ext = 0, [], []
         if self._prefix_reuse:
             # always leave >= 1 suffix token: admission needs the LAST
             # prompt token's logits to seed generation
-            matched, mbids = self._radix.match(req.prompt[:-1])
+            if self._tier is not None:
+                matched, mbids, tier_ext = self._radix.match_tiered(
+                    req.prompt[:-1], self._tier)
+            else:
+                matched, mbids = self._radix.match(req.prompt[:-1])
+        if tier_ext:
+            # crossover-gated restore: a promoted chain extends the
+            # hot match (mbids grows, matched covers the restored
+            # blocks, the write row below trash-redirects them), a
+            # declined one re-prefills with entries left in the tier
+            matched += self._promote_tier(req, matched, mbids,
+                                          tier_ext)
         pt = PageTable(self.block_size)
         pt.extend_blocks(mbids)
         try:
@@ -1828,7 +2022,7 @@ class ContinuousServer:
         wnp[:matched // self.block_size] = self._trash
         wrow = jnp.asarray(wnp)
         caches = self._paged_gather_prog()(self._pools, self._scales,
-                                           trow)
+                                           trow, jnp.int32(matched))
         return _PendingPrefill(req=req, slot=slot, caches=caches,
                                done=matched, seq=self._pf_seq, pt=pt,
                                trow=trow, wrow=wrow)
@@ -2616,6 +2810,10 @@ class ContinuousServer:
                                    self.prefill_buckets[-1] - 1)
             elif key == "hpx.cache.radix_budget_blocks" and self.paged:
                 self._radix.budget_blocks = max(1, int(raw))
+            elif key == "hpx.cache.tier.host_budget_mb" and self.paged \
+                    and self._tier is not None:
+                # shrink applies on the next demotion's LRU sweep
+                self._tier.budget_bytes = max(1, int(raw)) << 20
 
     def _tune_signals(self):
         """One TuneSignals sample for the tuner: decayed tokens/s,

@@ -124,6 +124,8 @@ class FleetRouter(DisaggRouter):
             "hpx.serving.fleet.w_prefix", 1.0)
         self._w_pressure = rc.get_float(
             "hpx.serving.fleet.w_pressure", 0.05)
+        self._w_tier = rc.get_float(
+            "hpx.serving.fleet.w_tier", 0.25)
         self._pool_min = max(1, rc.get_int(
             "hpx.serving.fleet.decode_pool_min", 1))
         self._pool_max = rc.get_int(
@@ -182,6 +184,10 @@ class FleetRouter(DisaggRouter):
             rate = max(0.0, (int(d["evictions"]) - ent["evictions"])
                        / dt)
         ent = {"set": frozenset(int(x) for x in d["hashes"]),
+               # chains held only in the worker's host tier — cold but
+               # restorable, scored with the discounted w_tier weight
+               "tier_set": frozenset(
+                   int(x) for x in d.get("tier_hashes", ())),
                "at": now, "evictions": int(d["evictions"]),
                "rate": rate}
         with self._fl_lock:
@@ -212,13 +218,26 @@ class FleetRouter(DisaggRouter):
                         if hs[i] in ent["set"]:
                             matched = i + 1
                             break
-                    if not matched:
+                    # tier depth: how far the worker covers the prompt
+                    # counting its HOST tier too — blocks it holds only
+                    # cold score at w_prefix * w_tier (restore beats a
+                    # cold miss, recompute beats a restore), so a
+                    # worker holding the prefix cold still outranks one
+                    # without it
+                    tiered = matched
+                    for i in range(len(hs) - 1, matched - 1, -1):
+                        if hs[i] in ent["tier_set"]:
+                            tiered = i + 1
+                            break
+                    if not tiered:
                         continue
                     score = (matched * self._w_prefix
+                             + (tiered - matched) * self._w_prefix
+                             * self._w_tier
                              - ent["rate"] * self._w_pressure)
                     if score > best_score:
                         best, best_score = h, score
-                        best_matched = matched
+                        best_matched = tiered
             if best is None:
                 best = self._least_loaded_decode()
             with self._fl_lock:

@@ -155,6 +155,12 @@ def build_bundle(kind: str, site: Optional[str] = None,
     # bundle capture, so the zero-cost discipline holds.
     from . import autotune
     doc["tune"] = autotune.flight_snapshot()
+    # host-tier state: occupancy + demote/promote/drop totals across
+    # every live tier, so a shed bundle answers "was the cold tier
+    # absorbing evictions or thrashing when this request died". {}
+    # when no tier is live (the key stays optional, like tune).
+    from ..cache import tier as _tier
+    doc["tier"] = _tier.flight_snapshot()
     if extra:
         doc["extra"] = dict(extra)
     return doc
@@ -259,6 +265,9 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
     tune = doc.get("tune")
     if tune is not None and not isinstance(tune, dict):
         errs.append("tune must be absent or an object")
+    tier = doc.get("tier")
+    if tier is not None and not isinstance(tier, dict):
+        errs.append("tier must be absent or an object")
     return errs
 
 

@@ -1283,6 +1283,99 @@ class Pool:
     assert res.findings == []
 
 
+# the host-tier checkout family (cache/tier.py): checkout() acquires an
+# entry, checkin() retires it, putback() is the abort-path release —
+# same balance discipline, one tier down from incref/decref
+
+def _hpx015_tier(source):
+    res = lint_sources({"hpx_tpu/cache/tier.py": source},
+                       rules=all_rules(["HPX015"]))
+    return res.findings
+
+
+def test_hpx015_tier_checkout_leak_fires():
+    fs = _hpx015_tier("""\
+class Promoter:
+    def restore(self, tier, h, bad):
+        tier.checkout(h)
+        if bad:
+            return 0
+        tier.checkin(h)
+        return 1
+""")
+    assert rules_of(fs) == ["HPX015"]
+    assert "checkout(h) in Promoter.restore" in fs[0].message
+    assert "checkin()" in fs[0].message
+
+
+def test_hpx015_tier_putback_on_abort_is_silent():
+    # putback balances the checkout on the abort path exactly like
+    # checkin does on the success path
+    assert _hpx015_tier("""\
+class Promoter:
+    def restore(self, tier, h, bad):
+        tier.checkout(h)
+        if bad:
+            tier.putback(h)
+            return 0
+        tier.checkin(h)
+        return 1
+""") == []
+
+
+def test_hpx015_tier_checkout_transfer_is_silent():
+    # the real promotion shape: checkout(hash) returns an ENTRY that
+    # is checked in under its own name — the differing operand keys
+    # keep the ownership-transfer exemption intact
+    assert _hpx015_tier("""\
+class Promoter:
+    def promote(self, tier, h, bad):
+        e = tier.checkout(h)
+        if e is None:
+            return None
+        if bad:
+            tier.putback(e)
+            return None
+        tier.checkin(e)
+        return e
+""") == []
+
+
+def test_hpx016_tier_counter_namespace_is_stable():
+    """The /cache{...}/tier/* namespace is an observability contract:
+    every leaf cache/counters.py registers for a tiered server must
+    (a) still be registered under exactly that name and (b) parse
+    under the HPX016 counter grammar — base names and the derived pNN
+    quantile counters alike."""
+    from hpx_tpu.analysis.rules import _COUNTER_NAME_RE
+    from hpx_tpu.svc.metrics import configured_quantiles, quantile_label
+    from hpx_tpu.svc.performance_counters import counter_name
+
+    leaves = ["tier/bytes-held", "tier/entries",
+              "tier/count/demoted", "tier/count/promoted",
+              "tier/count/dropped", "tier/count/declined",
+              "tier/hit-depth-blocks"]
+    src = open(os.path.join(REPO, "hpx_tpu", "cache", "counters.py"),
+               encoding="utf-8").read()
+    for leaf in leaves + ["tier/promote-latency-s"]:
+        assert f'"{leaf}"' in src, \
+            f"{leaf!r} gone from cache/counters.py — the tier " \
+            "counter namespace is pinned; rename both sides or don't"
+    hist = ["tier/promote-latency-s"] + [
+        f"tier/promote-latency-s/{quantile_label(q)}"
+        for q in configured_quantiles()]
+    for leaf in leaves + hist:
+        name = counter_name("cache", leaf, "server#0", locality=0)
+        assert _COUNTER_NAME_RE.match(name), name
+    # and the literal form stays HPX016-clean at a query site
+    assert findings(
+        "from hpx_tpu.svc.performance_counters import query_counter\n"
+        "def scrape():\n"
+        "    return query_counter(\n"
+        '        "/cache{locality#0/server#0}/tier/count/promoted")\n',
+        path="hpx_tpu/svc/fixture.py") == []
+
+
 # ---------------------------------------------------------------------------
 # suppression on a multi-line statement's header line
 # ---------------------------------------------------------------------------

@@ -382,6 +382,72 @@ def test_finalize_fails_pending_parcels_typed():
 
 
 # ---------------------------------------------------------------------------
+# export_prefix_rows / fetch_prefix round-trip on quantized pools
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kvd", ["fp8", "int8"])
+def test_export_fetch_prefix_roundtrip_quantized(params, kvd):
+    """A quantized pool's exported prefix rows must (a) be exactly the
+    dequantized pool bytes (scale-sidecar path) and (b) survive the
+    full wire round-trip: fetch_prefix → KVSegment framing → ingest →
+    admit_prefilled, decoding the SAME tokens the publisher emitted."""
+    from hpx_tpu.cache.transfer import make_segment
+    from hpx_tpu.models.serving import ContinuousServer
+
+    rng = np.random.default_rng(11)
+    prompt = [int(t) for t in rng.integers(1, 64, 32)]
+
+    src = DecodeWorker(params, CFG, slots=2, smax=64, kv_dtype=kvd,
+                       block_size=8)
+    srv = src.srv
+    rid = srv.submit(prompt, max_new=6)
+    base = srv.run()[rid]
+
+    got = src.fetch_prefix(prompt)
+    matched, rows = got["matched"], got["rows"]
+    assert matched == len(prompt)
+    assert rows.shape == (CFG.n_layers, 2, matched, CFG.kv_heads,
+                          CFG.head_dim)
+
+    # (a) rows == dequantized pool contents, bit-exact — same
+    # elementwise ops the fused kernels apply
+    assert srv._scales is not None           # fp8/int8 carry sidecars
+    m2, bids = srv._radix.match(prompt)
+    assert m2 == matched
+    try:
+        for li in range(CFG.n_layers):
+            kp, vp = srv._pools[li]
+            for side, pool in enumerate((kp, vp)):
+                g = np.asarray(pool)[np.asarray(bids)]
+                sc = np.asarray(srv._scales[li][side])[
+                    np.asarray(bids)]
+                ref = (g.astype(np.float32)
+                       * sc[:, None, :, None]).reshape(
+                           matched, CFG.kv_heads, CFG.head_dim)
+                np.testing.assert_array_equal(
+                    rows[li, side], ref.astype(rows.dtype))
+    finally:
+        for b in bids:
+            srv._alloc.decref(b)
+
+    # (b) ship through the segment framing into a fresh worker with
+    # the same pool dtype: identical tokens out
+    dst = DecodeWorker(params, CFG, slots=2, smax=64, kv_dtype=kvd,
+                       block_size=8)
+    dst.ingest(make_segment("rt:0", 0, 0, matched, rows))
+    dst.admit("rt:0", prompt, base[0], 6)
+    done = {}
+    for _ in range(200):
+        res = dst.pump(4)
+        done.update(res["done"])
+        if not res["busy"] and not res["live"]:
+            break
+    assert done["rt:0"] == base
+    assert src.leaked_blocks() == 0
+    assert dst.leaked_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
 # multi-process: real localities, real deaths (slow)
 # ---------------------------------------------------------------------------
 

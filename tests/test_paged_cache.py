@@ -182,11 +182,12 @@ def test_radix_evict_lru_skips_live_readers():
 
     # also lease the older chain: now nothing is evictable
     _, old_lease = r.match([1, 1, 1, 1])
-    assert r.evict(2) == 0
+    assert r.evict(2) == (0, 0)
     for b in old_lease:
         a.decref(b)                          # reader retires
 
-    assert r.evict(1) == 1                   # LRU idle leaf goes first
+    # LRU idle leaf goes first; no demote hook -> (demoted, dropped)
+    assert r.evict(1) == (0, 1)
     assert r.match([1, 1, 1, 1])[0] == 0     # the older one is gone
     assert r.blocks_held == 1
     for b in lease:
@@ -213,7 +214,7 @@ def test_oom_evict_retry_loop():
         a.decref(b)                          # publisher retired: idle
     with pytest.raises(CacheOOM):
         a.alloc()
-    assert r.evict(1) == 1
+    assert r.evict(1) == (0, 1)
     a.alloc()                                # retry succeeds
 
 

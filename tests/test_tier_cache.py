@@ -1,0 +1,436 @@
+"""Host-RAM KV tier (hpx_tpu/cache/tier.py) and its serving splice:
+demote/probe/checkout bookkeeping, the byte budget's LRU-to-oblivion
+final tier, the restore-vs-recompute crossover gate, the radix tree's
+(demoted, dropped) eviction split and two-tier match, and the full
+ContinuousServer promote path — tier-on output must be byte-identical
+to tier-off (greedy AND sampled) while strictly increasing prefill
+tokens saved, with zero leaked device blocks and zero in-flight host
+buffers at drain. Flight bundles and /cache{...}/tier/* counters ride
+the same fixtures."""
+
+import gc
+
+import jax
+import numpy as np
+import pytest
+
+from hpx_tpu.cache import BlockAllocator, RadixCache
+from hpx_tpu.cache.tier import HostTier, RestoreGate, flight_snapshot
+from hpx_tpu.core.config import runtime_config
+from hpx_tpu.models import transformer as tfm
+from hpx_tpu.models.serving import ContinuousServer
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=2, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _rows(fill=1, shape=(2, 2, 4, 2, 4)):
+    return np.full(shape, fill, np.uint8)
+
+
+def _scales(fill=0.5, shape=(2, 2, 2)):
+    return np.full(shape, fill, np.float32)
+
+
+# -- HostTier bookkeeping ----------------------------------------------------
+
+def test_demote_probe_checkout_checkin_roundtrip():
+    t = HostTier(budget_bytes=1 << 20, block_size=4)
+    rows, scs = _rows(7), _scales(0.25)
+    assert t.demote(11, 0, (1, 2, 3, 4), rows, scs)
+    nb = rows.nbytes + scs.nbytes
+    assert t.probe(11, (1, 2, 3, 4)) == nb
+    # collision guard: same chain hash, different token chunk -> miss
+    assert t.probe(11, (1, 2, 3, 9)) is None
+    assert t.probe(12, (1, 2, 3, 4)) is None
+    e = t.checkout(11)
+    assert e is not None and t.leaked_buffers() == 1
+    # the tier holds COPIES: mutating the caller's array after demote
+    # must not reach the entry
+    rows[:] = 0
+    assert (np.asarray(e.rows) == 7).all()
+    assert (np.asarray(e.scales) == 0.25).all()
+    assert t.probe(11, (1, 2, 3, 4)) is None    # checked out = gone
+    t.checkin(e)
+    assert t.leaked_buffers() == 0
+    st = t.stats()
+    assert st["tier_demoted"] == 1 and st["tier_promoted"] == 1
+    assert st["tier_entries"] == 0 and st["tier_bytes_held"] == 0
+
+
+def test_putback_restores_the_entry():
+    t = HostTier(1 << 20, 4)
+    t.demote(5, 0, (9, 9, 9, 9), _rows(3), None)
+    e = t.checkout(5)
+    assert t.leaked_buffers() == 1
+    t.putback(e)
+    assert t.leaked_buffers() == 0
+    assert t.probe(5, (9, 9, 9, 9)) is not None
+    assert t.stats()["tier_promoted"] == 0      # an abort is not a hit
+
+
+def test_budget_lru_to_oblivion_and_oversize_reject():
+    one = _rows().nbytes                        # no scales: rows only
+    t = HostTier(budget_bytes=2 * one, block_size=4)
+    t.demote(1, 0, (1,) * 4, _rows(1), None)
+    t.demote(2, 1, (2,) * 4, _rows(2), None)
+    t.probe(1, (1,) * 4)                        # touch: 2 becomes LRU
+    t.demote(3, 2, (3,) * 4, _rows(3), None)    # over budget -> evict 2
+    assert t.probe(2, (2,) * 4) is None
+    assert t.probe(1, (1,) * 4) is not None
+    assert t.probe(3, (3,) * 4) is not None
+    assert t.stats()["tier_dropped"] == 1
+    # an entry larger than the whole budget is refused outright
+    assert not t.demote(4, 3, (4,) * 4, np.zeros(3 * one, np.uint8),
+                        None)
+    assert t.stats()["tier_dropped"] == 2
+    assert t.stats()["tier_entries"] == 2
+
+
+def test_replace_same_chain_keeps_one_entry():
+    t = HostTier(1 << 20, 4)
+    t.demote(7, 0, (1, 2, 3, 4), _rows(1), None)
+    t.demote(7, 0, (1, 2, 3, 4), _rows(9), None)
+    st = t.stats()
+    assert st["tier_entries"] == 1 and st["tier_demoted"] == 2
+    assert st["tier_bytes_held"] == _rows().nbytes
+    e = t.checkout(7)
+    assert (np.asarray(e.rows) == 9).all()      # latest bytes win
+    t.checkin(e)
+
+
+def test_buffer_pool_recycles_across_demotions():
+    t = HostTier(1 << 20, 4)
+    t.demote(1, 0, (1,) * 4, _rows(1), None)
+    e = t.checkout(1)
+    buf = e.rows
+    t.checkin(e)                                # buf -> free list
+    t.demote(2, 0, (2,) * 4, _rows(2), None)
+    e2 = t.checkout(2)
+    assert e2.rows is buf                       # pooled, not realloc'd
+    assert (np.asarray(e2.rows) == 2).all()     # and rewritten
+    t.checkin(e2)
+
+
+def test_digest_is_mru_first():
+    t = HostTier(1 << 20, 4)
+    for c in (1, 2, 3):
+        t.demote(c, 0, (c,) * 4, _rows(c), None)
+    t.probe(1, (1,) * 4)
+    assert t.digest()[:2] == [1, 3]
+    assert set(t.digest()) == {1, 2, 3}
+    assert t.digest(max_entries=1) == [1]
+
+
+# -- RestoreGate: the crossover estimator ------------------------------------
+
+def test_gate_fast_link_promotes_slow_link_declines():
+    fast = RestoreGate(min_speedup=1.0, prefill_cost_us=50.0,
+                       overhead_us=200.0, probe_fn=lambda n: 1e12)
+    ok, est = fast.should_promote(ntok=48, nbytes=4096)
+    assert ok
+    assert est["prefill_s"] == pytest.approx(48 * 50e-6)
+    assert est["restore_s"] < est["prefill_s"]
+    slow = RestoreGate(min_speedup=1.0, prefill_cost_us=50.0,
+                       overhead_us=200.0, probe_fn=lambda n: 1.0)
+    ok, est = slow.should_promote(ntok=48, nbytes=4096)
+    assert not ok
+    assert est["restore_s"] > est["prefill_s"]
+
+
+def test_gate_bandwidth_is_measured_once():
+    calls = []
+
+    def probe(nbytes):
+        calls.append(nbytes)
+        return 1e9
+
+    g = RestoreGate(probe_mb=2, probe_fn=probe)
+    g.should_promote(16, 1024)
+    g.should_promote(16, 1024)
+    assert g.bandwidth() == 1e9
+    assert calls == [2 << 20]                   # lazy, exactly once
+
+
+def test_gate_prefill_fallback_without_profiler():
+    g = RestoreGate(prefill_cost_us=80.0, probe_fn=lambda n: 1e9)
+    assert g.prefill_s_per_token() == pytest.approx(80e-6)
+
+
+def test_gate_min_speedup_raises_the_bar():
+    # restore_s is pinned at exactly the overhead (infinite bandwidth);
+    # prefill_s = 2x restore_s, so 1x promotes but 3x declines
+    g1 = RestoreGate(min_speedup=1.0, prefill_cost_us=100.0,
+                     overhead_us=800.0, probe_fn=lambda n: 1e15)
+    g3 = RestoreGate(min_speedup=3.0, prefill_cost_us=100.0,
+                     overhead_us=800.0, probe_fn=lambda n: 1e15)
+    assert g1.should_promote(16, 64)[0]
+    assert not g3.should_promote(16, 64)[0]
+
+
+# -- RadixCache: eviction split + two-tier match -----------------------------
+
+def _tiered_radix(nblocks=8, bs=4, budget=None, tier=None):
+    """A radix tree whose demote hook snapshots dummy rows into
+    `tier` keyed exactly like serving's _demote_block (minus pools)."""
+    a = BlockAllocator(nblocks, bs)
+    r = RadixCache(a, budget)
+    if tier is not None:
+        r.demote_hook = lambda ch, par, key, bid: tier.demote(
+            ch, par, key, _rows(bid + 1), None)
+    return a, r
+
+
+def test_evict_returns_demoted_dropped_split():
+    tier = HostTier(1 << 20, 4)
+    a, r = _tiered_radix(tier=tier)
+    toks = list(range(12))                      # 3 full blocks
+    bids = [a.alloc() for _ in range(3)]
+    assert r.insert(toks, bids) == 3
+    for b in bids:
+        a.decref(b)                             # tree holds the only ref
+    assert r.evict(3) == (3, 0)
+    assert tier.stats()["tier_demoted"] == 3
+    # a refusing hook counts the same evictions as dropped
+    a2, r2 = _tiered_radix()
+    r2.demote_hook = lambda *args: False
+    bids = [a2.alloc() for _ in range(2)]
+    r2.insert(list(range(8)), bids)
+    for b in bids:
+        a2.decref(b)
+    assert r2.evict(2) == (0, 2)
+
+
+def test_match_tiered_extends_hot_match_and_stops_at_gap():
+    tier = HostTier(1 << 20, 4)
+    a, r = _tiered_radix(tier=tier)
+    toks = list(range(12))
+    bids = [a.alloc() for _ in range(3)]
+    r.insert(toks, bids)
+    for b in bids:
+        a.decref(b)
+    assert r.evict(1) == (1, 0)                 # deepest leaf demotes
+    matched, mbids, ext = r.match_tiered(toks, tier)
+    assert matched == 8 and len(mbids) == 2
+    assert [e[1] for e in ext] == [(8, 9, 10, 11)]
+    for b in mbids:
+        a.decref(b)                             # drop the match leases
+    # demote the rest; a gap (checked-out middle block) stops the run
+    assert r.evict(2) == (2, 0)
+    matched, mbids, ext = r.match_tiered(toks, tier)
+    assert matched == 0 and mbids == []
+    assert [e[1] for e in ext] == [(0, 1, 2, 3), (4, 5, 6, 7),
+                                   (8, 9, 10, 11)]
+    gone = tier.checkout(ext[1][0])             # hole at block 1
+    matched, mbids, ext = r.match_tiered(toks, tier)
+    assert [e[1] for e in ext] == [(0, 1, 2, 3)]
+    tier.putback(gone)
+
+
+# -- serving integration: the promote path -----------------------------------
+
+def _tier_reqs():
+    """Two 48-token (6-block) shared prefixes ALTERNATING over one
+    slot under a 4-block radix budget: each retire's budget sweep
+    evicts the other (reader-free) chain wholesale, so the next
+    admission of that prefix is restorable only from the host tier —
+    tier-off saves zero prefill tokens, tier-on promotes the full
+    prefix back every time. Deterministic by construction, not by
+    scheduling luck."""
+    rng = np.random.default_rng(42)
+    prefixes = [[int(x) for x in rng.integers(1, 64, 48)]
+                for _ in range(2)]
+    reqs = []
+    for i in range(6):
+        tail = [int(x) for x in rng.integers(1, 64, 4)]
+        r = dict(prompt=prefixes[i % 2] + tail, max_new=5)
+        if i % 3 == 2:
+            r.update(temperature=0.8, key=jax.random.PRNGKey(100 + i))
+        reqs.append(r)
+    return reqs
+
+
+def _run_wave(params, tier_on, probe_bw=1e12, kv_dtype="fp8"):
+    """One alternating-prefix wave (see _tier_reqs). Returns
+    (outputs, cache_stats, device_leak, host_leak)."""
+    rc = runtime_config()
+    rc.set("hpx.cache.tier.enable", "1" if tier_on else "0")
+    try:
+        srv = ContinuousServer(params, CFG, slots=1, smax=64,
+                               paged=True, block_size=8,
+                               kv_dtype=kv_dtype,
+                               radix_budget_blocks=4)
+        if tier_on:
+            # injectable probe: pin the gate's verdict, never touch
+            # the device from the estimator
+            srv._tier_gate = RestoreGate(min_speedup=1.0,
+                                         probe_fn=lambda n: probe_bw)
+        free0 = srv._alloc.stats()["free"]
+        for r in _tier_reqs():
+            srv.submit(**r)
+        out = srv.run()
+        st = srv.cache_stats()
+        while sum(srv._radix.evict(1)):
+            pass
+        dev_leak = free0 - srv._alloc.stats()["free"]
+        host_leak = (srv._tier.leaked_buffers()
+                     if srv._tier is not None else 0)
+        return out, st, dev_leak, host_leak
+    finally:
+        rc.set("hpx.cache.tier.enable", "0")
+
+
+@pytest.mark.parametrize("kvd", ["fp8", "bf16"])
+def test_tier_on_is_byte_identical_and_saves_more(params, kvd):
+    """The acceptance wave: small HBM budget, shared prefix bigger
+    than it. Tier-on must emit exactly the tier-off tokens (greedy
+    and sampled) while strictly increasing prefill tokens saved, and
+    drain with zero device-block and host-buffer leaks."""
+    out_off, st_off, dl_off, hl_off = _run_wave(params, False,
+                                                kv_dtype=kvd)
+    out_on, st_on, dl_on, hl_on = _run_wave(params, True,
+                                            kv_dtype=kvd)
+    assert out_on == out_off
+    assert st_on["prefill_tokens_saved"] > st_off["prefill_tokens_saved"]
+    assert st_on["tier_demoted"] > 0
+    assert st_on["tier_promoted"] > 0
+    assert (dl_off, hl_off) == (0, 0)
+    assert (dl_on, hl_on) == (0, 0)
+
+
+def test_slow_probe_declines_but_stays_identical(params):
+    """The other side of the crossover: a 1 B/s link makes every
+    restore lose to re-prefill — zero promotions, declines counted,
+    and the outputs are STILL byte-identical (a declined hit just
+    recomputes)."""
+    out_off, st_off, _, _ = _run_wave(params, False)
+    out_slow, st_slow, dl, hl = _run_wave(params, True, probe_bw=1.0)
+    assert out_slow == out_off
+    assert st_slow["tier_promoted"] == 0
+    assert st_slow["tier_declined"] > 0
+    assert st_slow["prefill_tokens_saved"] == \
+        st_off["prefill_tokens_saved"]
+    assert (dl, hl) == (0, 0)
+
+
+def test_budget_knob_reloads_live(params):
+    rc = runtime_config()
+    rc.set("hpx.cache.tier.enable", "1")
+    try:
+        srv = ContinuousServer(params, CFG, slots=2, smax=64,
+                               paged=True, block_size=8)
+        assert srv._tier.budget_bytes == 256 << 20      # default
+        rc.set("hpx.cache.tier.host_budget_mb", 7)
+        srv._reload_knobs()
+        assert srv._tier.budget_bytes == 7 << 20
+    finally:
+        rc.set("hpx.cache.tier.host_budget_mb", "auto")
+        rc.set("hpx.cache.tier.enable", "0")
+
+
+# -- observability: counters + flight bundles --------------------------------
+
+def test_tier_counters_registered_and_queryable(params):
+    from hpx_tpu.svc import performance_counters as pc
+    rc = runtime_config()
+    rc.set("hpx.cache.tier.enable", "1")
+    try:
+        srv = ContinuousServer(params, CFG, slots=1, smax=64,
+                               paged=True, block_size=8,
+                               kv_dtype="fp8", radix_budget_blocks=4)
+        srv._tier_gate = RestoreGate(min_speedup=1.0,
+                                     probe_fn=lambda n: 1e12)
+        inst = srv.counter_instance
+        for r in _tier_reqs()[:4]:
+            srv.submit(**r)
+        srv.run()
+        for leaf, want in [
+                ("tier/count/demoted", srv._tier.total_demoted),
+                ("tier/count/promoted", srv._tier.total_promoted),
+                ("tier/count/declined", srv._tier.total_declined),
+                ("tier/hit-depth-blocks", srv._tier.hit_depth_blocks),
+                ("tier/bytes-held",
+                 srv._tier.stats()["tier_bytes_held"]),
+                ("tier/entries", srv._tier.stats()["tier_entries"])]:
+            got = pc.query_counter(
+                pc.counter_name("cache", leaf, inst)).value
+            assert got == want, leaf
+        assert srv._tier.total_promoted > 0
+        # the promotion-latency histogram exports its base counter
+        # (mean seconds, sample count) plus the derived pNN quantiles
+        base = pc.counter_name("cache", "tier/promote-latency-s", inst)
+        cv = pc.query_counter(base)
+        assert cv.count >= 1 and cv.value > 0
+        from hpx_tpu.svc.metrics import configured_quantiles, \
+            quantile_label
+        for q in configured_quantiles():
+            derived = pc.counter_name(
+                "cache", f"tier/promote-latency-s/{quantile_label(q)}",
+                inst)
+            assert pc.query_counter(derived).value >= 0
+        name = pc.counter_name("cache", "tier/count/demoted", inst)
+        del srv
+        gc.collect()
+        assert name not in pc.discover_counters("/cache{locality#*/*}/*")
+    finally:
+        rc.set("hpx.cache.tier.enable", "0")
+
+
+def test_flight_bundle_carries_tier_state(params):
+    from hpx_tpu.svc import flight
+    rc = runtime_config()
+    rc.set("hpx.cache.tier.enable", "1")
+    try:
+        srv = ContinuousServer(params, CFG, slots=1, smax=64,
+                               paged=True, block_size=8,
+                               radix_budget_blocks=4)
+        srv._tier_gate = RestoreGate(min_speedup=1.0,
+                                     probe_fn=lambda n: 1e12)
+        for r in _tier_reqs()[:3]:
+            srv.submit(**r)
+        srv.run()
+        doc = flight.build_bundle("manual")
+        assert doc["tier"].get("tiers", 0) >= 1
+        assert doc["tier"]["tier_demoted"] >= srv._tier.total_demoted
+        assert flight.validate_bundle(doc) == []
+        bad = dict(doc, tier=3)
+        assert any("tier" in e for e in flight.validate_bundle(bad))
+    finally:
+        rc.set("hpx.cache.tier.enable", "0")
+
+
+def test_flight_snapshot_shape():
+    t = HostTier(1 << 20, 4)
+    t.demote(1, 0, (1,) * 4, _rows(1), None)
+    snap = flight_snapshot()
+    assert snap["tiers"] >= 1
+    assert snap["tier_demoted"] >= 1
+    assert "tier_budget_bytes" not in snap      # budgets don't sum
+
+
+def test_worker_digest_exposes_cold_chains(params):
+    """The fleet-routing feed: a tiered DecodeWorker's prefix digest
+    carries the host tier's chain hashes next to the hot ones."""
+    from hpx_tpu.models.disagg import DecodeWorker
+    rc = runtime_config()
+    rc.set("hpx.cache.tier.enable", "1")
+    try:
+        w = DecodeWorker(params, CFG, slots=1, smax=64, block_size=8,
+                         radix_budget_blocks=4)
+        w.srv._tier_gate = RestoreGate(min_speedup=1.0,
+                                       probe_fn=lambda n: 1e12)
+        for r in _tier_reqs()[:2]:
+            w.srv.submit(**r)
+        w.srv.run()
+        d = w.prefix_digest()
+        assert d["tier_hashes"]                 # demotions happened
+        assert set(d["tier_hashes"]).isdisjoint(d["hashes"])
+        assert w.leaked_blocks() == 0
+        assert w.srv._tier.leaked_buffers() == 0
+    finally:
+        rc.set("hpx.cache.tier.enable", "0")
