@@ -80,6 +80,38 @@ def case_sync_floor(n: int) -> None:
         null_fn()
 
 
+def _hist_record_cases(n: int) -> None:
+    """HistogramCounter.record() floor — the per-token cost every
+    serving histogram charges the decode loop. Three states: bare
+    (the pre-observability path), exemplars attached but value below
+    the capture threshold (the common case: gate check only), and
+    exemplars capturing on every record (worst case, top bucket)."""
+    from hpx_tpu.svc.exemplars import ExemplarReservoir
+    from hpx_tpu.svc.metrics import HistogramCounter
+
+    def record_loop(h, v):
+        def run(k):
+            for _ in range(k):
+                h.record(v)
+        return run
+
+    bare = HistogramCounter()
+    bench("hist.record (bare)", n, record_loop(bare, 0.01),
+          "histogram")
+    below = HistogramCounter()
+    below.record(10.0)  # pins the capture threshold to the top bucket
+    below._ex = ExemplarReservoir(below, per_bucket=4, quantile=0.99,
+                                  refresh=1 << 30)
+    bench("hist.record (exemplars, below threshold)", n,
+          record_loop(below, 0.01), "histogram")
+    hot = HistogramCounter()
+    hot._ex = ExemplarReservoir(hot, per_bucket=4, quantile=0.5,
+                                refresh=1 << 30)
+    hot.record(0.01)
+    bench("hist.record (exemplars, capturing)", n,
+          record_loop(hot, 0.01), "histogram")
+
+
 def _native_cases(n: int) -> None:
     """Same spawn patterns straight on the C++ pool (the scheduler the
     reference's future_overhead exercises): per-task submits cross the
@@ -124,6 +156,7 @@ def main() -> int:
     bench("async_many+wait_all (batched)", n, case_async_many_wait_all)
     _native_cases(n)
     bench("call floor (no tasks)", n, case_sync_floor)
+    _hist_record_cases(n)
     return 0
 
 

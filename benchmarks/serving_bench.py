@@ -133,7 +133,7 @@ Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
                                           [--paged-decode-only] [--mesh]
                                           [--chaos] [--disagg] [--fleet]
-                                          [--tier]
+                                          [--tier] [--alerts]
                                           [--trace-out PATH]
                                           [--metrics-out PATH]
 
@@ -650,6 +650,154 @@ def main() -> int:
             "errors": sorted({type(e).__name__
                               for e in srv.failed.values()}),
         }), flush=True)
+
+    # 7b. the observability wave: exemplars + burn-rate alerting over
+    # two sub-runs with identical request streams. The healthy run
+    # must collect >=1 exemplar per recorded SLO histogram, each rid
+    # resolving to a complete (submit..retire) timeline; the seeded
+    # regression run (decode faults -> retry backoff inflates decode
+    # stalls past the rule threshold) must fire EXACTLY one
+    # flight-bundle-capturing alert and clear on recovery.
+    def alerts_bench() -> None:
+        import glob
+        import tempfile
+        from hpx_tpu.core.config import runtime_config
+        from hpx_tpu.svc import faultinject
+        rc = runtime_config()
+        arng = np.random.default_rng(11)
+        areqs = [(arng.integers(1, 1000,
+                                int(arng.integers(6, 24))).tolist(),
+                  int(arng.integers(16, 33))) for _ in range(8)]
+        atotal = sum(m for _, m in areqs)
+        fdir = tempfile.mkdtemp(prefix="hpx-alerts-")
+        knobs = {
+            "hpx.obs.exemplars": "1",
+            "hpx.obs.exemplar_quantile": "0.9",
+            "hpx.obs.alert_interval_s": "0.02",
+            "hpx.flight.dir": fdir,
+        }
+        defaults = {
+            "hpx.obs.exemplars": "0",
+            "hpx.obs.exemplar_quantile": "0.95",
+            "hpx.obs.alerts": "0",
+            "hpx.obs.alert_rules": "",
+            "hpx.obs.alert_fast_s": "300",
+            "hpx.obs.alert_slow_s": "3600",
+            "hpx.obs.alert_burn_fast": "14.4",
+            "hpx.obs.alert_burn_slow": "6",
+            "hpx.obs.alert_interval_s": "1.0",
+            "hpx.flight.dir": "auto",
+            "hpx.serving.retry_backoff_s": "0.005",
+        }
+        for k, v in knobs.items():
+            rc.set(k, v)
+
+        def run_wave(fi=None):
+            srv = ContinuousServer(params, cfg, slots=4, smax=128)
+            for p, m in areqs:
+                srv.submit(p, max_new=m)
+            if fi is not None:
+                faultinject.install(fi)
+            t0 = time.perf_counter()
+            try:
+                out = srv.run()
+            finally:
+                faultinject.uninstall()
+            return srv, out, time.perf_counter() - t0
+
+        try:
+            # compile run doubles as cadence calibration: decode_stall
+            # IS the inter-step gap, so the SLO threshold must sit
+            # between this host's healthy step time and the injected
+            # fault-retry stall (step + backoff) — absolute numbers
+            # would fire spuriously on a loaded box and never fire on
+            # a fast one.  The fast burn window spans several fault
+            # periods so alternating good/bad steps can't flap the FSM
+            # (a clear fires whenever the fast window drains).
+            csrv, _, _ = run_wave()
+            from hpx_tpu.svc.metrics import HistogramCounter as _HC
+            cal = _HC.from_snapshot(
+                csrv.hist["decode_stall"].snapshot())
+            p50 = cal.quantile(0.5) if cal.count else 0.005
+            thr = min(max(0.05, 3.0 * p50), 2.0)
+            backoff = min(max(0.2, 3.0 * thr), 4.0)
+            fast_s = 3.0 * (p50 + backoff)
+            for k, v in {
+                "hpx.obs.alerts": "1",
+                "hpx.obs.alert_rules": f"decode_stall:{thr:.3f}:0.9",
+                "hpx.obs.alert_fast_s": f"{fast_s:.3f}",
+                "hpx.obs.alert_slow_s": f"{3.0 * fast_s:.3f}",
+                "hpx.obs.alert_burn_fast": "3",
+                "hpx.obs.alert_burn_slow": "1.5",
+                "hpx.serving.retry_backoff_s": f"{backoff:.3f}",
+            }.items():
+                rc.set(k, v)
+            srv, out, secs = run_wave()                 # healthy
+            bad_exemplars = []
+            exemplar_counts = {}
+            for key in ("ttft", "queue_wait", "decode_stall", "e2e"):
+                h = srv.hist[key]
+                if not h.count:
+                    continue
+                collected_hists[f"alerts/{key}"] = h
+                exs = h.snapshot().get("exemplars", [])
+                resolved = 0
+                for e in exs:
+                    evs = srv.timeline.events(e["rid"]) \
+                        if e["rid"] is not None else []
+                    names = {ev["name"] for ev in evs}
+                    if "submit" in names and "retire" in names:
+                        resolved += 1
+                if not resolved:
+                    bad_exemplars.append(key)
+                exemplar_counts[key] = [len(exs), resolved]
+            healthy_fired = srv._alerts.fired
+
+            pre_bundles = set(glob.glob(
+                os.path.join(fdir, "flight-*-slo_alert.json")))
+
+            # regression: a burst of decode faults, each retried with
+            # the elevated backoff — every faulted step's inter-step
+            # gap sits at >= backoff >= 3x the calibrated rule
+            # threshold until the schedule runs dry
+            fi = faultinject.FaultInjector(
+                seed=0, schedule={"decode": set(range(2, 40, 2))})
+            rsrv, rout, rsecs = run_wave(fi)
+            fired, cleared = rsrv._alerts.fired, rsrv._alerts.cleared
+            bundles = sorted(set(glob.glob(
+                os.path.join(fdir, "flight-*-slo_alert.json")))
+                - pre_bundles)
+            emit("serving_alerts", atotal, secs,
+                 mix="8 reqs plen6-23 new16-32 over 4 slots, "
+                     "healthy + seeded decode regression",
+                 exemplars={k: v[0] for k, v in
+                            exemplar_counts.items()},
+                 exemplars_resolved={k: v[1] for k, v in
+                                     exemplar_counts.items()},
+                 healthy_fired=healthy_fired,
+                 calibration={"stall_p50_s": round(p50, 4),
+                              "threshold_s": round(thr, 3),
+                              "retry_backoff_s": round(backoff, 3),
+                              "fast_window_s": round(fast_s, 3)},
+                 regression_secs=round(rsecs, 4),
+                 regression_fired=fired,
+                 regression_cleared=cleared,
+                 alert_bundles=len(bundles),
+                 alert_state=rsrv._alerts.state()["rules"])
+            if (bad_exemplars or healthy_fired
+                    or fired != 1 or len(bundles) != 1):
+                print(json.dumps({
+                    "error": "alerts gate failed",
+                    "hists_without_resolved_exemplar": bad_exemplars,
+                    "healthy_fired": healthy_fired,
+                    "regression_fired": fired,
+                    "alert_bundles": [os.path.basename(b)
+                                      for b in bundles],
+                }), flush=True)
+                raise SystemExit(2)
+        finally:
+            for k, v in defaults.items():
+                rc.set(k, v)
 
     # 8. the disaggregated wave: Poisson arrivals over Zipf-shared
     # prefixes with a 70/30 interactive/batch SLO mix, measured twice —
@@ -1216,6 +1364,10 @@ def main() -> int:
 
     if "--autotune" in sys.argv:
         autotune_bench()
+        return finish()
+
+    if "--alerts" in sys.argv:
+        alerts_bench()
         return finish()
 
     if "--chaos" in sys.argv:

@@ -909,3 +909,74 @@ class RefcountBalance(ProjectRule):
                         f"{fam}({key}) in {qname.split(':', 1)[1]} is "
                         f"not matched by {_ACQ_OPS[fam]}() on every "
                         "exit path")
+
+
+# ---------------------------------------------------------------------------
+# HPX023 — quantile scans on the serving hot path
+# ---------------------------------------------------------------------------
+
+# hot-path roots, by method/function NAME: the decode/prefill loops
+# and the flush boundary. Anything reachable from one of these runs
+# once per step (or per flush tick) — O(buckets) histogram scans do
+# not belong there.
+_HPX023_ROOTS = {
+    "step", "_step_inner", "submit", "generate", "_flush",
+    "_tune_signals", "_pump_decodes", "_advance_prefills",
+    "_dispatch_prefills"}
+
+# the HistogramCounter methods that walk every bucket (quantile) or
+# merge whole snapshot dicts (merged_hist)
+_HPX023_SCANS = {"quantile", "merged_hist"}
+
+
+@register
+class QuantileInHotPath(ProjectRule):
+    """HPX023: a HistogramCounter.quantile()/merged_hist() call is
+    reachable from the serving hot path (step/submit/_flush and the
+    router pump family). quantile() walks every bucket under the
+    counter's GIL window and merged_hist() merges whole snapshot
+    dicts — a per-step O(buckets) scan the decode loop would pay on
+    every token. Fix: take a snapshot()/delta() at the flush boundary
+    and run the scan on the detached
+    HistogramCounter.from_snapshot() copy, or move it behind a
+    metrics/debug endpoint. Suppress a deliberate site with
+    ``# hpxlint: disable=HPX023 — <why>``."""
+
+    id = "HPX023"
+    name = "quantile-in-hot-path"
+    severity = "warning"
+
+    def check_project(self, index: ProjectIndex) -> Iterable[Finding]:
+        # resolve every call once, then fixpoint the reachable set out
+        # of the named hot-path roots — the HPX013 propagation
+        # machinery without the lock context.
+        def leaf(q: str) -> str:
+            return q.split(":", 1)[1].rsplit(".", 1)[-1]
+
+        resolved: Dict[str, List[str]] = {}
+        for q in sorted(index.functions):
+            info = index.functions[q]
+            resolved[q] = [c for d, _n, _h in info.calls
+                           for c in index.resolve_call(info, d)]
+        reach = {q for q in sorted(index.functions)
+                 if leaf(q) in _HPX023_ROOTS}
+        frontier = sorted(reach)
+        while frontier:
+            q = frontier.pop()
+            for callee in resolved[q]:
+                if callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+
+        for q in sorted(reach):
+            info = index.functions[q]
+            for desc, node, _held in info.calls:
+                meth = desc[-1]
+                if meth in _HPX023_SCANS:
+                    yield self.finding_at(
+                        info.path, node,
+                        f"{meth}() is reachable from the serving hot "
+                        f"path in {q.split(':', 1)[1]} — snapshot at "
+                        "the flush boundary and scan the detached "
+                        "HistogramCounter.from_snapshot() copy "
+                        "instead")

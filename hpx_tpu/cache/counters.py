@@ -186,6 +186,20 @@ def register_server(srv) -> str:
         put("serving", "tune/holds",
             pc.CallbackCounter(_read(ref, lambda s: s._tuner.holds)))
 
+    if getattr(srv, "_alerts", None) is not None:
+        # SLO burn-rate alerting (svc/slo_alerts): evaluation and
+        # transition totals — /serving{...}/alerts/*. `active` is the
+        # number of rules currently in the alerting state, so a trace
+        # or /varz scrape shows incident windows as a step function.
+        put("serving", "alerts/evals",
+            pc.CallbackCounter(_read(ref, lambda s: s._alerts.evals)))
+        put("serving", "alerts/fired",
+            pc.CallbackCounter(_read(ref, lambda s: s._alerts.fired)))
+        put("serving", "alerts/cleared",
+            pc.CallbackCounter(_read(ref, lambda s: s._alerts.cleared)))
+        put("serving", "alerts/active",
+            pc.CallbackCounter(_read(ref, lambda s: s._alerts.active())))
+
     if getattr(srv, "paged", False):
         put("cache", "hit-rate",
             pc.CallbackCounter(_read(ref, lambda s: s._radix.hit_rate())))

@@ -621,6 +621,14 @@ class DisaggRouter:
         self.whist: Dict[int, Dict[str, _metrics.HistogramCounter]] = {}
         self.timeline = _metrics.RequestTimeline()
         self._last_pump_t: Dict[int, float] = {}
+        # live ops plane: one weakref /statusz provider per router,
+        # so the router port exposes the merged fleet view (workers
+        # roll up through merged_hist / stats). None unless
+        # hpx.obs.port enables the plane.
+        from ..svc import opsplane as _opsplane
+        if _opsplane.ensure_opsplane() is not None:
+            _opsplane.register_provider(
+                f"router/{id(self):x}", self, type(self)._statusz)
 
     # -- admission --------------------------------------------------------
 
@@ -756,6 +764,8 @@ class DisaggRouter:
         hist = self.whist.get(idx)
         if hist is None:
             hist = self.whist[idx] = _metrics.latency_histograms()
+            from ..svc import exemplars as _exemplars
+            _exemplars.attach_from_config(hist)
         return hist
 
     def merged_hist(self) -> Dict[str, _metrics.HistogramCounter]:
@@ -846,7 +856,7 @@ class DisaggRouter:
             jobs[id(h)] += 1
             now = time.monotonic()
             self._whist(req.decode_h)["queue_wait"].record(
-                now - self._t_submit[req.rid])
+                now - self._t_submit[req.rid], rid=req.grid)
             self.timeline.event(req.grid, "place", t=now,
                                 worker=self._widx(req.decode_h))
             self.timeline.event(req.grid, "prefill_start", t=now)
@@ -906,7 +916,14 @@ class DisaggRouter:
             now = time.monotonic()
             last = self._last_pump_t.get(widx)
             if last is not None:
-                self._whist(h)["decode_stall"].record(now - last)
+                # attribute the stall exemplar to the first live grid on
+                # this worker (deterministic: lowest rid)
+                stall_rid = next(
+                    (self._reqs[r].grid for r in sorted(self._reqs)
+                     if self._reqs[r].decode_h is h
+                     and self._reqs[r].state == "decode"), None)
+                self._whist(h)["decode_stall"].record(now - last,
+                                                      rid=stall_rid)
             out = self._call(h, "pump", self._pump_steps)
             self._last_pump_t[widx] = time.monotonic()
             for grid, toks in sorted(out["done"].items()):
@@ -922,7 +939,8 @@ class DisaggRouter:
                 if req.rid not in self.ttft and toks:
                     ttft = time.monotonic() - self._t_submit[req.rid]
                     self.ttft[req.rid] = ttft
-                    self._whist(req.decode_h)["ttft"].record(ttft)
+                    self._whist(req.decode_h)["ttft"].record(
+                        ttft, rid=req.grid)
                     self.timeline.event(req.grid, "first_token",
                                         worker=widx)
 
@@ -943,11 +961,12 @@ class DisaggRouter:
         if req.rid not in self.ttft:
             ttft = now - self._t_submit[req.rid]
             self.ttft[req.rid] = ttft
-            self._whist(req.decode_h)["ttft"].record(ttft)
+            self._whist(req.decode_h)["ttft"].record(ttft,
+                                                     rid=req.grid)
             self.timeline.event(req.grid, "first_token",
                                 worker=self._widx(req.decode_h))
         self._whist(req.decode_h)["e2e"].record(
-            now - self._t_submit[req.rid])
+            now - self._t_submit[req.rid], rid=req.grid)
         self.timeline.event(req.grid, "retire", tokens=len(toks))
 
     # -- failover ---------------------------------------------------------
@@ -1055,6 +1074,36 @@ class DisaggRouter:
         return busy or self._unfinished() > 0
 
     # -- lifecycle --------------------------------------------------------
+
+    def _statusz(self) -> Dict[str, Any]:
+        """This router's /statusz section (svc/opsplane provider):
+        queue split, request-state census, per-worker liveness and
+        per-worker SLO sample counts, plus the stats() roll-up —
+        ONE port answers for the whole fleet.  Host-only reads; no
+        worker calls (a scrape must not touch a dead worker)."""
+        states: Dict[str, int] = {}
+        for r in self._reqs.values():
+            states[r.state] = states.get(r.state, 0) + 1
+        return {
+            "kind": "router",
+            "queue": {"interactive": len(self._qi),
+                      "batch": len(self._qb)},
+            "requests": states,
+            "workers": {
+                "prefill": [
+                    {"locality": getattr(h, "locality", 0),
+                     "alive": h.alive} for h in self._prefill],
+                "decode": [
+                    {"widx": self._widx(h),
+                     "locality": getattr(h, "locality", 0),
+                     "alive": h.alive,
+                     "samples": {k: v.count for k, v in sorted(
+                         self.whist.get(self._widx(h), {}).items())}}
+                    for h in self._decode],
+            },
+            "timeline_rids": len(self.timeline),
+            "stats": self.stats(),
+        }
 
     def stats(self) -> Dict[str, Any]:
         merged = self.merged_hist()

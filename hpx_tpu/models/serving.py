@@ -904,8 +904,29 @@ class ContinuousServer:
         if rc.get_bool("hpx.tune.enable", False):
             from ..svc.autotune import server_tuner
             self._tuner = server_tuner(self)
+        # live observability (svc/exemplars, svc/slo_alerts,
+        # svc/opsplane): every piece is None/empty unless its
+        # hpx.obs.* knob is on, so the record and flush fast paths
+        # keep their pre-observability cost (the hpx.trace.*
+        # discipline). Exemplar reservoirs ride the SLO histograms;
+        # the burn-rate evaluator ticks in _flush (built BEFORE
+        # register_server so the /serving{...}/alerts/* counters see
+        # it); the ops plane gets a weakref /statusz provider.
+        from ..svc import exemplars as _exemplars
+        _exemplars.attach_from_config(self.hist)
+        self._alerts = None
+        if rc.get_bool("hpx.obs.alerts", False):
+            from ..svc.slo_alerts import server_alerts
+            self._alerts = server_alerts(self)
         from ..cache.counters import register_server
         self.counter_instance = register_server(self)
+        if self._alerts is not None:
+            self._alerts.name = f"serving/{self.counter_instance}"
+        from ..svc import opsplane as _opsplane
+        if _opsplane.ensure_opsplane() is not None:
+            _opsplane.register_provider(
+                f"serving/{self.counter_instance}", self,
+                ContinuousServer._statusz)
 
     def _init_paged(self, block_size, num_blocks, radix_budget_blocks,
                     prefix_reuse, paged_kernel=None,
@@ -2100,7 +2121,7 @@ class ContinuousServer:
                 self._draft_prefill(slot, req.prompt)
         ttft = time.monotonic() - req.t_submit
         self.ttft[req.rid] = ttft
-        self.hist["ttft"].record(ttft)
+        self.hist["ttft"].record(ttft, rid=req.rid)
         self.timeline.event(req.rid, "first_token", slot=slot)
         # seed checkpoint: a fault before the first cadence capture
         # restores to the freshly-admitted state instead of losing the
@@ -2136,7 +2157,8 @@ class ContinuousServer:
                 # OOM-deferred request re-dequeues but records once)
                 if req.rid not in self._admit_defers:
                     self.hist["queue_wait"].record(
-                        time.monotonic() - req.t_submit)
+                        time.monotonic() - req.t_submit,
+                        rid=req.rid)
                     self.timeline.event(req.rid, "prefill_start",
                                         slot=slot)
                 try:
@@ -2218,7 +2240,7 @@ class ContinuousServer:
                 self._draft_prefill(slot, req.prompt)
         ttft = time.monotonic() - req.t_submit
         self.ttft[req.rid] = ttft
-        self.hist["ttft"].record(ttft)
+        self.hist["ttft"].record(ttft, rid=req.rid)
         self.timeline.event(req.rid, "transfer_admit", slot=slot,
                             plen=plen)
         self._prefill_saved += plen    # prefill compute happened remotely
@@ -2745,7 +2767,8 @@ class ContinuousServer:
                           rid=req.rid, slot=slot,
                           tokens=len(req.tokens), eos=hit_eos):
             self._done[req.rid] = req.tokens
-            self.hist["e2e"].record(time.monotonic() - req.t_submit)
+            self.hist["e2e"].record(time.monotonic() - req.t_submit,
+                                    rid=req.rid)
             self.timeline.event(req.rid, "retire",
                                 tokens=len(req.tokens))
             if self._slot_req[slot] is req:
@@ -2773,8 +2796,16 @@ class ContinuousServer:
                     self._finalize(s, req, hit_eos)
         self._ckpt_sweep()
         self._reload_knobs()
+        # SLO burn evaluation shares the tuner's boundary: no step in
+        # flight, so a flight-bundle capture sees consistent state. A
+        # firing alert also holds the tuner — probing against
+        # regressed traffic tunes toward the incident.
+        alerting = False
+        if self._alerts is not None:
+            self._alerts.maybe_tick()
+            alerting = self._alerts.active() > 0
         if self._tuner is not None:
-            self._tuner.maybe_tick(self._tune_signals)
+            self._tuner.maybe_tick(self._tune_signals, hold=alerting)
 
     def _reload_knobs(self) -> None:
         """Propagate runtime config writes into the live server at
@@ -2827,11 +2858,13 @@ class ContinuousServer:
         h = self.hist["decode_stall"]
         prev, self._tune_stall_prev = self._tune_stall_prev, \
             h.snapshot()
-        if prev is None:
-            p99 = h.quantile(0.99)
-        else:
-            p99 = HistogramCounter.from_snapshot(
-                h.delta(prev)).quantile(0.99)
+        # quantile() on a DETACHED window copy, never on the live
+        # histogram — the live scan is the O(buckets)-under-load read
+        # hpxlint HPX023 bans from paths reachable off the flush
+        # boundary (first tick: the snapshot just taken IS the window)
+        p99 = HistogramCounter.from_snapshot(
+            h.delta(prev) if prev is not None
+            else self._tune_stall_prev).quantile(0.99)
         comp = None
         prof = progprof.active_profiler()
         if prof is not None:
@@ -2840,6 +2873,38 @@ class ContinuousServer:
             tok_rate=self._rate.rate(), stall_p99=p99,
             queue_depth=float(len(self._queue)),
             compile_s_total=comp)
+
+    def _statusz(self) -> Dict[str, Any]:
+        """This server's /statusz section (svc/opsplane provider):
+        live queue/slot state, the SLO alert burn state, tuner flight
+        state, and tier occupancy — host-only reads, no device sync
+        (an ops scrape must never stall the decode loop)."""
+        doc: Dict[str, Any] = {
+            "kind": "server",
+            "instance": self.counter_instance,
+            "paged": self.paged,
+            "queue_depth": len(self._queue),
+            "pending_prefills": len(self._pending),
+            "live_slots": sum(1 for r in self._slot_req
+                              if r is not None),
+            "slots": self.slots,
+            "done": len(self._done),
+            "failed": len(self.failed),
+            "tok_rate": float(self._rate.rate()),
+            "timeline_rids": len(self.timeline),
+        }
+        if self._tuner is not None:
+            doc["tuner"] = self._tuner.flight_state()
+        if self._alerts is not None:
+            doc["alerts"] = self._alerts.state()
+        if self.paged:
+            doc["cache"] = {
+                "free_blocks": self._alloc.free_count,
+                "num_blocks": self._alloc.num_blocks,
+            }
+            if self._tier is not None:
+                doc["tier"] = self._tier.stats()
+        return doc
 
     def step(self) -> bool:
         """Admit + one prefill chunk + one decode step for every live
@@ -2861,7 +2926,13 @@ class ContinuousServer:
         # latency a streaming client would observe
         now = time.monotonic()
         if self._stall_live and self._last_step_t is not None:
-            self.hist["decode_stall"].record(now - self._last_step_t)
+            # the stall is shared by every live slot; attribute the
+            # exemplar to the first live rid (deterministic pick — any
+            # of them observed this inter-token gap)
+            stall_rid = next((r.rid for r in self._slot_req
+                              if r is not None), None)
+            self.hist["decode_stall"].record(now - self._last_step_t,
+                                             rid=stall_rid)
         self._last_step_t = now
         try:
             return sync_replay(

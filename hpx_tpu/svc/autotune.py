@@ -244,39 +244,51 @@ class AdaptiveTuner:
 
     # -- ticking ----------------------------------------------------------
 
-    def maybe_tick(self, collect: Callable[[], TuneSignals]
-                   ) -> Optional[Dict[str, Any]]:
+    def maybe_tick(self, collect: Callable[[], TuneSignals],
+                   hold: bool = False) -> Optional[Dict[str, Any]]:
         """Per-flush entry point: counts the tick and, every
         ``interval_ticks`` flushes, samples the signals and runs one
         evaluation. Signal collection only happens at evaluation
-        boundaries — the between-boundary cost is one increment."""
+        boundaries — the between-boundary cost is one increment.
+        ``hold=True`` (a firing SLO alert) blocks NEW probes this
+        evaluation; an in-flight probe still settles."""
         self.ticks += 1
         if self.ticks % self.interval_ticks:
             return None
-        return self.evaluate(collect())
+        return self.evaluate(collect(), hold=hold)
 
     # -- the FSM ----------------------------------------------------------
 
     def evaluate(self, sig: TuneSignals,
-                 denied: Optional[Any] = None
-                 ) -> Optional[Dict[str, Any]]:
+                 denied: Optional[Any] = None,
+                 hold: bool = False) -> Optional[Dict[str, Any]]:
         """One controller evaluation against one signal sample. Pure
         in the sample sequence: same samples in, same decisions out.
-        Arbiter grants are the one external input — live denials are
-        recorded INTO the stored signal sample so a replay (which
-        passes them back via ``denied``) stays exact."""
+        Arbiter grants and the alert-hold flag are the two external
+        inputs — both are recorded INTO the stored signal sample so a
+        replay (which passes them back) stays exact."""
         self.evals += 1
         rec = sig.as_dict()
+        if hold:
+            rec["alert_hold"] = True
         self._signals.append(rec)
         j = self.objective(sig)
         if self._phase == "probe":
             return self._settle_probe(sig, j)
-        return self._start_probe(sig, j, denied, rec)
+        return self._start_probe(sig, j, denied, rec, hold)
 
     def _start_probe(self, sig: TuneSignals, j: float,
                      denied: Optional[Any],
-                     rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+                     rec: Dict[str, Any],
+                     hold: bool = False) -> Optional[Dict[str, Any]]:
         self._j_before = j
+        if hold:
+            # alert-aware hold: while an SLO alert fires, the signal a
+            # probe would be judged against is regressed traffic — a
+            # knob move now tunes toward the incident, and the probe
+            # itself can deepen it. Sit the evaluation out.
+            self.holds += 1
+            return self._log("hold", None, None, None, sig, j, j, 0.0)
         knob = self._next_knob(sig, denied, rec)
         if knob is None:
             self.holds += 1
@@ -581,7 +593,8 @@ def replay(state: Dict[str, Any]) -> List[Dict[str, Any]]:
         # the counter the same way so the logged tick numbers match
         t.ticks += t.interval_ticks
         dec = t.evaluate(TuneSignals.from_dict(s),
-                         denied=frozenset(s.get("denied", ())))
+                         denied=frozenset(s.get("denied", ())),
+                         hold=bool(s.get("alert_hold")))
         if dec is not None:
             out.append(dec)
     return out

@@ -458,3 +458,21 @@ class FleetRouter(DisaggRouter):
         st["decode_pool"] = len(self._alive(self._decode))
         st["digest_staleness_s"] = self.digest_staleness_s()
         return st
+
+    def _statusz(self) -> Dict[str, Any]:
+        """Fleet view on the router's /statusz section: the base
+        census plus the autoscale pool bounds and per-worker queue
+        depth — the merged fleet picture one ops-plane port serves."""
+        doc = super()._statusz()
+        doc["kind"] = "fleet"
+        doc["pool"] = {
+            "min": self._pool_min, "max": self._pool_max,
+            "decode": len(self._decode),
+            "alive": len(self._alive(self._decode)),
+            "scale_high": self._scale_high,
+            "scale_low": self._scale_low,
+        }
+        doc["worker_queue_depth"] = {
+            str(k): self.worker_queue_depth(k)
+            for k in range(len(self._decode))}
+        return doc

@@ -44,6 +44,7 @@ __all__ = [
     "dropped_count",
     "reset_counts",
     "flight_dir",
+    "bundle_index",
     "main",
 ]
 
@@ -225,6 +226,44 @@ def record_fault(kind: str, site: Optional[str] = None, rid: Any = None,
 
 
 # ---------------------------------------------------------------------------
+# bundle index (the `list` CLI and the opsplane /flightz route share it)
+# ---------------------------------------------------------------------------
+
+def bundle_index(d: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Age-sorted (newest first) index of the on-disk bundles: name,
+    age, trigger reason/site/rid, and schema version — enough for an
+    operator to pick which bundle to fetch without opening each one.
+    Unreadable files still index (an operator must see a truncated
+    bundle exists), with ``error`` set."""
+    d = flight_dir() if d is None else d
+    try:
+        names = [n for n in os.listdir(d)
+                 if n.startswith("flight-") and n.endswith(".json")]
+    except OSError:
+        return []
+    now = time.time()
+    out: List[Dict[str, Any]] = []
+    for name in names:
+        path = os.path.join(d, name)
+        entry: Dict[str, Any] = {"name": name, "path": path}
+        try:
+            entry["mtime"] = os.path.getmtime(path)
+            entry["age_s"] = round(max(0.0, now - entry["mtime"]), 3)
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            trig = doc.get("trigger") or {}
+            entry["reason"] = trig.get("kind")
+            entry["site"] = trig.get("site")
+            entry["rid"] = trig.get("rid")
+            entry["schema"] = doc.get("schema")
+        except (OSError, ValueError) as e:
+            entry["error"] = repr(e)
+        out.append(entry)
+    out.sort(key=lambda e: (-e.get("mtime", 0.0), e["name"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # schema validation (tests + CLI)
 # ---------------------------------------------------------------------------
 
@@ -273,18 +312,45 @@ def validate_bundle(doc: Dict[str, Any]) -> List[str]:
 
 # ---------------------------------------------------------------------------
 # one-shot CLI:  python -m hpx_tpu.svc.flight dump [--out PATH]
+#                python -m hpx_tpu.svc.flight --list [--tail N]
 # ---------------------------------------------------------------------------
+
+def _print_index(tail: int) -> int:
+    """The ``--list`` view: one line per bundle, newest first —
+    exactly what the opsplane /flightz route serves as JSON."""
+    entries = bundle_index()
+    if tail > 0:
+        entries = entries[:tail]
+    for e in entries:
+        if "error" in e:
+            print(f"{e['name']}  error={e['error']}")
+            continue
+        print(f"{e['name']}  age={e['age_s']:.1f}s  "
+              f"reason={e['reason']}  site={e['site']}  "
+              f"rid={e['rid']}  schema={e['schema']}")
+    return 0
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     ap = argparse.ArgumentParser(
         prog="python -m hpx_tpu.svc.flight",
         description="fault flight recorder tools")
-    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="print the age-sorted bundle index "
+                         "(reason/rid/schema per line) and exit")
+    ap.add_argument("--tail", type=int, default=0, metavar="N",
+                    help="with --list: only the newest N bundles")
+    sub = ap.add_subparsers(dest="cmd", required=False)
     dump = sub.add_parser("dump", help="capture one bundle right now")
     dump.add_argument("--out", default=None,
                       help="write here instead of hpx.flight.dir")
     args = ap.parse_args(argv)
+    if args.list_:
+        return _print_index(args.tail)
+    if args.cmd is None:
+        ap.print_usage()
+        return 2
     if args.cmd == "dump":
         doc = build_bundle("manual", site="cli")
         if args.out:

@@ -26,7 +26,8 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["to_chrome_trace", "write_chrome_trace", "write_trace_doc",
-           "merge_traces", "validate_chrome_trace", "load_chrome_trace"]
+           "merge_traces", "validate_chrome_trace", "load_chrome_trace",
+           "slow_spans"]
 
 _PID = 1                       # single-process trace; localities could
                                # map to pids in a multi-host merge
@@ -146,6 +147,34 @@ def to_chrome_trace(events: List[tuple],
     return {"traceEvents": meta + out,
             "displayTimeUnit": "ms",
             "otherData": other}
+
+
+def slow_spans(events: List[tuple], t0: float = 0.0,
+               limit: int = 32) -> List[dict]:
+    """Top-``limit`` longest COMPLETED spans in a ``Tracer.snapshot()``
+    — the /tracez sample: pair B/E halves by span id and sort by
+    duration (ties broken by start then id, so the answer is
+    deterministic for a fixed ring).  Spans whose opener was evicted
+    from the ring are skipped, like :func:`to_chrome_trace` orphans."""
+    opens: Dict[int, tuple] = {}
+    done: List[dict] = []
+    for ev in events:
+        ph, _name, _cat, ts, tid, eid = ev[0], ev[1], ev[2], ev[3], \
+            ev[4], ev[5]
+        if ph == "B":
+            opens[eid] = ev
+        elif ph == "E":
+            b = opens.pop(eid, None)
+            if b is not None:
+                done.append({
+                    "name": b[1], "cat": b[2],
+                    "dur_s": round(ts - b[3], 9),
+                    "start_s": round(b[3] - t0, 9),
+                    "tid": tid, "id": eid,
+                    "args": b[7] or {},
+                })
+    done.sort(key=lambda d: (-d["dur_s"], d["start_s"], d["id"]))
+    return done[: max(0, int(limit))]
 
 
 def write_trace_doc(path: str, doc: dict) -> dict:

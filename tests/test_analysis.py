@@ -956,7 +956,7 @@ def test_all_rules_registry():
                    "HPX009", "HPX010", "HPX011", "HPX012",
                    "HPX013", "HPX014", "HPX015", "HPX016",
                    "HPX017", "HPX018", "HPX019", "HPX020",
-                   "HPX021", "HPX022"]
+                   "HPX021", "HPX022", "HPX023"]
 
 
 def test_rule_registry_completeness(capsys):
@@ -973,7 +973,7 @@ def test_rule_registry_completeness(capsys):
             f"{rule.id} missing from the README lint table"
         assert rule.id in listed
     project_ids = {r.id for r in all_rules() if r.scope == "project"}
-    assert project_ids == {"HPX013", "HPX014", "HPX015"}
+    assert project_ids == {"HPX013", "HPX014", "HPX015", "HPX023"}
     dataflow_ids = {r.id for r in all_rules() if r.scope == "dataflow"}
     assert dataflow_ids == {"HPX019", "HPX020", "HPX021", "HPX022"}
 
@@ -1374,6 +1374,79 @@ def test_hpx016_tier_counter_namespace_is_stable():
         "    return query_counter(\n"
         '        "/cache{locality#0/server#0}/tier/count/promoted")\n',
         path="hpx_tpu/svc/fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
+# HPX023 — quantile scans reachable from the serving hot path
+# ---------------------------------------------------------------------------
+
+def test_hpx023_quantile_reachable_from_step_fires():
+    res = lint_sources({"hpx_tpu/svc/srv.py": """\
+class Server:
+    def step(self):
+        self._tick()
+
+    def _tick(self):
+        return self.hist.quantile(0.99)
+"""}, rules=all_rules(["HPX023"]))
+    assert rules_of(res.findings) == ["HPX023"]
+    assert "quantile()" in res.findings[0].message
+    assert "Server._tick" in res.findings[0].message
+
+
+def test_hpx023_detached_snapshot_is_silent():
+    # the sanctioned shape: scan a detached from_snapshot() copy, not
+    # the live histogram — the call-result base is off the hot path's
+    # shared structure so it carries no per-step lock cost
+    res = lint_sources({"hpx_tpu/svc/srv.py": """\
+from hpx_tpu.svc.metrics import HistogramCounter
+
+class Server:
+    def step(self):
+        self._tick()
+
+    def _tick(self):
+        snap = self.hist.delta(self.prev)
+        return HistogramCounter.from_snapshot(snap).quantile(0.99)
+"""}, rules=all_rules(["HPX023"]))
+    assert res.findings == []
+
+
+def test_hpx023_cold_path_quantile_is_silent():
+    # same scan in a debug/stats method nothing on the hot path
+    # reaches — reporting endpoints may walk buckets freely
+    res = lint_sources({"hpx_tpu/svc/srv.py": """\
+class Server:
+    def step(self):
+        self.tokens += 1
+
+    def stats(self):
+        return self.hist.quantile(0.99)
+"""}, rules=all_rules(["HPX023"]))
+    assert res.findings == []
+
+
+def test_hpx023_cross_module_merged_hist_fires():
+    # reachability crosses modules through import aliases: the router
+    # pump calls a helper whose module-level merged_hist() scan is the
+    # violation
+    res = lint_sources({
+        "hpx_tpu/svc/a.py": """\
+from hpx_tpu.svc.b import summarize
+
+class Router:
+    def _pump_decodes(self):
+        return summarize(self.hists)
+""",
+        "hpx_tpu/svc/b.py": """\
+from hpx_tpu.svc.metrics import merged_hist
+
+def summarize(hists):
+    return merged_hist(hists)
+"""}, rules=all_rules(["HPX023"]))
+    assert rules_of(res.findings) == ["HPX023"]
+    assert "merged_hist()" in res.findings[0].message
+    assert res.findings[0].path == "hpx_tpu/svc/b.py"
 
 
 # ---------------------------------------------------------------------------
