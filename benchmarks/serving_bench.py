@@ -103,6 +103,19 @@ PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
                            --xla_force_host_platform_device_count=8);
                            emits a skipped line otherwise.
 
+  9b. serving_moe        — the expert-parallel MoE wave (--moe): one
+                           greedy mix through a single-device MoE
+                           paged server and the (dp, tp)-mesh one,
+                           experts sharded over tp and decode routing
+                           through moe_ffn's tiled all_to_all at the
+                           drop-free auto capacity. Reports warm
+                           tokens/s, decode-stall p50/p99 and the
+                           overflow-drop rate from the /serving moe
+                           counters (banked into --metrics-out), and
+                           GATES on sha-identical tokens. Rows carry
+                           an explicit onchip stamp; needs >=4
+                           devices, emits a skipped line otherwise.
+
  10. serving_fleet      — the fleet wave (--fleet): the SAME warm
                            Zipf-shared-prefix Poisson mix through a
                            FleetRouter in placement=load (pure
@@ -132,7 +145,8 @@ PATH, loadable directly in chrome://tracing or https://ui.perfetto.dev.
 Usage: python benchmarks/serving_bench.py [--cpu] [--scale N]
                                           [--prefix-only] [--spec-only]
                                           [--paged-decode-only] [--mesh]
-                                          [--chaos] [--disagg] [--fleet]
+                                          [--moe] [--chaos] [--disagg]
+                                          [--fleet]
                                           [--tier] [--alerts]
                                           [--trace-out PATH]
                                           [--metrics-out PATH]
@@ -222,6 +236,10 @@ def main() -> int:
     # live HistogramCounters the waves hand to finish() for the
     # --metrics-out artifact, keyed "<bench>/<metric>"
     collected_hists = {}
+    # scalar counters the waves bank for the artifact's "counters"
+    # section (merged over the live registry snapshot), keyed
+    # "<bench>/<name>" — e.g. the MoE wave's overflow-drop rate
+    collected_counters = {}
     # (label, chrome-doc) pairs from the fleet wave's worker rings —
     # finish() stitches them with the router tracer into ONE trace
     fleet_trace_docs = []
@@ -560,6 +578,93 @@ def main() -> int:
                  output_identical=(sha == base_sha))
         if any(sha != base_sha for _, _, sha in results.values()):
             print(json.dumps({"error": "sharded paged output "
+                              "diverged from single-device"}),
+                  flush=True)
+            raise SystemExit(2)
+
+    # 9b. the expert-parallel MoE wave (--moe): the SAME greedy mix
+    # through a single-device MoE paged server and the (dp, tp)-mesh
+    # one — experts sharded over tp, decode routing through moe_ffn's
+    # tiled all_to_all with the drop-free auto capacity. Identity is
+    # CHECKED (sha gate): expert parallelism moves the exchange onto
+    # more chips, never changes tokens. Reports warm tokens/s and
+    # decode-stall p50/p99 for both topologies plus the overflow-drop
+    # rate from the /serving moe counters (banked into --metrics-out);
+    # rows carry an explicit onchip stamp so CPU-smoke numbers can
+    # never masquerade as chip measurements. Needs >=4 devices;
+    # emits a skipped line otherwise.
+    def moe_bench():
+        import hashlib
+        ndev = len(jax.devices())
+        if ndev < 4:
+            print(json.dumps({
+                "engine": "serving_moe", "skipped": True,
+                "reason": f"needs >=4 devices, have {ndev} (CPU smoke:"
+                          " XLA_FLAGS=--xla_force_host_platform"
+                          "_device_count=8)"}), flush=True)
+            return
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+        mcfg = tfm.TransformerConfig(
+            vocab=1024, d_model=d, n_heads=8, head_dim=d // 8,
+            n_layers=2, d_ff=2 * d, n_experts=4, moe_top_k=2,
+            moe_capacity=4.0, dtype=cfg.dtype)
+        mparams = tfm.init_params(mcfg, jax.random.PRNGKey(4))
+        wreqs = [(rng.integers(1, 1000, 24).tolist(), 48)
+                 for _ in range(8)]
+        wtotal = sum(m for _, m in wreqs)
+
+        def run_once(m):
+            srv = ContinuousServer(mparams, mcfg, slots=4, smax=128,
+                                   paged=True, mesh=m)
+            for p, mx in wreqs:
+                srv.submit(p, max_new=mx)
+            t0 = time.perf_counter()
+            stalls = []
+            alive = True
+            while alive:
+                s0 = time.perf_counter()
+                alive = srv.step()
+                stalls.append(time.perf_counter() - s0)
+            secs = time.perf_counter() - t0
+            out, srv._done = srv._done, {}
+            sha = hashlib.sha256(json.dumps(
+                [out[r] for r in sorted(out)]).encode()).hexdigest()
+            routed, dropped = srv._moe_routed, srv._moe_dropped
+            drop_rate = dropped / max(routed + dropped, 1.0)
+            return secs, stalls, sha, routed, dropped, drop_rate
+
+        waves = [("serving_moe_single_device", None),
+                 ("serving_moe_mesh_dp2_tp2", mesh)]
+        results = {}
+        for name, m in waves:
+            run_once(m)                                # compile
+            results[name] = run_once(m)
+        base_sha = results["serving_moe_single_device"][2]
+        for name, (secs, stalls, sha, routed, dropped,
+                   drop_rate) in results.items():
+            emit(name, wtotal, secs,
+                 mix="8 reqs plen24 new48 over 4 slots, greedy, "
+                     "4 experts top-2, auto capacity",
+                 decode_stall_p50_ms=round(
+                     1e3 * float(np.percentile(stalls, 50)), 2),
+                 decode_stall_p99_ms=round(
+                     1e3 * float(np.percentile(stalls, 99)), 2),
+                 moe_tokens_routed=int(routed),
+                 moe_tokens_dropped=int(dropped),
+                 moe_overflow_drop_rate=round(drop_rate, 4),
+                 onchip=on_tpu,
+                 output_sha=sha[:16],
+                 output_identical=(sha == base_sha))
+            collected_counters[f"{name}/moe_tokens_routed"] = \
+                int(routed)
+            collected_counters[f"{name}/moe_tokens_dropped"] = \
+                int(dropped)
+            collected_counters[f"{name}/moe_overflow_drop_rate"] = \
+                round(drop_rate, 6)
+        if any(sha != base_sha
+               for _, _, sha, _, _, _ in results.values()):
+            print(json.dumps({"error": "expert-parallel MoE output "
                               "diverged from single-device"}),
                   flush=True)
             raise SystemExit(2)
@@ -1317,8 +1422,9 @@ def main() -> int:
         if metrics_out:
             from hpx_tpu.svc import metrics as svc_metrics
             reg = svc_metrics.registry_snapshot("*")
-            doc = metrics_artifact(collected_hists,
-                                   counters=reg["counters"])
+            doc = metrics_artifact(
+                collected_hists,
+                counters={**reg["counters"], **collected_counters})
             if profiler is not None:
                 from hpx_tpu.svc import progprof
                 doc["programs"] = profiler.profile_table()
@@ -1352,6 +1458,10 @@ def main() -> int:
 
     if "--mesh" in sys.argv:
         mesh_paged_bench()
+        return finish()
+
+    if "--moe" in sys.argv:
+        moe_bench()
         return finish()
 
     if "--disagg" in sys.argv:

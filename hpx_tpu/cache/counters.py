@@ -29,6 +29,13 @@ Speculative servers (``hpx.serving.spec.enable``) add::
 these, so the Chrome-trace counter sampler picks up an
 acceptance-rate track with no extra config).
 
+MoE servers (``cfg.n_experts > 0``) add the expert-routing feed::
+
+    /serving{locality#L/server#i}/moe/tokens-routed   routing claims honored
+    /serving{locality#L/server#i}/moe/tokens-dropped  claims over capacity
+    /serving{locality#L/server#i}/moe/expert#e/occupancy  latest capacity
+                                                          fraction, per expert
+
 Tuned servers (``hpx.tune.enable``) add the closed-loop controller's
 accounting — ``/serving{...}/tune/ticks``, ``tune/evals``,
 ``tune/probes``, ``tune/accepts``, ``tune/reverts``, ``tune/holds``.
@@ -167,6 +174,21 @@ def register_server(srv) -> str:
             pc.CallbackCounter(_read(ref, lambda s: (
                 s._spec_emitted / s._spec_steps
                 if s._spec_steps else 0.0))))
+
+    if getattr(srv.cfg, "n_experts", 0) > 0:
+        # expert-parallel MoE decode routing (models/moe): routing
+        # claims routed vs dropped-over-capacity (capacity-factor
+        # knob), plus each expert's latest occupancy fraction —
+        # /serving{...}/moe/*. Fed from the per-step stats vector the
+        # decode/verify programs return, drained at flush boundaries.
+        put("serving", "moe/tokens-routed",
+            pc.CallbackCounter(_read(ref, lambda s: s._moe_routed)))
+        put("serving", "moe/tokens-dropped",
+            pc.CallbackCounter(_read(ref, lambda s: s._moe_dropped)))
+        for e in range(srv.cfg.n_experts):
+            put("serving", f"moe/expert#{e}/occupancy",
+                pc.CallbackCounter(_read(
+                    ref, lambda s, e=e: s._moe_occ[e])))
 
     if getattr(srv, "_tuner", None) is not None:
         # closed-loop tuner observability (svc/autotune): tick/probe/

@@ -331,6 +331,13 @@ declare("hpx.serving.disagg.prefill_jobs", "int", None,
         "concurrent prefill jobs per prefill worker")
 declare("hpx.serving.disagg.xfer_retries", "int", None,
         "KV transfer attempts before failing over")
+declare("hpx.serving.moe.capacity_factor", "int", "0",
+        "MoE decode expert capacity factor as an integer PERCENT "
+        "(100 = GShard cf 1.0; C = ceil(T*k*pct/100 / E)); 0 = auto = "
+        "drop-free (cf = n_experts), the token-identity default. "
+        "Lower trades overflow drops for smaller expert exchanges",
+        tunable=Tunable(lo=100, hi=6400, step=2, geometric=True,
+                        compiles=True))
 declare("hpx.serving.mesh.paged", "bool", "1",
         "sharded paged serving (0 restores the single-device refusal)")
 declare("hpx.serving.mesh.table_residency", "str", "sharded",

@@ -37,7 +37,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["MoeConfig", "init_moe_params", "moe_ffn", "moe_param_specs"]
+__all__ = ["MoeConfig", "init_moe_params", "moe_ffn", "moe_ffn_decode",
+           "moe_param_specs"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,13 +77,23 @@ def moe_param_specs(axis: str = "ep",
             "w2": P(axis, tp_axis, None)}
 
 
-def _top_k_dispatch(gates: jax.Array, k: int, capacity: int):
+def _top_k_dispatch(gates: jax.Array, k: int, capacity: int,
+                    token_mask: Any = None):
     """One-hot dispatch/combine tensors for top-k routing.
 
     gates [T, E] (softmax rows). Returns (dispatch [T, E, C] one-hot,
     combine [T, E, C] weighted, aux_loss scalar). GShard order: the
     k-th choice claims capacity AFTER all earlier choices, so first
     choices are never bumped by second choices.
+
+    token_mask [T] (optional; truthy = real token): masked rows claim
+    NO capacity and get all-zero dispatch/combine rows — the decode
+    path's padding rows route nowhere and contribute exact-zero output.
+
+    Overflow is the paged-splice trash-row idiom: positions clip into a
+    [.., C+1] one-hot whose last (trash) column is sliced off, so an
+    over-capacity claim writes through the trash row and contributes
+    exact-zero output and gradient.
     """
     t, e = gates.shape
     masks = []
@@ -90,6 +101,8 @@ def _top_k_dispatch(gates: jax.Array, k: int, capacity: int):
     for _ in range(k):
         idx = jnp.argmax(g, axis=-1)
         m = jax.nn.one_hot(idx, e, dtype=gates.dtype)      # [T, E]
+        if token_mask is not None:
+            m = m * token_mask.astype(gates.dtype)[:, None]
         masks.append(m)
         g = g * (1.0 - m)                  # mask out the chosen expert
 
@@ -100,9 +113,9 @@ def _top_k_dispatch(gates: jax.Array, k: int, capacity: int):
     used = jnp.zeros((1, e), gates.dtype)  # tokens claimed per expert
     for m in masks:
         pos = jnp.cumsum(m, axis=0) - m + used             # [T, E]
-        keep = m * (pos < capacity)
-        oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                            dtype=gates.dtype) * keep[..., None]
+        slot = jnp.minimum(pos, capacity).astype(jnp.int32)
+        oh = (jax.nn.one_hot(slot, capacity + 1, dtype=gates.dtype)
+              * m[..., None])[..., :capacity]
         dispatch = dispatch + oh
         combine = combine + oh * jnp.sum(gates * m, axis=-1,
                                          keepdims=True)[..., None]
@@ -116,13 +129,27 @@ def _top_k_dispatch(gates: jax.Array, k: int, capacity: int):
 
 
 def moe_ffn(x: jax.Array, params: Dict[str, Any], cfg: MoeConfig,
-            axis: str = "", axis_size: int = 1
-            ) -> Tuple[jax.Array, jax.Array]:
+            axis: str = "", axis_size: int = 1,
+            token_mask: Any = None, return_stats: bool = False,
+            stats_sharding: Any = None) -> Tuple[jax.Array, ...]:
     """MoE feed-forward on a [T, D] token block.
 
     axis: mesh axis the experts are sharded over ("" = single shard —
     all experts local, no collective). Call from INSIDE shard_map when
-    axis != "". Returns (out [T, D], aux_load_balance_loss).
+    axis != "". token_mask [T]: rows with a falsy mask claim no
+    capacity and produce exact-zero output (decode padding rows).
+    Returns (out [T, D], aux_load_balance_loss); with return_stats
+    also a psum-complete f32 stats vector [2 + E]:
+    [claims routed, claims dropped over capacity,
+    per-expert occupancy fraction of capacity].
+
+    stats_sharding (GSPMD callers only, never inside shard_map): a
+    replicated NamedSharding pinned onto the dispatch tensor for the
+    stats sums. Under expert-sharded weights the partitioner
+    propagates the e-sharded layout back into dispatch (which every
+    device computes in full from replicated gate weights) without
+    reslicing it, so an unpinned sum comes out multiplied by the
+    expert-shard count; the pin makes XLA close the sums correctly.
     """
     t, d = x.shape
     e = cfg.n_experts
@@ -139,7 +166,8 @@ def moe_ffn(x: jax.Array, params: Dict[str, Any], cfg: MoeConfig,
     xf = x.astype(jnp.float32)
     gates = jax.nn.softmax(xf @ params["wg"].astype(jnp.float32),
                            axis=-1)
-    dispatch, combine, aux = _top_k_dispatch(gates, cfg.top_k, capacity)
+    dispatch, combine, aux = _top_k_dispatch(
+        gates, cfg.top_k, capacity, token_mask=token_mask)
 
     # [T, E, C] x [T, D] -> [E, C, D] in the compute dtype
     xd = x.astype(cfg.dtype)
@@ -169,4 +197,60 @@ def moe_ffn(x: jax.Array, params: Dict[str, Any], cfg: MoeConfig,
         eo = eo.reshape(e, capacity, d)
 
     out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), eo)
-    return out.astype(x.dtype), aux
+    if not return_stats:
+        return out.astype(x.dtype), aux
+    # every gate row claims exactly top_k slots (argmax always picks
+    # an expert), masked rows none — a static count, immune to the
+    # propagation hazard stats_sharding documents
+    claims = (jnp.float32(t * cfg.top_k) if token_mask is None
+              else cfg.top_k * jnp.sum(token_mask.astype(jnp.float32)))
+    disp = dispatch
+    if stats_sharding is not None:
+        disp = jax.lax.with_sharding_constraint(disp, stats_sharding)
+    kept = jnp.sum(disp)
+    occ = jnp.sum(disp, axis=(0, 2)) / capacity            # [E]
+    if axis and p > 1:
+        kept = jax.lax.psum(kept, axis)
+        claims = jax.lax.psum(claims, axis)
+        # each rank claims up to `capacity` rows per expert, so the
+        # global occupancy fraction is the mean of the rank fractions
+        occ = jax.lax.psum(occ, axis) / p
+    stats = jnp.concatenate(
+        [jnp.stack([kept, claims - kept]), occ]).astype(jnp.float32)
+    return out.astype(x.dtype), aux, stats
+
+
+def moe_ffn_decode(x: jax.Array, params: Dict[str, Any],
+                   cfg: MoeConfig, axis: str = "", axis_size: int = 1
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Expert-parallel MoE FFN for DECODE shard_map bodies, where the
+    token block x [T, D] arrives REPLICATED over the expert axis
+    (decode shards batch over dp and heads over tp; experts ride the
+    tp — or a dedicated ep — axis). Each rank takes an equal slice of
+    the tokens (padded up to a multiple of axis_size; pad rows carry a
+    zero token_mask, so they claim no capacity and contribute
+    exact-zero output), routes it through :func:`moe_ffn`'s tiled
+    all_to_all exchange, and the rank-local outputs close with a psum
+    over the axis — the same row-parallel close as the dense MLP —
+    yielding the replicated [T, D] block the decode body expects.
+
+    Returns (out [T, D], aux, stats [2 + E]); stats are psum-complete
+    (see moe_ffn). axis_size == 1 degenerates to the single-shard
+    moe_ffn (no collective)."""
+    t, d = x.shape
+    p = max(axis_size, 1)
+    if p == 1:
+        return moe_ffn(x, params, cfg, return_stats=True)
+    tl = -(-t // p)                        # ceil(T / P) tokens per rank
+    xp = jnp.pad(x, ((0, p * tl - t), (0, 0)))
+    start = jax.lax.axis_index(axis) * tl
+    xl = jax.lax.dynamic_slice_in_dim(xp, start, tl, axis=0)
+    mask = (start + jnp.arange(tl)) < t
+    out_l, aux, stats = moe_ffn(xl, params, cfg, axis=axis,
+                                axis_size=p, token_mask=mask,
+                                return_stats=True)
+    full = jnp.zeros((p * tl, d), out_l.dtype)
+    full = jax.lax.dynamic_update_slice_in_dim(full, out_l, start,
+                                               axis=0)
+    out = jax.lax.psum(full, axis)[:t]
+    return out, jax.lax.pmean(aux, axis), stats

@@ -121,6 +121,7 @@ _RELOADABLE_KNOBS = (
     "hpx.serving.max_async_steps",
     "hpx.serving.ckpt_every",
     "hpx.serving.spec.k",
+    "hpx.serving.moe.capacity_factor",
     "hpx.cache.radix_budget_blocks",
     "hpx.cache.tier.host_budget_mb",
 )
@@ -246,12 +247,54 @@ def _rope_rows(x, pos, cfg: TransformerConfig):
     return _rope_win(x, pos[:, None], cfg)
 
 
-def _block_decode_rows(x, lp, kv, pos, cfg: TransformerConfig):
+def _moe_rows(h2, lp, cfg, moe_cf=None, moe_ep=None, moe_sink=None,
+              moe_ms=None):
+    """Shared MoE branch of the serving block fns: expert FFN over the
+    flattened [T, D] token block. `moe_cf` overrides the capacity
+    factor (None = drop-free n_experts, the token-identity default);
+    `moe_ep` = (axis_name, axis_size) routes expert-parallel through
+    `moe_ffn_decode` — only valid inside a shard_map body; `moe_sink`
+    (a list) collects the per-layer psum-complete stats vector;
+    `moe_ms` is the replicated stats sharding for GSPMD bodies (see
+    moe_ffn's stats_sharding)."""
+    from .moe import moe_ffn, moe_ffn_decode
+    from .transformer import _moe_cfg
+    cf = float(cfg.n_experts) if moe_cf is None else float(moe_cf)
+    mcfg = dataclasses.replace(_moe_cfg(cfg), capacity_factor=cf)
+    if moe_ep is not None:
+        out, _aux, stats = moe_ffn_decode(h2, lp["moe"], mcfg,
+                                          moe_ep[0], moe_ep[1])
+    else:
+        out, _aux, stats = moe_ffn(h2, lp["moe"], mcfg,
+                                   return_stats=True,
+                                   stats_sharding=moe_ms)
+    if moe_sink is not None:
+        moe_sink.append(stats)
+    return out
+
+
+def _moe_fold(sink):
+    """Fold the per-layer MoE stats vectors into ONE [2 + E] f32
+    program output: routed / dropped-over-capacity claims SUM over
+    layers, per-expert occupancy fractions AVERAGE over layers.
+    Returns None (an empty pytree — legal jit/shard_map output) for
+    dense models, so every driver can return it unconditionally."""
+    if not sink:
+        return None
+    s = jnp.sum(jnp.stack(sink), axis=0)
+    return jnp.concatenate([s[:2], s[2:] / len(sink)])
+
+
+def _block_decode_rows(x, lp, kv, pos, cfg: TransformerConfig,
+                       moe_cf=None, moe_ep=None, moe_sink=None,
+                       moe_ms=None):
     """One decoder block for ONE new token per slot with PER-SLOT cache
     positions. x: [B, 1, D]; kv: (k_cache, v_cache) [B, Smax, Nkv, H];
     pos: [B] int32 — slot b's token lands at pos[b], and its query
     attends cache positions <= pos[b]. The write is a batched scatter
-    (row b at pos[b]); everything else mirrors _block_decode."""
+    (row b at pos[b]); everything else mirrors _block_decode. MoE
+    layers route through `_moe_rows` (expert-parallel when `moe_ep`
+    names a mesh axis)."""
     kc, vc = kv
     b = x.shape[0]
     h = _ln(x, lp["ln1"])
@@ -276,33 +319,36 @@ def _block_decode_rows(x, lp, kv, pos, cfg: TransformerConfig):
     x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
-        from .moe import moe_ffn
-        from .transformer import _moe_cfg
         d = h.shape[-1]
-        mcfg = dataclasses.replace(_moe_cfg(cfg),
-                                   capacity_factor=float(cfg.n_experts))
-        out, _aux = moe_ffn(h.reshape(b, d), lp["moe"], mcfg)
+        out = _moe_rows(h.reshape(b, d), lp, cfg, moe_cf, moe_ep,
+                        moe_sink, moe_ms)
         return x + out.reshape(b, 1, d), (kc, vc)
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
     return x + h, (kc, vc)
 
 
-def _decode_rows(params, caches, tok, pos, cfg):
+def _decode_rows(params, caches, tok, pos, cfg, moe_cf=None,
+                 moe_ep=None, moe_ms=None):
     """One token per slot through every block at per-slot positions;
-    returns (caches, f32 logits [B, V])."""
+    returns (caches, f32 logits [B, V], mstats) — mstats is the folded
+    MoE stats vector (None for dense models)."""
     x = params["emb"][tok][:, None, :]
     new_caches = []
+    sink = []
     for lp, kv in zip(params["layers"], caches):
-        x, kv = _block_decode_rows(x, lp, kv, pos, cfg)
+        x, kv = _block_decode_rows(x, lp, kv, pos, cfg, moe_cf,
+                                   moe_ep, sink, moe_ms)
         new_caches.append(kv)
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-    return new_caches, logits[:, 0, :].astype(jnp.float32)
+    return (new_caches, logits[:, 0, :].astype(jnp.float32),
+            _moe_fold(sink))
 
 
 def _paged_block_rows(x, lp, pools, scales, table, pos,
                       cfg: TransformerConfig, fused=False,
-                      tp_axis=None):
+                      tp_axis=None, moe_cf=None, moe_ep=None,
+                      moe_sink=None):
     """_block_decode_rows with the K/V rows living in a shared BLOCK
     POOL instead of per-slot dense buffers. x: [B, 1, D]; pools:
     (k_pool, v_pool) each [num_blocks, block_size, Nkv, H]; scales:
@@ -342,12 +388,9 @@ def _paged_block_rows(x, lp, pools, scales, table, pos,
     x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
-        from .moe import moe_ffn
-        from .transformer import _moe_cfg
         d = h.shape[-1]
-        mcfg = dataclasses.replace(_moe_cfg(cfg),
-                                   capacity_factor=float(cfg.n_experts))
-        out, _aux = moe_ffn(h.reshape(b, d), lp["moe"], mcfg)
+        out = _moe_rows(h.reshape(b, d), lp, cfg, moe_cf, moe_ep,
+                        moe_sink)
         return x + out.reshape(b, 1, d), (kp, vp), scales
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
     if tp_axis is not None:
@@ -356,26 +399,32 @@ def _paged_block_rows(x, lp, pools, scales, table, pos,
 
 
 def _paged_decode_rows(params, pools, scales, tok, table, pos, cfg,
-                       fused=False, tp_axis=None):
+                       fused=False, tp_axis=None, moe_cf=None,
+                       moe_ep=None):
     """One token per slot through every block over paged pools;
-    returns (pools, scales, f32 logits [B, V]) — the _decode_rows
-    analog. `scales` is the per-layer list of (k_scale, v_scale)
-    sidecars for int8 pools, or None (passed through untouched)."""
+    returns (pools, scales, f32 logits [B, V], mstats) — the
+    _decode_rows analog. `scales` is the per-layer list of
+    (k_scale, v_scale) sidecars for int8 pools, or None (passed
+    through untouched)."""
     x = params["emb"][tok][:, None, :]
     new_pools, new_scales = [], []
+    sink = []
     for i, (lp, pl) in enumerate(zip(params["layers"], pools)):
         sc = None if scales is None else scales[i]
         x, pl, sc = _paged_block_rows(x, lp, pl, sc, table, pos, cfg,
-                                      fused, tp_axis)
+                                      fused, tp_axis, moe_cf, moe_ep,
+                                      sink)
         new_pools.append(pl)
         new_scales.append(sc)
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
     return (new_pools, None if scales is None else new_scales,
-            logits[:, 0, :].astype(jnp.float32))
+            logits[:, 0, :].astype(jnp.float32), _moe_fold(sink))
 
 
-def _window_rows(x, lp, kv, pos0, cfg: TransformerConfig):
+def _window_rows(x, lp, kv, pos0, cfg: TransformerConfig,
+                 moe_cf=None, moe_ep=None, moe_sink=None,
+                 moe_ms=None):
     """One decoder block for a W-token VERIFY WINDOW per slot at
     PER-SLOT positions: x [B, W, D]; slot b's window row i lands at
     cache position pos0[b] + i and attends positions <= pos0[b] + i.
@@ -415,34 +464,35 @@ def _window_rows(x, lp, kv, pos0, cfg: TransformerConfig):
     x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
-        from .moe import moe_ffn
-        from .transformer import _moe_cfg
         d = h.shape[-1]
-        mcfg = dataclasses.replace(_moe_cfg(cfg),
-                                   capacity_factor=float(cfg.n_experts))
-        out, _aux = moe_ffn(h.reshape(b * w, d), lp["moe"], mcfg)
+        out = _moe_rows(h.reshape(b * w, d), lp, cfg, moe_cf, moe_ep,
+                        moe_sink, moe_ms)
         return x + out.reshape(b, w, d), (kc, vc)
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
     return x + h, (kc, vc)
 
 
-def _decode_window_rows(params, caches, toks, pos0, cfg):
+def _decode_window_rows(params, caches, toks, pos0, cfg, moe_cf=None,
+                        moe_ep=None, moe_ms=None):
     """W tokens per slot through every block at per-slot positions
     (the speculative-verify forward); toks [B, W] int32, pos0 [B]
-    int32. Returns (caches, f32 logits [B, W, V])."""
+    int32. Returns (caches, f32 logits [B, W, V], mstats)."""
     x = params["emb"][toks]
     new_caches = []
+    sink = []
     for lp, kv in zip(params["layers"], caches):
-        x, kv = _window_rows(x, lp, kv, pos0, cfg)
+        x, kv = _window_rows(x, lp, kv, pos0, cfg, moe_cf, moe_ep,
+                             sink, moe_ms)
         new_caches.append(kv)
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
-    return new_caches, logits.astype(jnp.float32)
+    return new_caches, logits.astype(jnp.float32), _moe_fold(sink)
 
 
 def _paged_window_rows(x, lp, pools, scales, table, pos0,
                        cfg: TransformerConfig, fused=False,
-                       tp_axis=None):
+                       tp_axis=None, moe_cf=None, moe_ep=None,
+                       moe_sink=None):
     """`_window_rows` over paged pools: the scatter/gather and the
     per-query horizon live in `ops.paged_attention.
     paged_window_attention`; projections/rope/ffn are byte-identical
@@ -472,12 +522,9 @@ def _paged_window_rows(x, lp, pools, scales, table, pos0,
     x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
-        from .moe import moe_ffn
-        from .transformer import _moe_cfg
         d = h.shape[-1]
-        mcfg = dataclasses.replace(_moe_cfg(cfg),
-                                   capacity_factor=float(cfg.n_experts))
-        out, _aux = moe_ffn(h.reshape(b * w, d), lp["moe"], mcfg)
+        out = _moe_rows(h.reshape(b * w, d), lp, cfg, moe_cf, moe_ep,
+                        moe_sink)
         return x + out.reshape(b, w, d), (kp, vp), scales
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
     if tp_axis is not None:
@@ -486,21 +533,24 @@ def _paged_window_rows(x, lp, pools, scales, table, pos0,
 
 
 def _paged_decode_window_rows(params, pools, scales, toks, table, pos0,
-                              cfg, fused=False, tp_axis=None):
+                              cfg, fused=False, tp_axis=None,
+                              moe_cf=None, moe_ep=None):
     """W tokens per slot over paged pools; returns (pools, scales, f32
-    logits [B, W, V]) — the `_decode_window_rows` analog."""
+    logits [B, W, V], mstats) — the `_decode_window_rows` analog."""
     x = params["emb"][toks]
     new_pools, new_scales = [], []
+    sink = []
     for i, (lp, pl) in enumerate(zip(params["layers"], pools)):
         sc = None if scales is None else scales[i]
         x, pl, sc = _paged_window_rows(x, lp, pl, sc, table, pos0, cfg,
-                                       fused, tp_axis)
+                                       fused, tp_axis, moe_cf, moe_ep,
+                                       sink)
         new_pools.append(pl)
         new_scales.append(sc)
     x = _ln(x, params["ln_f"])
     logits = jnp.einsum("bsd,vd->bsv", x, params["emb"])
     return (new_pools, None if scales is None else new_scales,
-            logits.astype(jnp.float32))
+            logits.astype(jnp.float32), _moe_fold(sink))
 
 
 def _verify_tail(logits, toks, kvec, temp, keys, pos0, width):
@@ -686,30 +736,46 @@ class ContinuousServer:
                 "sharded paged serving is disabled "
                 "(hpx.serving.mesh.paged=0): shard the dense path "
                 "(mesh=...) or run one paged server per replica")
+        self._ep_axis, self._ep_size = None, 1
         if mesh is not None:
             # GSPMD sharded serving: slots over dp, heads over tp. The
             # dense step/prefill/splice programs are UNCHANGED —
             # placement alone makes XLA partition them (einsum
             # contractions over the tp-sharded head dim close with
-            # compiler-inserted all-reduces). The PAGED decode/verify
+            # compiler-inserted all-reduces; expert einsums partition
+            # over the expert-sharded e dim). The PAGED decode/verify
             # steps instead run under shard_map (block tables are
             # per-dp-shard; the pool gather must stay shard-local),
-            # with explicit psums over tp — see _paged_step_prog.
+            # with explicit psums over tp and MoE token routing over
+            # the expert axis — see _paged_step_prog.
             from jax.sharding import NamedSharding, PartitionSpec as P
-            from .transformer import (_decode_mesh_check,
+            from .transformer import (_decode_ep, _decode_mesh_check,
                                       _decode_pspecs, _place)
-            # the shared decode-mesh contract (axes, dense models
-            # only — MoE is the one remaining exclusion — and
+            # the shared decode-mesh contract (axes, expert and
             # head/slot divisibility); slots play the batch role
             try:
                 _decode_mesh_check(cfg, mesh, slots)
             except ValueError as e:
                 raise ValueError(str(e).replace("batch", "slots")) \
                     from None
-            params = _place(params, _decode_pspecs(params, cfg), mesh)
+            self._ep_axis, self._ep_size = _decode_ep(cfg, mesh)
+            params = _place(params, _decode_pspecs(params, cfg, mesh),
+                            mesh)
             cache_sh = NamedSharding(mesh, P("dp", None, "tp", None))
         self.params = params
         self._cache_sh = cache_sh
+        # MoE decode state: the capacity-factor knob is an int PERCENT
+        # (100 = GShard cf 1.0); 0 = auto = drop-free (cf = n_experts),
+        # the token-identity default. Routed/dropped counts and
+        # per-expert occupancy come back as one small f32 vector per
+        # step program and drain at flush boundaries (async-safe).
+        pct = rc.get_int("hpx.serving.moe.capacity_factor", 0)
+        self._moe_capacity_pct = (cfg.n_experts * 100 if pct <= 0
+                                  else max(1, int(pct)))
+        self._moe_routed = 0.0
+        self._moe_dropped = 0.0
+        self._moe_occ = [0.0] * max(0, cfg.n_experts)
+        self._moe_buf: deque = deque()
 
         if prefill_chunk is None:
             prefill_chunk = rc.get_int("hpx.serving.prefill_chunk",
@@ -787,7 +853,8 @@ class ContinuousServer:
                         + str(e).replace("batch", "slots")) from None
                 draft_params = _place(
                     draft_params,
-                    _decode_pspecs(draft_params, draft_cfg), mesh)
+                    _decode_pspecs(draft_params, draft_cfg, mesh),
+                    mesh)
             self._draft_params = draft_params
             self._draft_cfg = draft_cfg
             dn, dh = draft_cfg.kv_heads, draft_cfg.head_dim
@@ -1107,25 +1174,58 @@ class ContinuousServer:
             self._prog_misses += 1
         return _cached_program(ck, build)
 
+    def _moe_cf(self):
+        """Effective decode capacity factor from the int-percent knob
+        (None for dense models, so dense bodies never see the knob)."""
+        if self.cfg.n_experts <= 0:
+            return None
+        return self._moe_capacity_pct / 100.0
+
+    def _moe_ep(self):
+        """(axis, size) for expert-parallel routing inside the
+        shard_map paged bodies; None on a single shard — and for the
+        GSPMD dense programs, which partition the expert einsums from
+        placement alone and must never call collectives directly."""
+        if self.cfg.n_experts <= 0 or self._ep_axis is None \
+                or self._ep_size <= 1:
+            return None
+        return (self._ep_axis, self._ep_size)
+
     def _step_prog(self):
         cfg, slots, smax = self.cfg, self.slots, self.smax
-        ck = ("cb_step", cfg, slots, smax, self.mesh,
-              _tree_key(self.params))
+        ck = ("cb_step", cfg, slots, smax, self._moe_capacity_pct,
+              self.mesh, _tree_key(self.params))
 
         def build():
             cache_sh = self._cache_sh
+            moe_cf = self._moe_cf()
+            ms_sh = self._moe_stats_sh()
 
             def step(params, caches, tok, pos, temp, keys):
                 if cache_sh is not None:
                     caches = jax.tree.map(
                         lambda c: jax.lax.with_sharding_constraint(
                             c, cache_sh), caches)
-                caches, logits = _decode_rows(params, caches, tok, pos,
-                                              cfg)
+                caches, logits, ms = _decode_rows(
+                    params, caches, tok, pos, cfg, moe_cf,
+                    moe_ms=ms_sh)
                 nxt = jax.vmap(_pick_row)(logits, keys, temp, pos)
-                return caches, nxt
+                return caches, nxt, ms
             return jax.jit(step, donate_argnums=(1,))
         return self._program(ck, build)
+
+    def _moe_stats_sh(self):
+        """Replicated sharding for the MoE stats vector under GSPMD
+        dense programs. The partitioner propagates the expert-sharded
+        weight layout back into the (replicated-by-construction)
+        dispatch tensor without reslicing it, so the stats sums come
+        out multiplied by the expert-shard count; pinning the vector
+        replicated makes XLA close the sums correctly. The shard_map
+        paged programs psum explicitly and never need this."""
+        if self.mesh is None or self.cfg.n_experts <= 0:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
 
     def _chunk_prog(self, width: int):
         """One bucketed prefill chunk: toks [1, width] (tail-padded
@@ -1197,19 +1297,29 @@ class ContinuousServer:
         cfg, slots, smax = self.cfg, self.slots, self.smax
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_step", cfg, slots, smax, nb, bs, self._kv_dtype,
-              self._paged_kernel, self.mesh, _tree_key(self.params))
+              self._paged_kernel, self._moe_capacity_pct, self.mesh,
+              _tree_key(self.params))
 
         def build():
             fused = self._paged_fused
             tp_axis = None if self.mesh is None else "tp"
+            moe_cf = self._moe_cf()
+            moe_ep = self._moe_ep()
 
             def step(params, pools, scales, tok, pos, tables, temp,
                      keys):
-                pools, scales, logits = _paged_decode_rows(
+                pools, scales, logits, ms = _paged_decode_rows(
                     params, pools, scales, tok, tables, pos, cfg,
-                    fused, tp_axis)
+                    fused, tp_axis, moe_cf, moe_ep)
                 nxt = jax.vmap(_pick_row)(logits, keys, temp, pos)
-                return pools, scales, nxt
+                if ms is not None and tp_axis is not None:
+                    # fold the per-dp-group stats into one replicated
+                    # vector: routed/dropped claims sum over groups,
+                    # occupancy fractions average
+                    ms = jnp.concatenate(
+                        [jax.lax.psum(ms[:2], "dp"),
+                         jax.lax.pmean(ms[2:], "dp")])
+                return pools, scales, nxt, ms
             if self.mesh is None:
                 return self._jit_step(step)
             # sharded paged decode runs under shard_map, NOT bare
@@ -1217,9 +1327,11 @@ class ContinuousServer:
             # pool replica (block tables are per-shard int32 into a
             # dp-replicated block axis — the gather can never cross
             # shards), tp shards the kv-head axis with explicit psums
-            # in _paged_block_rows. Per-slot sampling (keys fold per
-            # slot, row 0) is shard-local, so emitted tokens match the
-            # single-device server exactly.
+            # in _paged_block_rows, and MoE layers route tokens over
+            # the expert axis via moe_ffn_decode's tiled all_to_all.
+            # Per-slot sampling (keys fold per slot, row 0) is
+            # shard-local, so emitted tokens match the single-device
+            # server exactly.
             from jax.sharding import PartitionSpec as P
             from ..utils.jaxcompat import shard_map
             pspecs, pool_sp, scale_sp = self._paged_shard_specs()
@@ -1228,7 +1340,7 @@ class ContinuousServer:
                 in_specs=(pspecs, pool_sp, scale_sp, P("dp"),
                           P("dp"), P("dp", None), P("dp"),
                           P("dp", None)),
-                out_specs=(pool_sp, scale_sp, P("dp"))))
+                out_specs=(pool_sp, scale_sp, P("dp"), P())))
         return self._program(ck, build)
 
     def _jit_step(self, step):
@@ -1247,7 +1359,8 @@ class ContinuousServer:
         pool_sp = P(*self._alloc.pool_pspec("tp"))
         scale_sp = (P(*self._alloc.scale_pspec("tp"))
                     if self._scales is not None else P())
-        return _decode_pspecs(self.params, self.cfg), pool_sp, scale_sp
+        return (_decode_pspecs(self.params, self.cfg, self.mesh),
+                pool_sp, scale_sp)
 
     def _paged_gather_prog(self):
         """Materialize one request's (possibly prefix-matched) blocks
@@ -1424,21 +1537,25 @@ class ContinuousServer:
         per LADDER WIDTH (same ladder as the prefill chunks), so the
         program cache stays O(buckets) however adaptive k wanders."""
         cfg, slots, smax = self.cfg, self.slots, self.smax
-        ck = ("cb_verify", cfg, slots, smax, width, self.mesh,
+        ck = ("cb_verify", cfg, slots, smax, width,
+              self._moe_capacity_pct, self.mesh,
               _tree_key(self.params))
 
         def build():
             cache_sh = self._cache_sh
+            moe_cf = self._moe_cf()
+            ms_sh = self._moe_stats_sh()
 
             def verify(params, caches, toks, pos0, kvec, temp, keys):
                 if cache_sh is not None:
                     caches = jax.tree.map(
                         lambda c: jax.lax.with_sharding_constraint(
                             c, cache_sh), caches)
-                caches, logits = _decode_window_rows(
-                    params, caches, toks, pos0, cfg)
+                caches, logits, ms = _decode_window_rows(
+                    params, caches, toks, pos0, cfg, moe_cf,
+                    moe_ms=ms_sh)
                 return caches, _verify_tail(
-                    logits, toks, kvec, temp, keys, pos0, width)
+                    logits, toks, kvec, temp, keys, pos0, width), ms
             return jax.jit(verify, donate_argnums=(1,))
         return self._program(ck, build)
 
@@ -1446,20 +1563,27 @@ class ContinuousServer:
         cfg, slots, smax = self.cfg, self.slots, self.smax
         nb, bs = self._alloc.num_blocks, self.block_size
         ck = ("pg_verify", cfg, slots, smax, width, nb, bs,
-              self._kv_dtype, self._paged_kernel, self.mesh,
+              self._kv_dtype, self._paged_kernel,
+              self._moe_capacity_pct, self.mesh,
               _tree_key(self.params))
 
         def build():
             fused = self._paged_fused
             tp_axis = None if self.mesh is None else "tp"
+            moe_cf = self._moe_cf()
+            moe_ep = self._moe_ep()
 
             def verify(params, pools, scales, toks, pos0, tables,
                        kvec, temp, keys):
-                pools, scales, logits = _paged_decode_window_rows(
+                pools, scales, logits, ms = _paged_decode_window_rows(
                     params, pools, scales, toks, tables, pos0, cfg,
-                    fused, tp_axis)
+                    fused, tp_axis, moe_cf, moe_ep)
+                if ms is not None and tp_axis is not None:
+                    ms = jnp.concatenate(
+                        [jax.lax.psum(ms[:2], "dp"),
+                         jax.lax.pmean(ms[2:], "dp")])
                 return pools, scales, _verify_tail(
-                    logits, toks, kvec, temp, keys, pos0, width)
+                    logits, toks, kvec, temp, keys, pos0, width), ms
             if self.mesh is None:
                 return jax.jit(verify, donate_argnums=(1, 2))
             # same shard_map layout as _paged_step_prog, stretched to
@@ -1475,7 +1599,7 @@ class ContinuousServer:
                 in_specs=(pspecs, pool_sp, scale_sp, P("dp", None),
                           P("dp"), P("dp", None), P("dp"), P("dp"),
                           P("dp", None)),
-                out_specs=(pool_sp, scale_sp, P("dp", None))),
+                out_specs=(pool_sp, scale_sp, P("dp", None), P())),
                 donate_argnums=(1, 2))
         return self._program(ck, build)
 
@@ -1489,8 +1613,8 @@ class ContinuousServer:
 
         def build():
             def step(params, caches, tok, pos):
-                caches, logits = _decode_rows(params, caches, tok, pos,
-                                              dcfg)
+                caches, logits, _ms = _decode_rows(params, caches, tok,
+                                                   pos, dcfg)
                 return caches, jnp.argmax(logits, axis=-1) \
                                   .astype(jnp.int32)
             return jax.jit(step, donate_argnums=(1,))
@@ -2420,15 +2544,17 @@ class ContinuousServer:
                 for s in live:
                     self._ensure_window(s, self._pos[s],
                                         self._pos[s] + kvec_host[s])
-                self._pools, self._scales, packed = \
+                self._pools, self._scales, packed, ms = \
                     self._paged_verify_prog(width)(
                         self.params, self._pools, self._scales, toks,
                         pos, self._tables_dev(), kvec, self._temp_dev,
                         self._keys_dev)
             else:
-                self._caches, packed = self._verify_prog(width)(
+                self._caches, packed, ms = self._verify_prog(width)(
                     self.params, self._caches, toks, pos, kvec,
                     self._temp_dev, self._keys_dev)
+            if ms is not None:
+                self._moe_buf.append(ms)
             # the speculative step's single designed host sync: one
             # packed [slots, width+1] read carries every slot's target
             # tokens AND acceptance count together
@@ -2794,6 +2920,14 @@ class ContinuousServer:
                            and t == req.eos_id)
                 if hit_eos or len(req.tokens) >= req.max_new:
                     self._finalize(s, req, hit_eos)
+        # MoE routing stats buffered by the step/verify programs: one
+        # small [2+E] vector per dispatched step, read here so the
+        # async window never gains an extra host sync
+        while self._moe_buf:
+            ms = np.asarray(self._moe_buf.popleft())
+            self._moe_routed += float(ms[0])
+            self._moe_dropped += float(ms[1])
+            self._moe_occ = [float(v) for v in ms[2:]]
         self._ckpt_sweep()
         self._reload_knobs()
         # SLO burn evaluation shares the tuner's boundary: no step in
@@ -2839,6 +2973,14 @@ class ContinuousServer:
             elif key == "hpx.serving.spec.k" and self._spec:
                 self._spec_k = min(max(1, int(raw)),
                                    self.prefill_buckets[-1] - 1)
+            elif key == "hpx.serving.moe.capacity_factor" \
+                    and self.cfg.n_experts > 0:
+                pct = int(raw)
+                # 0 = auto = drop-free; the program cache re-keys on
+                # the new percent (one compile per distinct value)
+                self._moe_capacity_pct = (
+                    self.cfg.n_experts * 100 if pct <= 0
+                    else max(1, pct))
             elif key == "hpx.cache.radix_budget_blocks" and self.paged:
                 self._radix.budget_blocks = max(1, int(raw))
             elif key == "hpx.cache.tier.host_budget_mb" and self.paged \
@@ -2985,15 +3127,17 @@ class ContinuousServer:
             if self.paged:
                 for s in live:
                     self._ensure_block(s, self._pos[s])
-                self._pools, self._scales, nxt = \
+                self._pools, self._scales, nxt, ms = \
                     self._paged_step_prog()(
                         self.params, self._pools, self._scales, tok,
                         pos, self._tables_dev(), self._temp_dev,
                         self._keys_dev)
             else:
-                self._caches, nxt = self._step_prog()(
+                self._caches, nxt, ms = self._step_prog()(
                     self.params, self._caches, tok, pos,
                     self._temp_dev, self._keys_dev)
+            if ms is not None:
+                self._moe_buf.append(ms)
             self._cur_dev = nxt
             self._rate.mark(float(len(live)))
             lanes = []

@@ -764,7 +764,8 @@ def make_pipelined_train_step(cfg: TransformerConfig, mesh,
 
 
 def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
-                  tp_axis: Optional[str] = None):
+                  tp_axis: Optional[str] = None,
+                  ep_axis: Optional[str] = None, ep_size: int = 1):
     """One decoder block for a single new token position with a KV
     cache. x: [B, 1, D]; kv: (k_cache, v_cache) each [B, Smax, N, H]
     (N = the tp-LOCAL head count under sharded decode); write_at:
@@ -805,7 +806,7 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
     x = x + o
     h = _ln(x, lp["ln2"])
     if "moe" in lp:
-        from .moe import moe_ffn
+        from .moe import moe_ffn, moe_ffn_decode
         b, s, d = h.shape
         # decode routes DROP-FREE (capacity_factor = n_experts makes
         # C >= every possible claim): with no drops, each token's output
@@ -815,7 +816,15 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
         # capacity-limited training legitimately does
         mcfg = dataclasses.replace(_moe_cfg(cfg),
                                    capacity_factor=float(cfg.n_experts))
-        out, _aux = moe_ffn(h.reshape(b * s, d), lp["moe"], mcfg)
+        if ep_axis is not None:
+            # expert-parallel decode: experts shard over ep_axis; the
+            # replicated token block splits across it and the outputs
+            # close with a psum (moe_ffn_decode) — the expert-axis
+            # analogue of the dense branch's row-parallel tp psum
+            out, _aux, _stats = moe_ffn_decode(
+                h.reshape(b * s, d), lp["moe"], mcfg, ep_axis, ep_size)
+        else:
+            out, _aux = moe_ffn(h.reshape(b * s, d), lp["moe"], mcfg)
         return x + out.reshape(b, s, d), (kc, vc)
     h = jax.nn.gelu(h @ _dq(lp["w1"], h) + lp["b1"]) @ _dq(lp["w2"], h)
     if tp_axis:
@@ -823,19 +832,21 @@ def _block_decode(x, lp, kv, write_at, cfg: TransformerConfig,
     return x + h, (kc, vc)
 
 
-def _decode_forward(params, caches, tok, pos, cfg, tp_axis=None):
+def _decode_forward(params, caches, tok, pos, cfg, tp_axis=None,
+                    ep_axis=None, ep_size=1):
     """One decode token through every block: the W == 1 case of
     _decode_window, so there is exactly ONE copy of the cached forward
     — any change to it lands in generate(), beam_search(), and both
     phases of speculative_generate(). Returns (caches, f32 logits
     [B, V])."""
     caches, logits = _decode_window(params, caches, tok[:, None], pos,
-                                    cfg, tp_axis=tp_axis)
+                                    cfg, tp_axis=tp_axis,
+                                    ep_axis=ep_axis, ep_size=ep_size)
     return caches, logits[:, 0, :]
 
 
 def _decode_window(params, caches, toks, pos0, cfg, tp_axis=None,
-                   need_logits=True):
+                   ep_axis=None, ep_size=1, need_logits=True):
     """A WINDOW of new tokens through the cached blocks in one pass:
     toks [B, W] at positions pos0..pos0+W-1. Returns (caches, f32
     logits [B, W, V]). One MXU-batched forward where a scan would run
@@ -848,7 +859,8 @@ def _decode_window(params, caches, toks, pos0, cfg, tp_axis=None,
     x = params["emb"][toks]
     new_caches = []
     for lp, kv in zip(params["layers"], caches):
-        x, kv = _block_decode(x, lp, kv, pos0, cfg, tp_axis=tp_axis)
+        x, kv = _block_decode(x, lp, kv, pos0, cfg, tp_axis=tp_axis,
+                              ep_axis=ep_axis, ep_size=ep_size)
         new_caches.append(kv)
     if not need_logits:
         return new_caches, None
@@ -865,6 +877,7 @@ _PREFILL_CHUNK = 128
 
 
 def _prefill_window(params, cfg, caches, prompt, tp_axis=None,
+                    ep_axis=None, ep_size=1,
                     chunk: int = _PREFILL_CHUNK, need_logits=True,
                     logits0=None):
     """Feed the prompt into the caches in windowed one-pass chunks
@@ -883,6 +896,7 @@ def _prefill_window(params, cfg, caches, prompt, tp_axis=None,
         e = min(plen, s + chunk)
         caches, lg = _decode_window(params, caches, prompt[:, s:e], s,
                                     cfg, tp_axis=tp_axis,
+                                    ep_axis=ep_axis, ep_size=ep_size,
                                     need_logits=need_logits
                                     and e == plen)
         if lg is not None:
@@ -909,8 +923,10 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
     (either size may be 1) runs SHARDED serving as one program: batch
     over dp, attention heads + ffn + KV caches over tp (Megatron decode
     — caches never replicate), params placed by shard_params, prompt
-    sharded [dp, None]. Dense models only (MoE decode is the drop-free
-    single-device path)."""
+    sharded [dp, None]. MoE models decode EXPERT-PARALLEL: experts
+    shard over tp (or a dedicated "ep" mesh axis), routing drop-free
+    through moe_ffn_decode's all_to_all exchange — token-identical to
+    the single-device MoE path."""
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 needs a PRNG key")
     if temperature <= 0.0 and (top_k > 0 or key is not None):
@@ -924,9 +940,11 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
     nh, hd = cfg.n_heads, cfg.head_dim
     tp = dp = 1
     tp_axis = None
+    ep_axis, ep_size = None, 1
     if mesh is not None:
         dp, tp = _decode_mesh_check(cfg, mesh, b)
         tp_axis = "tp"       # size-1 tp: the psums are no-ops
+        ep_axis, ep_size = _decode_ep(cfg, mesh)
 
     def fresh_cache(b_local, nh_local):
         caches = [(jnp.zeros((b_local, smax, nh_local, hd), cfg.dtype),
@@ -961,7 +979,8 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
 
     def forward_token(params, caches, tok, pos):
         return _decode_forward(params, caches, tok, pos, cfg,
-                               tp_axis=tp_axis)
+                               tp_axis=tp_axis, ep_axis=ep_axis,
+                               ep_size=ep_size)
 
     def step_token(params, karg, carry, inp):
         caches, _prev = carry
@@ -982,6 +1001,8 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
             logits0 = _pvary(logits0, ("dp",))
         caches, last_logits = _prefill_window(params, cfg, caches,
                                               prompt, tp_axis=tp_axis,
+                                              ep_axis=ep_axis,
+                                              ep_size=ep_size,
                                               logits0=logits0)
         # t0 = the prediction following the last prompt token, drawn at
         # position plen-1 (same key fold the in-scan path would use)
@@ -1019,7 +1040,8 @@ def generate(params, cfg: TransformerConfig, prompt: jax.Array,
     data_spec = P("dp", None)
 
     def build():
-        pspecs = _decode_pspecs(params, cfg)  # scales follow channels
+        # scales follow channels; experts take the decode layout
+        pspecs = _decode_pspecs(params, cfg, mesh)
         return jax.jit(shard_map(
             run, mesh=mesh,
             in_specs=(pspecs, data_spec, P()),
@@ -1077,16 +1099,25 @@ def _pick_row(logits_row, key, temperature, pos):
                      jnp.argmax(logits_row))
 
 
+def _decode_ep(cfg: TransformerConfig, mesh):
+    """Expert axis for sharded MoE decode: the dedicated "ep" mesh
+    axis when the mesh declares one, otherwise experts ride "tp".
+    Returns (axis_name, axis_size); (None, 1) for dense models or no
+    mesh."""
+    if mesh is None or cfg.n_experts <= 0:
+        return None, 1
+    name = "ep" if "ep" in mesh.axis_names else "tp"
+    return name, mesh.shape[name]
+
+
 def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):
     """Shared decode-mesh contract for generate()/
     speculative_generate, and for ContinuousServer — dense AND paged
     (slots play the batch role there): ("dp","tp") axes, heads/batch
-    divisible. The one remaining exclusion is MoE, whose drop-free
-    routing still decodes single-device. Returns (dp, tp)."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "sharded decode supports dense models; MoE decodes "
-            "single-device (drop-free routing)")
+    divisible. MoE models decode EXPERT-PARALLEL: experts shard over
+    "tp" (or a dedicated "ep" axis when the mesh declares one), token
+    routing rides moe_ffn's tiled all_to_all, and n_experts must
+    divide the expert axis. Returns (dp, tp)."""
     names = mesh.axis_names
     if "dp" not in names or "tp" not in names:
         raise ValueError(f"decode mesh needs ('dp','tp'); has {names}")
@@ -1097,19 +1128,50 @@ def _decode_mesh_check(cfg: TransformerConfig, mesh, batch: int):
             f"by tp={tp}")
     if batch % dp:
         raise ValueError(f"batch {batch} not divisible by dp={dp}")
+    if cfg.n_experts > 0:
+        ep_axis, ep = _decode_ep(cfg, mesh)
+        if cfg.n_experts % ep:
+            raise ValueError(
+                f"n_experts ({cfg.n_experts}) not divisible by "
+                f"{ep_axis}={ep}; shrink {ep_axis} to a divisor of "
+                f"n_experts, or declare a dedicated 'ep' mesh axis "
+                f"that divides it")
     return dp, tp
 
 
-def _decode_pspecs(params, cfg: TransformerConfig):
+def _decode_pspecs(params, cfg: TransformerConfig, mesh=None):
     """Param specs for sharded decode; quantized targets (int8 or
-    packed int4) place scales with their channels."""
+    packed int4) place scales with their channels. MoE experts take
+    the DECODE layout — experts over the expert axis (_decode_ep),
+    each expert's d_ff UNSHARDED: the training layout's tp split of
+    d_ff can't compose with experts occupying tp, and the decode close
+    is already the psum over the expert axis."""
     from .quant import QTensor, QTensor4, quantized_bits
-    if any(isinstance(x, (QTensor, QTensor4)) for x in jax.tree.leaves(
-            params,
-            is_leaf=lambda x: isinstance(x, (QTensor, QTensor4)))):
+    quant = any(isinstance(x, (QTensor, QTensor4))
+                for x in jax.tree.leaves(
+                    params,
+                    is_leaf=lambda x: isinstance(x, (QTensor,
+                                                     QTensor4))))
+    if quant:
         from .quant import quantized_param_specs
-        return quantized_param_specs(cfg, quantized_bits(params))
-    return param_specs(cfg)
+        bits = quantized_bits(params)
+        specs = quantized_param_specs(cfg, bits)
+    else:
+        specs = param_specs(cfg)
+    if cfg.n_experts > 0:
+        from .moe import moe_param_specs
+        ep_axis = _decode_ep(cfg, mesh)[0] or "tp"
+        m = moe_param_specs(ep_axis, tp_axis=None)
+        if quant:
+            # scales keep size-1 contract axes (already unsharded in
+            # the decode layout), so their spec matches the weight's
+            from .quant import _MOE_CONTRACT_AXES, _MOE_PACK_AXES
+            for mn in _MOE_CONTRACT_AXES:
+                m[mn] = (QTensor4(m[mn], m[mn], _MOE_PACK_AXES[mn])
+                         if bits == 4 else QTensor(q=m[mn], s=m[mn]))
+        for lp in specs["layers"]:
+            lp["moe"] = dict(m)
+    return specs
 
 
 
@@ -1167,8 +1229,9 @@ def speculative_generate(params, cfg: TransformerConfig,
     share the vocab (sizes may differ otherwise).
 
     mesh=None runs single-device. A Mesh(("dp","tp")) runs the same
-    sharded-serving layout as generate() (dense targets; the draft is
-    replicated); the row-agreement minimum is then PER dp SHARD, and
+    sharded-serving layout as generate() (MoE targets run
+    expert-parallel over tp/ep; the draft is replicated); the
+    row-agreement minimum is then PER dp SHARD, and
     each shard's decode loop runs its own trip count — with
     return_stats the per-row rounds report their shard's count.
 
@@ -1193,16 +1256,19 @@ def speculative_generate(params, cfg: TransformerConfig,
 
     tp_size = 1
     tp_axis = None
+    ep_axis, ep_size = None, 1
     if mesh is not None:
-        # same mesh contract as generate() (dense only, dp x tp). The
-        # DRAFT is replicated (small by construction; each tp rank
-        # drafts redundantly and identically). Acceptance is
-        # per-dp-shard local, so the while_loop trip counts
-        # legitimately DIVERGE across dp shards — no collective
-        # crosses dp inside the loop, and tp groups stay in lockstep
-        # because their logits are psum-complete.
+        # same mesh contract as generate() (dp x tp; MoE targets run
+        # expert-parallel over tp or a dedicated ep axis). The DRAFT
+        # is replicated (small by construction; each tp rank drafts
+        # redundantly and identically). Acceptance is per-dp-shard
+        # local, so the while_loop trip counts legitimately DIVERGE
+        # across dp shards — no collective crosses dp inside the loop,
+        # and tp groups stay in lockstep because their logits are
+        # psum-complete (expert psums included).
         _dp_size, tp_size = _decode_mesh_check(cfg, mesh, b)
         tp_axis = "tp"
+        ep_axis, ep_size = _decode_ep(cfg, mesh)
 
     def fresh(c: TransformerConfig, b_local, nh_local, axes):
         caches = [(jnp.zeros((b_local, smax, nh_local, c.head_dim),
@@ -1225,6 +1291,8 @@ def speculative_generate(params, cfg: TransformerConfig,
                          ("dp",))
         t_caches, t_last = _prefill_window(tgt, cfg, t_caches, prompt,
                                            tp_axis=tp_axis,
+                                           ep_axis=ep_axis,
+                                           ep_size=ep_size,
                                            logits0=logits0)
         # draft prefill is cache-only: its prompt logits are never read
         d_caches, _ = _prefill_window(dft, draft_cfg, d_caches,
@@ -1259,7 +1327,9 @@ def speculative_generate(params, cfg: TransformerConfig,
             d = d.T[:, :k]                             # [B, k]
             window = jnp.concatenate([cur[:, None], d], axis=1)
             t_caches, lg = _decode_window(tgt, t_caches, window, pos0,
-                                          cfg, tp_axis=tp_axis)
+                                          cfg, tp_axis=tp_axis,
+                                          ep_axis=ep_axis,
+                                          ep_size=ep_size)
             t = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B, k+1]
             # longest all-rows-agree prefix; +1 bonus from the target.
             # Every EMITTED token is t[:, j]: for j < a the draft
@@ -1300,7 +1370,7 @@ def speculative_generate(params, cfg: TransformerConfig,
     data_spec = P("dp", None)
 
     def build():
-        pspecs = _decode_pspecs(params, cfg)
+        pspecs = _decode_pspecs(params, cfg, mesh)
         dspecs = jax.tree.map(lambda _: P(), draft_params)
         out_spec = (data_spec, P("dp")) if return_stats else data_spec
         return jax.jit(shard_map(

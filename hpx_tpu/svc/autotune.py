@@ -529,6 +529,13 @@ def server_tuner(srv: Any, name: str = "serving",
         bind("hpx.cache.radix_budget_blocks",
              lambda: srv._radix.budget_blocks,
              lambda v: setattr(srv._radix, "budget_blocks", v))
+    if getattr(srv.cfg, "n_experts", 0) > 0:
+        # the percent knob ceilings at drop-free (cf = n_experts):
+        # probing above it only pads the expert exchange wider
+        bind("hpx.serving.moe.capacity_factor",
+             lambda: srv._moe_capacity_pct,
+             lambda v: setattr(srv, "_moe_capacity_pct", max(1, v)),
+             hi_cap=srv.cfg.n_experts * 100)
     return from_config(knobs, name=name, arbiter=arbiter)
 
 

@@ -1376,6 +1376,36 @@ def test_hpx016_tier_counter_namespace_is_stable():
         path="hpx_tpu/svc/fixture.py") == []
 
 
+def test_hpx016_moe_counter_namespace_is_stable():
+    """The /serving{...}/moe/* namespace is an observability contract:
+    the MoE decode counters cache/counters.py registers for an
+    expert-routed server must (a) still be registered under exactly
+    those names and (b) parse under the HPX016 counter grammar,
+    including the per-expert `expert#e` instance fragment."""
+    from hpx_tpu.analysis.rules import _COUNTER_NAME_RE
+    from hpx_tpu.svc.performance_counters import counter_name
+
+    src = open(os.path.join(REPO, "hpx_tpu", "cache", "counters.py"),
+               encoding="utf-8").read()
+    for lit in ('"moe/tokens-routed"', '"moe/tokens-dropped"',
+                'f"moe/expert#{e}/occupancy"'):
+        assert lit in src, \
+            f"{lit} gone from cache/counters.py — the MoE counter " \
+            "namespace is pinned; rename both sides or don't"
+    leaves = ["moe/tokens-routed", "moe/tokens-dropped",
+              "moe/expert#0/occupancy", "moe/expert#7/occupancy"]
+    for leaf in leaves:
+        name = counter_name("serving", leaf, "server#0", locality=0)
+        assert _COUNTER_NAME_RE.match(name), name
+    # and the literal form stays HPX016-clean at a query site
+    assert findings(
+        "from hpx_tpu.svc.performance_counters import query_counter\n"
+        "def scrape():\n"
+        "    return query_counter(\n"
+        '        "/serving{locality#0/server#0}/moe/tokens-dropped")\n',
+        path="hpx_tpu/svc/fixture.py") == []
+
+
 # ---------------------------------------------------------------------------
 # HPX023 — quantile scans reachable from the serving hot path
 # ---------------------------------------------------------------------------

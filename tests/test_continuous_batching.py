@@ -158,11 +158,13 @@ def test_sharded_server_validates(params):
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
     with pytest.raises(ValueError, match="slots"):
         ContinuousServer(params, CFG, slots=3, smax=32, mesh=mesh)
-    # the shared decode-mesh contract applies: MoE serves single-device
+    # MoE decodes expert-parallel now; the only MoE refusal left is
+    # expert-count divisibility over the expert axis, and it names
+    # the counts and the remedy
     import dataclasses
-    moe_cfg = dataclasses.replace(CFG, n_experts=4)
+    moe_cfg = dataclasses.replace(CFG, n_experts=3)
     moe_params = tfm.init_params(moe_cfg, jax.random.PRNGKey(8))
-    with pytest.raises(NotImplementedError, match="dense"):
+    with pytest.raises(ValueError, match=r"n_experts \(3\).*tp=2"):
         ContinuousServer(moe_params, moe_cfg, slots=4, smax=32,
                          mesh=mesh)
 

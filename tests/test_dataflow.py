@@ -414,6 +414,62 @@ def build(devs):
     assert "PartitionSpec axis 'tp'" in fs[0].message
 
 
+# The expert-parallel decode shape (models/moe.moe_ffn_decode): a
+# same-file helper carrying axis_index / tiled all_to_all / psum over
+# the expert axis, called from the shard_map body.  The helper-chasing
+# path must CHECK these collectives, not skip them.
+HPX021_EP = """\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+def _moe(x):
+    i = jax.lax.axis_index("ep")
+    x = jax.lax.all_to_all(x, "ep", split_axis=0, concat_axis=2,
+                           tiled=True)
+    return jax.lax.psum(x, "ep") + i
+
+def build(devs):
+    mesh = Mesh(devs, ("dp", "ep"))
+
+    def body(x):
+        return _moe(x)
+
+    return shard_map(body, mesh=mesh,
+                     in_specs=P("dp"), out_specs=P("dp"))
+"""
+
+
+def test_hpx021_ep_axis_declared_is_silent():
+    assert _lint({"hpx_tpu/models/fix21.py": HPX021_EP},
+                 ["HPX021"]) == []
+
+
+def test_hpx021_ep_axis_undeclared_fires_in_chased_helper():
+    # the same body on a mesh WITHOUT "ep" (the dp/tp serving default
+    # before an ep axis is declared): every "ep" collective in the
+    # chased helper flags, including the tiled all_to_all exchange
+    src = HPX021_EP.replace('("dp", "ep")', '("dp", "tp")')
+    fs = _lint({"hpx_tpu/models/fix21.py": src}, ["HPX021"])
+    assert rules_of(fs) == ["HPX021"] * 3
+    msgs = "\n".join(f.message for f in fs)
+    assert "axis_index() over axis 'ep'" in msgs
+    assert "all_to_all() over axis 'ep'" in msgs
+    assert "psum() over axis 'ep'" in msgs
+    assert "(dp, tp)" in msgs
+
+
+def test_hpx021_registry_covers_moe_decode_collectives():
+    # pin: every collective moe_ffn / moe_ffn_decode use inside
+    # shard_map bodies stays in the axis-arg registry with the right
+    # position, so their axis literals are checked rather than skipped
+    from hpx_tpu.analysis.dataflow import _COLLECTIVE_AXIS_ARG
+    assert _COLLECTIVE_AXIS_ARG["all_to_all"] == 1
+    assert _COLLECTIVE_AXIS_ARG["axis_index"] == 0
+    assert _COLLECTIVE_AXIS_ARG["psum"] == 1
+    assert _COLLECTIVE_AXIS_ARG["pmean"] == 1
+
+
 # ---------------------------------------------------------------------------
 # HPX022 — flow-sensitive host sync
 # ---------------------------------------------------------------------------

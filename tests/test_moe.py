@@ -173,6 +173,55 @@ class TestExpertParallel:
                     cfg, axis="ep", axis_size=2)
 
 
+class TestCapacityOverflow:
+    def test_overflow_drops_deterministic_and_exact_zero(self):
+        """Overflow routing is pure argmax over f32 gates — no RNG, no
+        nondeterministic reduction — so two runs drop THE SAME tokens,
+        and a dropped token (all its claims through the trash row)
+        contributes exact-zero output, not merely small."""
+        import math
+        from hpx_tpu.models.moe import _top_k_dispatch
+        cfg = MoeConfig(n_experts=E, top_k=1, capacity_factor=0.25,
+                        d_model=D, d_ff=F)
+        p = _params(cfg, seed=5)
+        x = _x(6)
+        out1, _, st1 = moe_ffn(x, p, cfg, return_stats=True)
+        out2, _, st2 = moe_ffn(x, p, cfg, return_stats=True)
+        np.testing.assert_array_equal(np.asarray(out1),
+                                      np.asarray(out2))
+        np.testing.assert_array_equal(np.asarray(st1),
+                                      np.asarray(st2))
+        routed, dropped = float(st1[0]), float(st1[1])
+        assert dropped > 0            # the fixture actually overflows
+        assert routed + dropped == T * cfg.top_k
+        assert float(jnp.max(st1[2:])) <= 1.0 + 1e-6   # occupancy caps
+        cap = max(1, math.ceil(T * cfg.top_k
+                               * cfg.capacity_factor / E))
+        gates = jax.nn.softmax(x @ p["wg"], axis=-1)
+        disp, _, _ = _top_k_dispatch(gates, cfg.top_k, cap)
+        lost = np.asarray(jnp.sum(disp, axis=(1, 2)) == 0)
+        assert lost.any()
+        assert (np.asarray(out1)[lost] == 0.0).all()
+
+    def test_bf16_gating_agrees_with_f32(self):
+        """Gating always runs in f32 (the xf upcast), so a bf16 expert
+        compute makes the SAME routing and drop decisions as f32 —
+        stats identical, outputs within bf16 rounding."""
+        cfg32 = MoeConfig(n_experts=E, top_k=2, capacity_factor=1.0,
+                          d_model=D, d_ff=F, dtype=jnp.float32)
+        cfg16 = MoeConfig(n_experts=E, top_k=2, capacity_factor=1.0,
+                          d_model=D, d_ff=F, dtype=jnp.bfloat16)
+        p = _params(cfg32, seed=11)
+        x = _x(12)
+        out32, _, st32 = moe_ffn(x, p, cfg32, return_stats=True)
+        out16, _, st16 = moe_ffn(x, p, cfg16, return_stats=True)
+        np.testing.assert_array_equal(np.asarray(st32),
+                                      np.asarray(st16))
+        np.testing.assert_allclose(
+            np.asarray(out16, np.float32), np.asarray(out32),
+            rtol=0.1, atol=0.1)
+
+
 def test_top_k_exceeding_experts_raises():
     cfg = MoeConfig(n_experts=2, top_k=3, d_model=D, d_ff=F)
     with pytest.raises(ValueError, match="top_k"):

@@ -165,12 +165,13 @@ def test_sharded_paged_validates(params, mesh):
     with pytest.raises(ValueError, match="slots"):
         ContinuousServer(params, CFG, slots=3, smax=64, paged=True,
                          mesh=mesh)
-    # MoE is the one REMAINING exclusion
+    # MoE decodes expert-parallel now; the remaining refusal is
+    # expert-count divisibility over the expert axis
     moe = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
                                 head_dim=8, n_layers=2, d_ff=64,
-                                n_experts=4)
+                                n_experts=3)
     mp = tfm.init_params(moe, jax.random.PRNGKey(1))
-    with pytest.raises(NotImplementedError, match="dense"):
+    with pytest.raises(ValueError, match=r"n_experts \(3\).*tp=2"):
         ContinuousServer(mp, moe, slots=4, smax=64, paged=True,
                          mesh=mesh)
     # bogus residency knob

@@ -323,9 +323,13 @@ def test_generate_sharded_rejects_bad(devices):
     bad_batch = jnp.ones((3, 4), jnp.int32)       # 3 % dp=2 != 0
     with pytest.raises(ValueError, match="divisible"):
         tfm.generate(params, CFG, bad_batch, max_new=2, mesh=mesh)
-    with pytest.raises(NotImplementedError):
-        tfm.generate(tfm.init_params(MOE_CFG, jax.random.PRNGKey(2)),
-                     MOE_CFG, jnp.ones((2, 4), jnp.int32), max_new=2,
+    # MoE decodes expert-parallel now; the remaining MoE refusal is
+    # expert divisibility over the expert axis, with the remedy named
+    import dataclasses
+    odd = dataclasses.replace(MOE_CFG, n_experts=3, moe_top_k=2)
+    with pytest.raises(ValueError, match=r"n_experts \(3\).*tp=2"):
+        tfm.generate(tfm.init_params(odd, jax.random.PRNGKey(2)),
+                     odd, jnp.ones((2, 4), jnp.int32), max_new=2,
                      mesh=mesh)
 
 
