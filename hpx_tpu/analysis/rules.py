@@ -1155,3 +1155,98 @@ class TunableKnobMutationRule(Rule):
 
         walk(ctx.tree, "<module>")
         yield from out
+
+
+# shape-ladder knobs with a resolver chain: explicit operator config,
+# then the perfdb learned tier, then the declared schema default.
+# Keyed param/kwarg name -> the chain a baked literal bypasses.
+_SHAPE_KNOB_PARAMS = {
+    "block_size": "hpx.paged.block_size + the perfdb learned-blocks "
+                  "tier (ops.attention_pallas.resolve_paged_block)",
+    "prefill_chunk": "hpx.serving.prefill_chunk + the perfdb "
+                     "learned-ladder tier",
+    "prefill_buckets": "hpx.serving.prefill_buckets + the perfdb "
+                       "learned-ladder tier",
+    "spec_k": "hpx.serving.spec.k + the perfdb learned-ladder tier",
+    "page_size": "hpx.paged.block_size + the perfdb learned-blocks "
+                 "tier",
+}
+
+
+def _is_shape_literal(node: ast.AST) -> bool:
+    """A bare int literal, or a tuple/list of them (bucket ladders)."""
+    if isinstance(node, ast.Constant):
+        return type(node.value) is int
+    if isinstance(node, (ast.Tuple, ast.List)) and node.elts:
+        return all(isinstance(e, ast.Constant)
+                   and type(e.value) is int for e in node.elts)
+    return False
+
+
+@register
+class BakedShapeConstantRule(Rule):
+    """HPX024: a shape-ladder knob (``block_size``, ``prefill_chunk``,
+    ``prefill_buckets``, ``spec_k``, ``page_size``) baked to an int
+    literal in a parameter default or call-site keyword inside
+    ``models/``/``svc/``/``ops/``.
+
+    These knobs have three legitimate sources, consulted in order:
+    explicit operator config (``hpx.serving.*``/``hpx.paged.*``), the
+    perfdb learned tier (``hpx.perfdb.use_learned_ladders`` — the
+    geometry benchmarks/ladder_search.py re-derived from measured
+    costs), and the declared schema default.  A literal baked at a
+    signature or call site silently pins the geometry for every
+    caller: the learned ladder never applies there, and two
+    components can disagree about a shape they must share (a prefill
+    worker emitting 16-row segments into a decode pool tuned to 32).
+    Fix: default the parameter to ``None`` and resolve through the
+    chain (``resolve_paged_block``, ``_resolve_buckets``), or thread
+    the owning component's already-resolved value.  A deliberate bake
+    (reference path, fixed-geometry kernel) carries ``# hpxlint:
+    disable=HPX024 — <why>`` or a baseline entry with justification.
+    """
+
+    id = "HPX024"
+    name = "baked-shape-constant"
+    severity = "warning"
+
+    _SCOPE = ("hpx_tpu/models/", "hpx_tpu/svc/", "hpx_tpu/ops/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.in_subpath(*self._SCOPE):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                a = node.args
+                pos = a.posonlyargs + a.args
+                pairs = list(zip(pos[len(pos) - len(a.defaults):],
+                                 a.defaults))
+                pairs += [(p, d) for p, d in
+                          zip(a.kwonlyargs, a.kw_defaults)
+                          if d is not None]
+                for param, default in pairs:
+                    if param.arg in _SHAPE_KNOB_PARAMS \
+                            and _is_shape_literal(default):
+                        yield self.finding(
+                            ctx, default,
+                            f"parameter `{param.arg}` of "
+                            f"{node.name}() bakes a shape constant "
+                            "in its default — the resolver chain "
+                            f"({_SHAPE_KNOB_PARAMS[param.arg]}) "
+                            "never applies for callers that omit "
+                            "it; default to None and resolve, or "
+                            "thread the owner's resolved value")
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg in _SHAPE_KNOB_PARAMS \
+                            and _is_shape_literal(kw.value):
+                        yield self.finding(
+                            ctx, kw.value,
+                            f"call-site keyword `{kw.arg}` bakes a "
+                            "shape constant — it pins this "
+                            "component's geometry against the "
+                            "resolver chain "
+                            f"({_SHAPE_KNOB_PARAMS[kw.arg]}); pass "
+                            "the resolved value (or omit the "
+                            "keyword and let the callee resolve)")

@@ -415,6 +415,26 @@ declare("hpx.prof.peak_gflops", "float", "0",
         "roofline denominator in GFLOP/s (0 = infer from device kind; "
         "unknown kinds report roofline fraction 0)")
 
+# -- persistent perf database (svc/perfdb) ----------------------------------
+declare("hpx.perfdb.path", "str", "",
+        "versioned cross-run performance store (JSON); empty = no "
+        "store — producers no-op, consumers fall back to constants")
+declare("hpx.perfdb.use_learned_ladders", "bool", "0",
+        "boot-time consult of the perfdb ladders: on a key hit with "
+        "enough samples the server overrides the hand-picked serving "
+        "ladder defaults; off (or on a miss) it is byte-identical to "
+        "the constants")
+declare("hpx.perfdb.min_samples", "int", "3",
+        "samples a learned ladder/block entry needs before a cold "
+        "boot trusts it (below = counted stale, constants win)")
+declare("hpx.perfdb.record", "bool", "0",
+        "bank the live progprof table into the perfdb on "
+        "stop_profiling() (needs hpx.perfdb.path)")
+declare("hpx.perfdb.allow_session", "bool", "0",
+        "accept builder-session-provenance ladders at boot (default "
+        "off: only on-chip-derived ladders override constants — same "
+        "discipline as bench.py medians)")
+
 # -- flight recorder (svc/flight) -------------------------------------------
 declare("hpx.flight.enabled", "bool", "1",
         "fault flight recorder master switch (lazy: allocates nothing "

@@ -149,7 +149,14 @@ class PrefillWorker(_WorkerRing):
     already-shipped prefix and only the suffix recomputes."""
 
     def __init__(self, params, cfg: TransformerConfig, smax: int = 512,
-                 block_size: int = 16, **server_kwargs) -> None:
+                 block_size: Optional[int] = None,
+                 **server_kwargs) -> None:
+        if block_size is None:
+            # the decode pool's geometry authority (env > perfdb
+            # learned tier > seed table > default) — emitted segments
+            # must match the pool the router splices them into
+            from ..ops.attention_pallas import resolve_paged_block
+            block_size = resolve_paged_block(cfg.head_dim)
         self.block_size = int(block_size)
         self._eng = ContinuousServer(params, cfg, slots=1, smax=smax,
                                      paged=False, async_dispatch=False,

@@ -87,7 +87,7 @@ from ..cache.radix import RadixCache
 from ..core.errors import Error, HpxError
 from ..svc import faultinject, flight, tracing
 from ..svc.resiliency import sync_replay
-from ..ops.attention_pallas import resolve_paged_block
+from ..ops.attention_pallas import resolve_paged_block_src
 from ..ops.paged_attention import (
     gather_block_kv,
     paged_decode_attention,
@@ -222,6 +222,53 @@ def _resolve_buckets(spec, chunk: int) -> Tuple[int, ...]:
             f"hpx.serving.prefill_buckets parsed to nothing: {spec!r}")
     vals.append(chunk)
     return tuple(sorted(set(vals)))
+
+
+def _resolve_kv_dtype(kv_dtype, rc) -> str:
+    """The hpx.cache.kv_dtype resolution _init_paged applies, factored
+    out so the perfdb boot consult can key on the RESOLVED dtype
+    before the paged state is built."""
+    if kv_dtype is None:
+        kv_dtype = rc.get("hpx.cache.kv_dtype", "bf16")
+    if kv_dtype not in ("bf16", "int8", "fp8"):
+        raise ValueError(
+            "hpx.cache.kv_dtype must be one of 'bf16' (pools in "
+            "the model compute dtype), 'int8' (quantized blocks "
+            "with absmax scale sidecars) or 'fp8' (e4m3 blocks "
+            f"with the same sidecars), got {kv_dtype!r}")
+    return kv_dtype
+
+
+def _resolve_paged_kernel(paged_kernel, rc) -> str:
+    """hpx.serving.paged_kernel resolution (auto -> fused on TPU,
+    gather elsewhere), factored out of _init_paged for the same
+    reason as _resolve_kv_dtype."""
+    if paged_kernel is None:
+        paged_kernel = rc.get("hpx.serving.paged_kernel", "auto")
+    if paged_kernel in (None, "", "auto"):
+        # the fused Pallas table-walk kernel is native on TPU;
+        # everywhere else the XLA gather formulation is the fast
+        # path (interpret-mode Pallas is a test vehicle, not a
+        # serving path)
+        paged_kernel = ("fused" if jax.default_backend() == "tpu"
+                        else "gather")
+    if paged_kernel not in ("gather", "fused", "fused_online"):
+        raise ValueError(
+            "hpx.serving.paged_kernel must be one of 'auto', "
+            "'gather', 'fused' (bitwise Pallas table walk) or "
+            "'fused_online' (O(block)-scratch online softmax), "
+            f"got {paged_kernel!r}")
+    return paged_kernel
+
+
+def _rc_at_default(rc, key: str) -> bool:
+    """True when the effective config value for ``key`` is its
+    DECLARED default — the learned-ladder override policy: a value an
+    operator set explicitly (ini/env/CLI/set()) always beats the
+    perfdb, even when the store holds a hit for the shape."""
+    from ..core import config_schema
+    entry = config_schema.lookup(key)
+    return entry is not None and rc.get(key) == entry.default
 
 
 def _rope_win(x, posw, cfg: TransformerConfig):
@@ -777,13 +824,51 @@ class ContinuousServer:
         self._moe_occ = [0.0] * max(0, cfg.n_experts)
         self._moe_buf: deque = deque()
 
+        # learned-ladder boot consult (svc/perfdb): with
+        # hpx.perfdb.use_learned_ladders=1 the store is keyed on this
+        # server's (device, shape, kv_dtype, kernel, mesh) and a
+        # usable hit overrides the hand-picked ladder DEFAULTS below.
+        # Explicit settings — constructor args, or config values moved
+        # off their declared defaults — always win, and with the knob
+        # off (or on a miss/stale entry) every resolution below is
+        # byte-identical to the constants (pinned by
+        # tests/test_perfdb.py).
+        self._learned_ladder = None
+        self._ladder_source = "default"
+        self._block_size_src = "n/a"
+        if rc.get_bool("hpx.perfdb.use_learned_ladders", False):
+            from ..svc import perfdb as _perfdb
+            _perfdb.ensure_counters()
+            if self.paged:
+                lk_kvd = _resolve_kv_dtype(kv_dtype, rc)
+                lk_kern = _resolve_paged_kernel(paged_kernel, rc)
+            else:
+                lk_kvd, lk_kern = "-", "dense"
+            self._learned_ladder = _perfdb.learned_ladder_for(
+                cfg, lk_kvd, lk_kern, mesh)
+        # "learned" only when a stored value actually lands — an
+        # explicit constructor arg or operator config write beats the
+        # store, and the source string must say so
+        learned = self._learned_ladder or {}
+
         if prefill_chunk is None:
-            prefill_chunk = rc.get_int("hpx.serving.prefill_chunk",
-                                       _PREFILL_CHUNK)
+            if learned.get("prefill_chunk") and \
+                    _rc_at_default(rc, "hpx.serving.prefill_chunk"):
+                prefill_chunk = int(learned["prefill_chunk"])
+                self._ladder_source = "learned"
+            else:
+                prefill_chunk = rc.get_int("hpx.serving.prefill_chunk",
+                                           _PREFILL_CHUNK)
         self.prefill_chunk = max(1, int(prefill_chunk))
         if prefill_buckets is None:
-            prefill_buckets = rc.get("hpx.serving.prefill_buckets",
-                                     "auto")
+            if learned.get("prefill_buckets") and \
+                    _rc_at_default(rc, "hpx.serving.prefill_buckets"):
+                prefill_buckets = ",".join(
+                    str(int(b)) for b in learned["prefill_buckets"])
+                self._ladder_source = "learned"
+            else:
+                prefill_buckets = rc.get("hpx.serving.prefill_buckets",
+                                         "auto")
         self.prefill_buckets = _resolve_buckets(prefill_buckets,
                                                 self.prefill_chunk)
         if async_dispatch is None:
@@ -810,7 +895,13 @@ class ContinuousServer:
                 f"got {spec_draft!r}")
         self._spec_source = spec_draft
         if spec_k is None:
-            spec_k = rc.get_int("hpx.serving.spec.k", 4)
+            sk = learned.get("spec_k") or {}
+            if sk.get("best") and _rc_at_default(rc,
+                                                "hpx.serving.spec.k"):
+                spec_k = int(sk["best"])
+                self._ladder_source = "learned"
+            else:
+                spec_k = rc.get_int("hpx.serving.spec.k", 4)
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         # the verify window (k drafts + the current token) rides the
@@ -889,6 +980,13 @@ class ContinuousServer:
                 return jnp.zeros((slots, smax, nkv, hd), cfg.dtype)
             self._caches = [(zeros(), zeros())
                             for _ in range(cfg.n_layers)]
+        # live progprof producer attribution: while hpx.perfdb.record
+        # is on, this server's key names the cost-surface point the
+        # profiled programs belong to (see svc/perfdb.bank_profile)
+        from ..svc import perfdb as _perfdb
+        if _perfdb.record_enabled():
+            _perfdb.ensure_counters()
+            _perfdb.note_live_key(self.perf_key())
         # windowed decode throughput, read by the serving counters
         from ..svc.performance_counters import RateCounter
         self._rate = RateCounter(window_s=5.0)
@@ -1006,46 +1104,36 @@ class ContinuousServer:
         from ..core.config import runtime_config
         cfg, slots, smax = self.cfg, self.slots, self.smax
         rc = runtime_config()
-        if kv_dtype is None:
-            kv_dtype = rc.get("hpx.cache.kv_dtype", "bf16")
-        if kv_dtype not in ("bf16", "int8", "fp8"):
-            raise ValueError(
-                "hpx.cache.kv_dtype must be one of 'bf16' (pools in "
-                "the model compute dtype), 'int8' (quantized blocks "
-                "with absmax scale sidecars) or 'fp8' (e4m3 blocks "
-                f"with the same sidecars), got {kv_dtype!r}")
-        self._kv_dtype = kv_dtype
-        if paged_kernel is None:
-            paged_kernel = rc.get("hpx.serving.paged_kernel", "auto")
-        if paged_kernel in (None, "", "auto"):
-            # the fused Pallas table-walk kernel is native on TPU;
-            # everywhere else the XLA gather formulation is the fast
-            # path (interpret-mode Pallas is a test vehicle, not a
-            # serving path)
-            paged_kernel = ("fused" if jax.default_backend() == "tpu"
-                            else "gather")
-        if paged_kernel not in ("gather", "fused", "fused_online"):
-            raise ValueError(
-                "hpx.serving.paged_kernel must be one of 'auto', "
-                "'gather', 'fused' (bitwise Pallas table walk) or "
-                "'fused_online' (O(block)-scratch online softmax), "
-                f"got {paged_kernel!r}")
-        self._paged_kernel = paged_kernel
+        self._kv_dtype = _resolve_kv_dtype(kv_dtype, rc)
+        self._paged_kernel = paged_kernel = _resolve_paged_kernel(
+            paged_kernel, rc)
         # the `fused=` mode threaded down to ops.paged_attention:
         # False -> gather oracle, True -> bitwise kernel, "online" ->
         # the O(block) online-softmax kernel
         self._paged_fused = {"gather": False, "fused": True,
                              "fused_online": "online"}[paged_kernel]
+        learned = self._learned_ladder or {}
         if block_size is None:
             v = rc.get("hpx.cache.block_size", "auto")
             if v in (None, "", "auto"):
-                # tuned table banked by `benchmarks/flash_tune.py
-                # --paged` (ops/paged_blocks.json); 16 when no entry
-                # covers this (head_dim, kv_dtype)
-                block_size = resolve_paged_block(cfg.head_dim,
-                                                 self._kv_dtype, 16)
+                if learned.get("block_size"):
+                    # this shape's learned ladder carries its own
+                    # block size — most specific tier, beats the
+                    # (head_dim, kv_dtype)-keyed tables below
+                    block_size = int(learned["block_size"])
+                    self._block_size_src = "learned"
+                else:
+                    # perfdb learned-blocks tier, then the seed table
+                    # banked by `benchmarks/flash_tune.py --paged`
+                    # (ops/paged_blocks.json), then 16
+                    block_size, self._block_size_src = \
+                        resolve_paged_block_src(cfg.head_dim,
+                                                self._kv_dtype, 16)
             else:
                 block_size = int(v)
+                self._block_size_src = "config"
+        else:
+            self._block_size_src = "arg"
         bs = int(block_size)
         if bs < 1:
             raise ValueError(f"block_size must be >= 1, got {bs}")
@@ -1887,7 +1975,19 @@ class ContinuousServer:
         return ("f32" if jnp.dtype(self.cfg.dtype).itemsize == 4
                 else "bf16")
 
-    def hbm_read_stats(self) -> Dict[str, float]:
+    def perf_key(self) -> str:
+        """This server's point on the perfdb cost surface —
+        ``device|shape|kv_dtype|kernel|mesh`` (see svc/perfdb).  The
+        key the learned-ladder boot consult resolves against, and the
+        one producers bank this server's costs under."""
+        from ..svc import perfdb as _perfdb
+        return str(_perfdb.PerfKey(
+            _perfdb.device_kind(), _perfdb.shape_str(self.cfg),
+            self._kv_dtype if self.paged else "-",
+            self._paged_kernel if self.paged else "dense",
+            _perfdb.mesh_str(self.mesh)))
+
+    def hbm_read_stats(self) -> Dict[str, Any]:
         """Modeled decode-attention HBM read cost per generated token,
         fed from pool dtype + table occupancy (the
         /cache{...}/{count,bytes}/hbm-read-per-token counters and the
@@ -1913,6 +2013,10 @@ class ContinuousServer:
         return {
             "hbm_read_blocks_per_token": per_tok,
             "hbm_read_bytes_per_token": per_tok * bb,
+            # where this server's block_size came from: arg | config |
+            # env | learned (perfdb) | seed (paged_blocks.json) |
+            # default — the satellite audit hook for learned ladders
+            "block_size_source": self._block_size_src,
         }
 
     def spec_stats(self) -> Dict[str, float]:

@@ -949,6 +949,62 @@ def test_hpx017_github_gate_on_real_tree(capsys):
     assert capsys.readouterr().out == ""
 
 
+HPX024_BAD = """\
+def make_worker(params, cfg, block_size=16):
+    return Worker(params, cfg, block_size)
+
+def boot(params, cfg):
+    return Server(params, cfg, spec_k=8,
+                  prefill_buckets=[8, 16, 32, 64, 128])
+"""
+
+HPX024_GOOD = """\
+def make_worker(params, cfg, block_size=None):
+    if block_size is None:
+        block_size = resolve_paged_block(cfg.head_dim)
+    return Worker(params, cfg, block_size)
+
+def boot(params, cfg, rc, chunk):
+    k = rc.get_int("hpx.serving.spec.k", 4)
+    return Server(params, cfg, spec_k=k,
+                  prefill_buckets=_resolve_buckets("auto", chunk))
+"""
+
+
+def test_hpx024_fires_on_baked_shape_literals():
+    fs = findings(HPX024_BAD, path="hpx_tpu/models/fixture.py")
+    assert rules_of(fs) == ["HPX024", "HPX024", "HPX024"]
+    assert "block_size" in fs[0].message
+    assert "make_worker" in fs[0].message
+    assert "resolve_paged_block" in fs[0].message
+    assert "spec_k" in fs[1].message
+    assert "prefill_buckets" in fs[2].message
+
+
+def test_hpx024_silent_on_resolver_chain():
+    assert findings(HPX024_GOOD,
+                    path="hpx_tpu/models/fixture.py") == []
+
+
+def test_hpx024_scope():
+    # models/, svc/ and ops/ carry the serving geometry; layers
+    # outside them (exec/, algo/) may bake shapes freely
+    assert rules_of(findings(
+        HPX024_BAD, path="hpx_tpu/svc/fixture.py")) == ["HPX024"] * 3
+    assert rules_of(findings(
+        HPX024_BAD, path="hpx_tpu/ops/fixture.py")) == ["HPX024"] * 3
+    assert findings(HPX024_BAD) == []  # default exec/ path
+
+
+def test_hpx024_real_tree_is_clean():
+    # ground truth: the shipped models//svc//ops layers resolve every
+    # shape knob through the config/perfdb chain (PrefillWorker's
+    # block_size routes through resolve_paged_block)
+    res = lint_paths([os.path.join(REPO, "hpx_tpu")],
+                     rules=all_rules(["HPX024"]))
+    assert [f.rule for f in res.findings] == []
+
+
 def test_all_rules_registry():
     ids = sorted(r.id for r in all_rules())
     assert ids == ["HPX001", "HPX002", "HPX003", "HPX004",
@@ -956,7 +1012,7 @@ def test_all_rules_registry():
                    "HPX009", "HPX010", "HPX011", "HPX012",
                    "HPX013", "HPX014", "HPX015", "HPX016",
                    "HPX017", "HPX018", "HPX019", "HPX020",
-                   "HPX021", "HPX022", "HPX023"]
+                   "HPX021", "HPX022", "HPX023", "HPX024"]
 
 
 def test_rule_registry_completeness(capsys):

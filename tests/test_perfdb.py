@@ -1,0 +1,319 @@
+"""The persistent perf store (svc/perfdb) + offline ladder search.
+
+Pins the contracts ISSUE 20 ships on: the versioned store refuses
+corrupt/foreign schemas LOUDLY (naming both versions), concurrent
+writers merge losslessly (union of observation logs, additive stats,
+rev-winning ladders), compaction never double-counts through a stale
+writer (folded-id tombstones), the offline derivation is a pure
+function of the store (same DB -> byte-identical proposal), and the
+serving boot consult is fail-safe: with ``hpx.perfdb.
+use_learned_ladders=0`` or an empty store, a ContinuousServer is
+byte-identical to the hand-picked defaults — same tokens, same
+O(buckets) compile count."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from hpx_tpu.models import transformer as tfm
+from hpx_tpu.models.serving import ContinuousServer
+from hpx_tpu.core.config import runtime_config
+from hpx_tpu.svc import perfdb as pdbm
+from hpx_tpu.svc.perfdb import (
+    PERFDB_SCHEMA,
+    PerfDB,
+    PerfDBSchemaError,
+    PerfKey,
+    shape_str,
+)
+from hpx_tpu.utils.compilemon import count_compiles
+
+CFG = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=2, d_ff=40)
+
+KEY = "cpu|d32.h4.hd8.f40.l2.v64|-|dense|1"
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(1))
+
+
+@pytest.fixture
+def rc_perfdb(tmp_path):
+    """Point the configured store at a temp path; restore after."""
+    rc = runtime_config()
+    keys = ("hpx.perfdb.path", "hpx.perfdb.use_learned_ladders",
+            "hpx.perfdb.allow_session", "hpx.perfdb.record")
+    saved = {k: rc.get(k) for k in keys}
+    path = str(tmp_path / "perfdb.json")
+    rc.set("hpx.perfdb.path", path)
+    pdbm.reset_configured()
+    yield rc, path
+    for k, v in saved.items():
+        rc.set(k, v if v is not None else "")
+    pdbm.reset_configured()
+
+
+def _seed_costs(db, key=KEY, onchip=False):
+    """A minimal derivable cost surface: >=3 compile samples, exec
+    rows for two programs, and a chunk-demand histogram."""
+    db.observe(key, "compile_s", 0.4, n=4, source="t",
+               onchip=onchip)
+    db.observe(key, "exec_p50_s", 0.002, n=50, program="cb_step",
+               source="t", onchip=onchip)
+    db.observe(key, "exec_p50_s", 0.003, n=10, program="cb_chunk",
+               source="t", onchip=onchip)
+    db.observe(key, "prefill_frac", 0.2, source="t", onchip=onchip)
+    for rung, cnt in ((8, 1.0), (32, 4.0), (128, 6.0)):
+        db.observe(key, "chunk_demand", cnt, program=f"r{rung}",
+                   source="t", onchip=onchip)
+
+
+# ---------------------------------------------------------------------------
+# key grammar + store round trip
+# ---------------------------------------------------------------------------
+
+def test_perf_key_roundtrip():
+    k = PerfKey("TPU v5e", shape_str(CFG), "int8", "fused", "dp2xtp4")
+    assert PerfKey.parse(str(k)) == k
+    assert shape_str(CFG) == "d32.h4.hd8.f40.l2.v64"
+    # dense defaults: no kv dtype, dense kernel, single-device mesh
+    assert str(PerfKey("cpu", shape_str(CFG))) == KEY
+
+
+def test_store_roundtrip_and_model(tmp_path):
+    p = str(tmp_path / "db.json")
+    db = PerfDB(p)
+    _seed_costs(db)
+    db.save()
+    back = PerfDB(p)
+    m = back.model(KEY, "compile_s")
+    assert m["n"] == 4 and m["mean"] == pytest.approx(0.4)
+    pm = back.program_models(KEY, "exec_p50_s")
+    assert set(pm) == {"cb_chunk", "cb_step"}
+    assert pm["cb_step"]["n"] == 50
+    assert back.metrics_for(KEY) == ["chunk_demand", "compile_s",
+                                     "exec_p50_s", "prefill_frac"]
+
+
+# ---------------------------------------------------------------------------
+# merge-safety: concurrent writers, compaction tombstones
+# ---------------------------------------------------------------------------
+
+def test_concurrent_writers_merge_lossless(tmp_path):
+    p = str(tmp_path / "db.json")
+    a, b = PerfDB(p), PerfDB(p)
+    a.observe(KEY, "compile_s", 0.5, n=2, source="writer_a")
+    b.observe(KEY, "compile_s", 0.3, n=3, source="writer_b")
+    b.observe(KEY, "warm_tok_s", 100.0, source="writer_b")
+    a.save()
+    b.save()         # merges a's rows from disk — nothing lost
+    merged = PerfDB(p)
+    assert merged.model(KEY, "compile_s")["n"] == 5
+    assert merged.model(KEY, "warm_tok_s")["n"] == 1
+    srcs = {r["source"] for r in merged.observations}
+    assert srcs == {"writer_a", "writer_b"}
+
+
+def test_ladder_rev_wins_merge(tmp_path):
+    p = str(tmp_path / "db.json")
+    a, b = PerfDB(p), PerfDB(p)
+    a.record_ladder(KEY, {"prefill_buckets": [8, 128], "samples": 4})
+    a.save()
+    b.record_ladder(KEY, {"prefill_buckets": [32, 128], "samples": 9})
+    b.record_ladder(KEY, {"prefill_buckets": [64, 128], "samples": 9})
+    b.save()         # b's rev 2 beats a's rev 1
+    assert PerfDB(p).ladder(KEY)["prefill_buckets"] == [64, 128]
+
+
+def test_compaction_tombstones_survive_stale_writer(tmp_path):
+    p = str(tmp_path / "db.json")
+    db = PerfDB(p)
+    for i in range(6):
+        db.observe(KEY, "compile_s", 0.1 * (i + 1), source="t")
+    db.save()
+    stale = PerfDB(p)          # loaded BEFORE compaction
+    folded = db.compact(keep=2)
+    assert folded == 4
+    db.save()
+    stale.save()               # must not resurrect folded rows
+    back = PerfDB(p)
+    assert back.model(KEY, "compile_s")["n"] == 6   # not 10
+    assert len(back.observations) == 2
+
+
+# ---------------------------------------------------------------------------
+# schema discipline: corrupt + foreign versions refuse loudly
+# ---------------------------------------------------------------------------
+
+def test_corrupt_store_refused_loudly(tmp_path):
+    p = tmp_path / "db.json"
+    p.write_text("{not json")
+    with pytest.raises(PerfDBSchemaError, match="refusing"):
+        PerfDB(str(p))
+
+
+def test_old_schema_refused_naming_both_versions(tmp_path):
+    p = tmp_path / "db.json"
+    p.write_text(json.dumps({"schema": "hpx_tpu.perfdb.v0",
+                             "observations": []}))
+    with pytest.raises(PerfDBSchemaError) as ei:
+        PerfDB(str(p))
+    msg = str(ei.value)
+    assert "hpx_tpu.perfdb.v0" in msg       # the version it found
+    assert PERFDB_SCHEMA in msg             # the version it speaks
+
+
+def test_save_never_clobbers_foreign_schema(tmp_path):
+    p = tmp_path / "db.json"
+    db = PerfDB(str(p))
+    db.observe(KEY, "compile_s", 0.1, source="t")
+    p.write_text(json.dumps({"schema": "hpx_tpu.perfdb.v99"}))
+    with pytest.raises(PerfDBSchemaError):
+        db.save()
+    assert json.loads(p.read_text())["schema"] == "hpx_tpu.perfdb.v99"
+
+
+# ---------------------------------------------------------------------------
+# offline search: deterministic, provenance-gated
+# ---------------------------------------------------------------------------
+
+def test_ladder_derivation_is_byte_identical(tmp_path):
+    from benchmarks import ladder_search
+    p = str(tmp_path / "db.json")
+    db = PerfDB(p)
+    _seed_costs(db)
+    db.save()
+    props = [ladder_search.derive_ladder(PerfDB(p), KEY)
+             for _ in range(2)]
+    assert props[0] is not None
+    blobs = {json.dumps(pr, sort_keys=True) for pr in props}
+    assert len(blobs) == 1       # same DB -> byte-identical proposal
+    # chunk rung always present; tunables ride the derived ladder
+    lad = props[0]["prefill_buckets"]
+    assert lad[-1] == 128
+    assert props[0]["tunables"]["hpx.serving.prefill_chunk"]["lo"] \
+        == lad[0]
+    assert props[0]["provenance"] == "builder-session"
+
+
+def test_session_only_ladder_refused_without_flag(tmp_path, capsys):
+    import sys
+    from benchmarks import ladder_search
+    p = str(tmp_path / "db.json")
+    db = PerfDB(p)
+    _seed_costs(db, onchip=False)
+    db.save()
+    argv0 = sys.argv
+    try:
+        sys.argv = ["ladder_search", "--db", p]
+        assert ladder_search.main() == 1          # nothing installed
+        assert PerfDB(p).ladder(KEY) is None
+        out = capsys.readouterr().out
+        assert "builder-session-only" in out
+        sys.argv = ["ladder_search", "--db", p, "--allow-session"]
+        assert ladder_search.main() == 0
+        assert PerfDB(p).ladder(KEY) is not None
+    finally:
+        sys.argv = argv0
+
+
+def test_onchip_ladder_installs_without_flag(tmp_path):
+    import sys
+    from benchmarks import ladder_search
+    p = str(tmp_path / "db.json")
+    db = PerfDB(p)
+    _seed_costs(db, onchip=True)
+    db.save()
+    argv0 = sys.argv
+    try:
+        sys.argv = ["ladder_search", "--db", p]
+        assert ladder_search.main() == 0
+        lad = PerfDB(p).ladder(KEY)
+        assert lad["provenance"] == "on-chip" and lad["onchip"]
+    finally:
+        sys.argv = argv0
+
+
+# ---------------------------------------------------------------------------
+# serving boot consult: fail-safe byte-identity + learned override
+# ---------------------------------------------------------------------------
+
+def _run(srv, plens=(3, 9, 17, 23, 12), max_new=5):
+    import numpy as np
+    r = np.random.RandomState(7)
+    for plen in plens:
+        srv.submit([int(t) for t in r.randint(1, CFG.vocab, plen)],
+                   max_new=max_new)
+    out = srv.run()
+    return [out[k] for k in sorted(out)]
+
+
+def test_flag_off_and_empty_db_are_byte_identical(params, rc_perfdb):
+    rc, path = rc_perfdb
+    base_srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    base = _run(base_srv)
+    # flag ON but the store is EMPTY: boot consult misses and falls
+    # back to the hand-picked defaults — same ladder, same tokens,
+    # same O(buckets) compile bound (the compile guard)
+    rc.set("hpx.perfdb.use_learned_ladders", "1")
+    with count_compiles() as c:
+        srv = ContinuousServer(params, CFG, slots=2, smax=64)
+        out = _run(srv)
+    assert out == base
+    assert srv.prefill_buckets == base_srv.prefill_buckets
+    assert srv._ladder_source == "default"
+    assert srv._prog_misses <= len(srv.prefill_buckets) + 3
+    assert int(c) <= len(srv.prefill_buckets) + 22
+    assert pdbm.perfdb_counts()["misses"] >= 1
+    # flag OFF entirely: no store consult at all
+    rc.set("hpx.perfdb.use_learned_ladders", "0")
+    srv0 = ContinuousServer(params, CFG, slots=2, smax=64)
+    assert _run(srv0) == base
+
+
+def test_learned_ladder_overrides_and_output_identity(params,
+                                                     rc_perfdb):
+    rc, path = rc_perfdb
+    base_srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    base = _run(base_srv)
+    db = PerfDB(path)
+    db.record_ladder(KEY, {
+        "prefill_buckets": [16, 64], "prefill_chunk": 64,
+        "samples": 8, "onchip": False,
+        "provenance": "builder-session"})
+    db.save()
+    pdbm.reset_configured()
+    rc.set("hpx.perfdb.use_learned_ladders", "1")
+    # session-only ladder without allow_session: STALE, not applied
+    srv_stale = ContinuousServer(params, CFG, slots=2, smax=64)
+    assert srv_stale.prefill_buckets == base_srv.prefill_buckets
+    assert srv_stale._ladder_source == "default"
+    assert pdbm.perfdb_counts()["stale"] >= 1
+    # allow_session: the learned geometry applies...
+    rc.set("hpx.perfdb.allow_session", "1")
+    srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    assert srv.prefill_buckets == (16, 64)
+    assert srv.prefill_chunk == 64
+    assert srv._ladder_source == "learned"
+    assert pdbm.perfdb_counts()["hits"] >= 1
+    # ...and the ladder is a PERFORMANCE knob: tokens match exactly
+    assert _run(srv) == base
+    # explicit constructor args always beat the store
+    srv_exp = ContinuousServer(params, CFG, slots=2, smax=64,
+                               prefill_chunk=32,
+                               prefill_buckets="8,32")
+    assert srv_exp.prefill_buckets == (8, 32)
+    assert srv_exp._ladder_source == "default"
+
+
+def test_perf_key_and_counters(params, rc_perfdb):
+    rc, path = rc_perfdb
+    srv = ContinuousServer(params, CFG, slots=2, smax=64)
+    assert srv.perf_key() == KEY
+    c = pdbm.perfdb_counts()
+    assert set(c) == {"keys", "observations", "hits", "misses",
+                      "stale"}
